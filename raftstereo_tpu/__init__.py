@@ -1,0 +1,16 @@
+"""raftstereo_tpu — a TPU-native (JAX/XLA/Pallas) stereo-matching framework.
+
+Capability-parity rebuild of RAFT-Stereo (arXiv 2109.07547; reference repo
+xuhaozheng/RAFT-Stereo), designed TPU-first rather than ported:
+
+* NHWC layout, flax.linen modules, explicit torch-compatible conv padding
+* the full GRU refinement loop is a single ``jax.lax.scan`` -> one XLA program
+* correlation volume as batched matmuls on the MXU; lookup via XLA gather or a
+  gather-free Pallas kernel (the CUDA ``sampler/`` equivalent)
+* data/model parallelism via ``jax.sharding`` meshes, bf16 via a dtype policy,
+  Orbax checkpoints with full train state
+"""
+
+__version__ = "0.1.0"
+
+from .config import RAFTStereoConfig, TrainConfig  # noqa: F401

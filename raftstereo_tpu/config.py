@@ -1,0 +1,148 @@
+"""Single typed configuration shared by all entry points.
+
+The reference duplicates argparse model flags across four scripts
+(reference: train_stereo.py:233-241, evaluate_stereo.py:199-207, demo.py:64-72,
+test.py:26-34).  Here every entry point consumes one frozen dataclass, which is
+also hashable so it can be passed as a static argument through ``jax.jit``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RAFTStereoConfig:
+    """Architecture hyper-parameters of the RAFT-Stereo model.
+
+    Mirrors the capability surface of the reference flags
+    (reference: train_stereo.py:233-241) while staying a single typed object.
+    Level index 0 is the finest GRU resolution (1/2^n_downsample); higher
+    indices are coarser, matching the reference's ``net_list`` ordering
+    (reference: core/raft_stereo.py:84-85).
+    """
+
+    # Correlation engine.  Backends: "reg" (precomputed pyramid + XLA gather
+    # lookup), "alt" (on-demand, O(H*W) memory), "pallas" (precomputed pyramid +
+    # Pallas TPU lookup kernel — the reg_cuda analogue; reference: core/corr.py).
+    corr_implementation: str = "reg"
+    corr_levels: int = 4
+    corr_radius: int = 4
+
+    # Resolution of the disparity field: 1/2^n_downsample.
+    n_downsample: int = 2
+
+    # GRU stack.
+    n_gru_layers: int = 3
+    hidden_dims: Tuple[int, ...] = (128, 128, 128)  # finest -> coarsest
+    slow_fast_gru: bool = False
+
+    # Encoders.
+    shared_backbone: bool = False
+    context_norm: str = "batch"
+
+    # Precision policy.  "float32" or "bfloat16" compute for encoders + GRUs.
+    # The correlation volume dtype is controlled separately because lookup
+    # accuracy is precision-sensitive (reference: evaluate_stereo.py:227-230).
+    compute_dtype: str = "float32"
+    corr_dtype: str = "float32"
+
+    def __post_init__(self):
+        if isinstance(self.hidden_dims, list):
+            object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
+        assert self.corr_implementation in ("reg", "alt", "pallas"), self.corr_implementation
+        assert 1 <= self.n_gru_layers <= 3, self.n_gru_layers
+        assert len(self.hidden_dims) >= self.n_gru_layers
+
+    @property
+    def factor(self) -> int:
+        """Full-resolution upsampling factor for the disparity field."""
+        return 2 ** self.n_downsample
+
+    @property
+    def cor_planes(self) -> int:
+        """Correlation feature channels fed to the motion encoder."""
+        return self.corr_levels * (2 * self.corr_radius + 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training-loop hyper-parameters (reference: train_stereo.py:216-248)."""
+
+    name: str = "raft-stereo"
+    batch_size: int = 6
+    train_datasets: Tuple[str, ...] = ("sceneflow",)
+    lr: float = 2e-4
+    num_steps: int = 100000
+    image_size: Tuple[int, int] = (320, 720)
+    train_iters: int = 16
+    valid_iters: int = 32
+    wdecay: float = 1e-5
+    loss_gamma: float = 0.9
+    max_flow: float = 700.0
+    grad_clip: float = 1.0
+    seed: int = 1234
+    validation_frequency: int = 10000
+    checkpoint_dir: str = "checkpoints"
+    restore_ckpt: Optional[str] = None
+    keep_checkpoints: int = 5
+
+    # Data augmentation (reference: train_stereo.py:244-248).
+    img_gamma: Optional[Tuple[float, float]] = None
+    saturation_range: Optional[Tuple[float, float]] = None
+    do_flip: Optional[str] = None  # None | "h" | "v"
+    spatial_scale: Tuple[float, float] = (0.0, 0.0)
+    noyjitter: bool = False
+
+    # Parallelism: number of data-parallel shards (devices along the "data"
+    # mesh axis); None = all visible devices.
+    data_parallel: Optional[int] = None
+
+    def __post_init__(self):
+        for f in ("train_datasets", "image_size", "spatial_scale"):
+            v = getattr(self, f)
+            if isinstance(v, list):
+                object.__setattr__(self, f, tuple(v))
+        if isinstance(self.img_gamma, list):
+            object.__setattr__(self, "img_gamma", tuple(self.img_gamma))
+        if isinstance(self.saturation_range, list):
+            object.__setattr__(self, "saturation_range", tuple(self.saturation_range))
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing: one flag set, shared by every entry point.
+# ---------------------------------------------------------------------------
+
+def add_model_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("model")
+    g.add_argument("--corr_implementation", choices=["reg", "alt", "pallas"], default="reg")
+    g.add_argument("--corr_levels", type=int, default=4)
+    g.add_argument("--corr_radius", type=int, default=4)
+    g.add_argument("--n_downsample", type=int, default=2)
+    g.add_argument("--n_gru_layers", type=int, default=3)
+    g.add_argument("--hidden_dims", nargs="+", type=int, default=[128, 128, 128])
+    g.add_argument("--slow_fast_gru", action="store_true")
+    g.add_argument("--shared_backbone", action="store_true")
+    g.add_argument("--context_norm", choices=["group", "batch", "instance", "none"],
+                   default="batch")
+    g.add_argument("--mixed_precision", action="store_true",
+                   help="bfloat16 compute for encoders and GRUs")
+    g.add_argument("--corr_dtype", choices=["float32", "bfloat16"], default="float32")
+
+
+def model_config_from_args(args: argparse.Namespace) -> RAFTStereoConfig:
+    return RAFTStereoConfig(
+        corr_implementation=args.corr_implementation,
+        corr_levels=args.corr_levels,
+        corr_radius=args.corr_radius,
+        n_downsample=args.n_downsample,
+        n_gru_layers=args.n_gru_layers,
+        hidden_dims=tuple(args.hidden_dims),
+        slow_fast_gru=args.slow_fast_gru,
+        shared_backbone=args.shared_backbone,
+        context_norm=args.context_norm,
+        compute_dtype="bfloat16" if args.mixed_precision else "float32",
+        corr_dtype=args.corr_dtype,
+    )

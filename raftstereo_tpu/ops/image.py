@@ -1,0 +1,159 @@
+"""Image-space primitives shared by the model, correlation engine and eval.
+
+All tensors are NHWC (TPU-native convolution layout), in contrast to the
+reference's NCHW.  Semantics are kept bit-compatible with the reference ops
+they replace so that converted checkpoints reproduce the same numerics:
+
+* ``resize_bilinear_align_corners``  ==  ``F.interpolate(..., mode='bilinear',
+  align_corners=True)`` (reference: core/update.py:93-95, core/utils/utils.py:82-84)
+* ``avg_pool2x``  ==  ``F.avg_pool2d(x, 3, stride=2, padding=1)`` with
+  count_include_pad=True (reference: core/update.py:87-88)
+* ``avg_pool_w2``  ==  ``F.avg_pool2d(x, [1,2], stride=[1,2])`` over the W axis
+  (reference: core/corr.py:124)
+* ``InputPadder``  ==  replicate padding to a divisibility constraint
+  (reference: core/utils/utils.py:7-26)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _axis_resize_indices(in_size: int, out_size: int):
+    """Source indices + lerp weight for align-corners resize along one axis."""
+    if out_size == 1 or in_size == 1:
+        idx = np.zeros((out_size,), np.int32)
+        return idx, idx, np.zeros((out_size,), np.float32)
+    pos = np.arange(out_size, dtype=np.float64) * (in_size - 1) / (out_size - 1)
+    i0 = np.floor(pos).astype(np.int32)
+    i0 = np.minimum(i0, in_size - 1)
+    i1 = np.minimum(i0 + 1, in_size - 1)
+    w = (pos - i0).astype(np.float32)
+    return i0, i1, w
+
+
+def resize_bilinear_align_corners(x: jax.Array, out_hw: Tuple[int, int]) -> jax.Array:
+    """Bilinear resize with align_corners=True semantics.  x: (B, H, W, C).
+
+    ``jax.image.resize`` uses half-pixel centres, which does not match the
+    reference's ``align_corners=True`` (core/update.py:94); this separable
+    gather+lerp formulation does, and XLA fuses it cleanly.
+    """
+    b, h, w, c = x.shape
+    oh, ow = out_hw
+    if (h, w) == (oh, ow):
+        return x
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    i0, i1, wh = _axis_resize_indices(h, oh)
+    xf = xf[:, i0] * (1.0 - wh)[None, :, None, None] + xf[:, i1] * wh[None, :, None, None]
+    j0, j1, ww = _axis_resize_indices(w, ow)
+    xf = xf[:, :, j0] * (1.0 - ww)[None, None, :, None] + xf[:, :, j1] * ww[None, None, :, None]
+    return xf.astype(dtype)
+
+
+def avg_pool2x(x: jax.Array) -> jax.Array:
+    """3x3/stride-2/pad-1 average pool, zeros counted in the divisor.
+
+    Matches torch ``F.avg_pool2d(x, 3, stride=2, padding=1)`` defaults
+    (count_include_pad=True), used to pass fine GRU state down one level
+    (reference: core/update.py:87-88).
+    """
+    s = jax.lax.reduce_window(
+        x, 0.0 if x.dtype != jnp.bfloat16 else jnp.bfloat16(0), jax.lax.add,
+        window_dimensions=(1, 3, 3, 1), window_strides=(1, 2, 2, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)))
+    return s / jnp.asarray(9.0, dtype=x.dtype)
+
+
+def avg_pool4x(x: jax.Array) -> jax.Array:
+    """5x5/stride-4/pad-1 average pool (reference: core/update.py:90-91)."""
+    s = jax.lax.reduce_window(
+        x, 0.0 if x.dtype != jnp.bfloat16 else jnp.bfloat16(0), jax.lax.add,
+        window_dimensions=(1, 5, 5, 1), window_strides=(1, 4, 4, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)))
+    return s / jnp.asarray(25.0, dtype=x.dtype)
+
+
+def avg_pool_w2(x: jax.Array) -> jax.Array:
+    """Average-pool by 2 along the second-to-last (W2) axis of (..., W2).
+
+    Valid padding: an odd trailing element is dropped, matching torch's floor
+    behaviour for ``F.avg_pool2d(x, [1,2], stride=[1,2])``
+    (reference: core/corr.py:124).  Operates on the LAST axis.
+    """
+    w = x.shape[-1]
+    x = x[..., : (w // 2) * 2]
+    shape = x.shape[:-1] + (w // 2, 2)
+    return jnp.mean(x.reshape(shape), axis=-1)
+
+
+def gauss_blur(x: jax.Array, n: int = 5, std: float = 1.0) -> jax.Array:
+    """Depthwise Gaussian blur (reference: core/utils/utils.py:86-93)."""
+    g = np.arange(n, dtype=np.float64) - n // 2
+    k = np.exp(-(g[:, None] ** 2 + g[None, :] ** 2) / (2 * std ** 2))
+    k = (k / max(k.sum(), 1e-4)).astype(np.float32)
+    c = x.shape[-1]
+    kernel = jnp.tile(jnp.asarray(k)[:, :, None, None], (1, 1, 1, c))
+    return jax.lax.conv_general_dilated(
+        x.astype(jnp.float32), kernel,
+        window_strides=(1, 1), padding=[(n // 2, n // 2)] * 2,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c).astype(x.dtype)
+
+
+def replicate_pad(x: jax.Array, pad: Sequence[int]) -> jax.Array:
+    """Edge-replicate pad; pad = (left, right, top, bottom) on (B, H, W, C)."""
+    l, r, t, b = pad
+    return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge")
+
+
+class InputPadder:
+    """Pads NHWC images so H and W are divisible by ``divis_by``.
+
+    Same layout policy as the reference (core/utils/utils.py:7-26):
+    'sintel' mode splits padding around the image, otherwise all height
+    padding goes to the bottom.  Works on jax arrays and numpy arrays.
+    """
+
+    def __init__(self, dims: Sequence[int], mode: str = "sintel", divis_by: int = 8):
+        self.ht, self.wd = dims[-3:-1] if len(dims) == 4 else dims[-2:]
+        pad_ht = (((self.ht // divis_by) + 1) * divis_by - self.ht) % divis_by
+        pad_wd = (((self.wd // divis_by) + 1) * divis_by - self.wd) % divis_by
+        if mode == "sintel":
+            self._pad = (pad_wd // 2, pad_wd - pad_wd // 2,
+                         pad_ht // 2, pad_ht - pad_ht // 2)
+        else:
+            self._pad = (pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht)
+
+    @property
+    def padded_hw(self) -> Tuple[int, int]:
+        l, r, t, b = self._pad
+        return self.ht + t + b, self.wd + l + r
+
+    def pad(self, *inputs: jax.Array):
+        assert all(x.ndim == 4 for x in inputs)
+        out = [replicate_pad(x, self._pad) for x in inputs]
+        return out if len(out) > 1 else out[0]
+
+    def unpad(self, x: jax.Array) -> jax.Array:
+        assert x.ndim == 4
+        l, r, t, b = self._pad
+        ht, wd = x.shape[1:3]
+        return x[:, t:ht - b, l:wd - r, :]
+
+
+def coords_grid_x(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:
+    """x-coordinate grid (B, H, W, 1).
+
+    The reference carries a full 2-channel (x, y) grid (core/utils/utils.py:76-79)
+    but zeroes the y update every iteration (core/raft_stereo.py:120) — for
+    stereo only the x channel ever changes.  We carry x only and materialise a
+    zero y channel where the motion encoder needs 2-channel flow.
+    """
+    x = jnp.arange(wd, dtype=dtype)
+    return jnp.broadcast_to(x[None, None, :, None], (batch, ht, wd, 1))

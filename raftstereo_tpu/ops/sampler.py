@@ -1,0 +1,55 @@
+"""1-D linear sampling along the last axis — the lookup primitive of the
+correlation engine.
+
+Reproduces exactly the semantics of the reference's ``bilinear_sampler``
+(reference: core/utils/utils.py:59-73): pixel coordinates, align_corners=True,
+zero padding outside [0, W-1].  Because the problem is 1-D (the reference
+asserts H==1 at core/utils/utils.py:64) the op reduces to a gather + lerp along
+one axis, with out-of-range taps contributing zero.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def linear_sample_1d(vol: jax.Array, x: jax.Array) -> jax.Array:
+    """Sample ``vol`` (..., W) at fractional positions ``x`` (..., K).
+
+    Leading dims of ``vol`` and ``x`` must match.  Returns (..., K) with
+    out-of-bounds taps treated as zero (grid_sample zero padding).
+    """
+    w = vol.shape[-1]
+    x = x.astype(jnp.float32)
+    x0 = jnp.floor(x)
+    dx = x - x0
+    i0 = x0.astype(jnp.int32)
+    i1 = i0 + 1
+
+    v0 = jnp.take_along_axis(vol, jnp.clip(i0, 0, w - 1), axis=-1)
+    v1 = jnp.take_along_axis(vol, jnp.clip(i1, 0, w - 1), axis=-1)
+    valid0 = (i0 >= 0) & (i0 <= w - 1)
+    valid1 = (i1 >= 0) & (i1 <= w - 1)
+    v0 = jnp.where(valid0, v0, 0)
+    v1 = jnp.where(valid1, v1, 0)
+    return (v0.astype(jnp.float32) * (1.0 - dx) + v1.astype(jnp.float32) * dx)
+
+
+def linear_sample_1d_dense(vol: jax.Array, x: jax.Array) -> jax.Array:
+    """Gather-free formulation of :func:`linear_sample_1d`.
+
+    out[..., k] = sum_j vol[..., j] * relu(1 - |j - x[..., k]|)
+
+    The hat weight ``relu(1-|j-x|)`` is exactly the two-tap lerp including the
+    zero-padding boundary behaviour, so this is bit-for-bit the same math as
+    the gather version but expressed as a broadcast-compare-multiply-reduce,
+    which maps onto the TPU VPU with no gathers at all.  This is the XLA-level
+    mirror of the Pallas lookup kernel and is used as its test oracle.
+    Cost O(W*K) per row instead of O(K) — cheap next to the matmuls here.
+    """
+    w = vol.shape[-1]
+    j = jnp.arange(w, dtype=jnp.float32)
+    # (..., K, W) weights
+    wt = jnp.maximum(0.0, 1.0 - jnp.abs(j[None, :] - x[..., :, None].astype(jnp.float32)))
+    return jnp.einsum("...w,...kw->...k", vol.astype(jnp.float32), wt)

@@ -1,0 +1,50 @@
+"""Learned convex upsampling of the low-resolution disparity field.
+
+Replaces the reference's ``F.unfold`` formulation (core/raft_stereo.py:55-67)
+with explicit shifted slices + one einsum: JAX has no unfold, and the
+slice/einsum form lets XLA fuse mask softmax, weighting and the final
+reshuffle into one kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def extract_3x3_patches(x: jax.Array) -> jax.Array:
+    """(B, H, W, C) -> (B, H, W, 9, C): zero-padded 3x3 neighbourhoods.
+
+    Patch index k = ky*3 + kx, matching torch ``F.unfold``'s (kh, kw) flatten
+    order so converted mask-head weights keep their meaning
+    (reference: core/raft_stereo.py:62-63).
+    """
+    b, h, w, c = x.shape
+    p = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    rows = [p[:, ky:ky + h, kx:kx + w, :] for ky in range(3) for kx in range(3)]
+    return jnp.stack(rows, axis=3)
+
+
+def convex_upsample(flow: jax.Array, mask: jax.Array, factor: int) -> jax.Array:
+    """Upsample (B, H, W, D) -> (B, factor*H, factor*W, D) by a learned
+    softmax-convex combination over each pixel's 3x3 coarse neighbourhood.
+
+    ``mask`` is (B, H, W, 9*factor*factor) with channel index
+    ((k*factor + fy)*factor + fx), the layout of the reference's mask head
+    (core/raft_stereo.py:59).  Flow values are scaled by ``factor`` because
+    disparities are measured in pixels of the respective resolution.
+    """
+    b, h, w, d = flow.shape
+    mask = mask.reshape(b, h, w, 9, factor, factor).astype(jnp.float32)
+    mask = jax.nn.softmax(mask, axis=3)
+
+    patches = extract_3x3_patches(flow.astype(jnp.float32) * factor)  # (B,H,W,9,D)
+    up = jnp.einsum("bhwkd,bhwkyx->bhywxd", patches, mask)
+    return up.reshape(b, h * factor, w * factor, d)
+
+
+def upsample_interp(flow: jax.Array, factor: int) -> jax.Array:
+    """Fallback bilinear upsampling (reference: core/utils/utils.py:82-84)."""
+    from .image import resize_bilinear_align_corners
+    b, h, w, d = flow.shape
+    return factor * resize_bilinear_align_corners(flow, (h * factor, w * factor))

@@ -1,0 +1,35 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+This is the idiomatic JAX answer to testing multi-chip code without a pod
+(SURVEY.md §4): force the host platform and fan it out into 8 XLA devices so
+sharding/collective paths execute for real.
+
+The platform override must go through ``jax.config`` (not just the env var):
+site hooks may import jax at interpreter startup, freezing JAX_PLATFORMS
+before this file runs.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "torch_parity: parity tests against the reference PyTorch code")
