@@ -1,0 +1,14 @@
+"""Model zoo: RAFT-Stereo and its building blocks."""
+
+from .encoders import BasicEncoder, MultiBasicEncoder
+from .layers import BottleneckBlock, ResidualBlock
+from .raft_stereo import ContextZQR, RAFTStereo, SharedBackboneHead, count_parameters
+from .update import (BasicMotionEncoder, BasicMultiUpdateBlock, ConvGRU,
+                     FlowHead, SepConvGRU)
+
+__all__ = [
+    "BasicEncoder", "MultiBasicEncoder", "BottleneckBlock", "ResidualBlock",
+    "ContextZQR", "RAFTStereo", "SharedBackboneHead", "count_parameters",
+    "BasicMotionEncoder", "BasicMultiUpdateBlock", "ConvGRU", "FlowHead",
+    "SepConvGRU",
+]

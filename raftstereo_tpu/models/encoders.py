@@ -1,0 +1,127 @@
+"""Feature and context encoders.
+
+Capability mirror of the reference's ``BasicEncoder``/``MultiBasicEncoder``
+(reference: core/extractor.py:122-300), NHWC + flax.linen.  Stride placement
+follows the reference's downsample-factor logic: conv1 strides iff
+downsample>2, layer2 iff downsample>1, layer3 iff downsample>0, so the trunk
+output sits at 1/2^downsample resolution.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .layers import ResidualBlock, conv, make_norm
+
+
+class BasicEncoder(nn.Module):
+    """Residual trunk -> ``output_dim`` feature maps at 1/2^downsample res
+    (reference: core/extractor.py:122-197).  The reference's list-input
+    batching trick (stack both images into the batch axis) is the caller's
+    job here — pass (2B, H, W, 3)."""
+
+    output_dim: int = 128
+    norm_fn: str = "batch"
+    downsample: int = 3
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        d = self.downsample
+        self.conv1 = conv(64, 7, stride=1 + (d > 2), padding=3, dtype=self.dtype)
+        self.norm1 = make_norm(self.norm_fn, 64, self.dtype, num_groups=8)
+        self.layer1_0 = ResidualBlock(64, 64, self.norm_fn, 1, self.dtype)
+        self.layer1_1 = ResidualBlock(64, 64, self.norm_fn, 1, self.dtype)
+        self.layer2_0 = ResidualBlock(64, 96, self.norm_fn, 1 + (d > 1), self.dtype)
+        self.layer2_1 = ResidualBlock(96, 96, self.norm_fn, 1, self.dtype)
+        self.layer3_0 = ResidualBlock(96, 128, self.norm_fn, 1 + (d > 0), self.dtype)
+        self.layer3_1 = ResidualBlock(128, 128, self.norm_fn, 1, self.dtype)
+        self.conv2 = conv(self.output_dim, 1, padding=0, dtype=self.dtype)
+
+    def __call__(self, x):
+        x = nn.relu(self.norm1(self.conv1(x)))
+        for blk in (self.layer1_0, self.layer1_1, self.layer2_0, self.layer2_1,
+                    self.layer3_0, self.layer3_1):
+            x = blk(x)
+        return self.conv2(x)
+
+
+class MultiBasicEncoder(nn.Module):
+    """Context encoder: shared trunk + two extra stride-2 stages, with
+    per-GRU-level output heads (reference: core/extractor.py:199-300).
+
+    ``output_dims`` is a sequence of channel tuples, one per output head
+    group (the model passes (hidden_dims, hidden_dims) for the GRU hidden
+    state and the context stream).  Each tuple is indexed finest-first:
+    dims[level] is the head width at GRU level ``level`` (0 = finest).
+
+    Returns ``(levels, heads)``-nested lists: ``out[level][head]``, finest
+    level first, plus the trunk features when ``dual_inp`` (shared-backbone
+    mode, reference: core/raft_stereo.py:78-80).
+    """
+
+    output_dims: Sequence[Tuple[int, ...]] = ((128, 128, 128), (128, 128, 128))
+    norm_fn: str = "batch"
+    downsample: int = 3
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        d = self.downsample
+        self.conv1 = conv(64, 7, stride=1 + (d > 2), padding=3, dtype=self.dtype)
+        self.norm1 = make_norm(self.norm_fn, 64, self.dtype, num_groups=8)
+        self.layer1_0 = ResidualBlock(64, 64, self.norm_fn, 1, self.dtype)
+        self.layer1_1 = ResidualBlock(64, 64, self.norm_fn, 1, self.dtype)
+        self.layer2_0 = ResidualBlock(64, 96, self.norm_fn, 1 + (d > 1), self.dtype)
+        self.layer2_1 = ResidualBlock(96, 96, self.norm_fn, 1, self.dtype)
+        self.layer3_0 = ResidualBlock(96, 128, self.norm_fn, 1 + (d > 0), self.dtype)
+        self.layer3_1 = ResidualBlock(128, 128, self.norm_fn, 1, self.dtype)
+        self.layer4_0 = ResidualBlock(128, 128, self.norm_fn, 2, self.dtype)
+        self.layer4_1 = ResidualBlock(128, 128, self.norm_fn, 1, self.dtype)
+        self.layer5_0 = ResidualBlock(128, 128, self.norm_fn, 2, self.dtype)
+        self.layer5_1 = ResidualBlock(128, 128, self.norm_fn, 1, self.dtype)
+
+        # Heads: level 0 (finest, trunk res) gets a ResidualBlock + 3x3 conv,
+        # level 1 the same, level 2 (coarsest) a bare 3x3 conv — mirroring the
+        # reference's outputs08/outputs16/outputs32 structure
+        # (core/extractor.py:227-250).
+        heads08, heads16, heads32 = [], [], []
+        for hi, dims in enumerate(self.output_dims):
+            heads08.append((
+                ResidualBlock(128, 128, self.norm_fn, 1, self.dtype,
+                              name=f"head08_{hi}_res"),
+                conv(dims[0], 3, dtype=self.dtype, name=f"head08_{hi}_conv"),
+            ))
+            heads16.append((
+                ResidualBlock(128, 128, self.norm_fn, 1, self.dtype,
+                              name=f"head16_{hi}_res"),
+                conv(dims[1], 3, dtype=self.dtype, name=f"head16_{hi}_conv"),
+            ))
+            heads32.append(conv(dims[2], 3, dtype=self.dtype,
+                                name=f"head32_{hi}_conv"))
+        self.heads08 = heads08
+        self.heads16 = heads16
+        self.heads32 = heads32
+
+    def __call__(self, x, dual_inp: bool = False, num_layers: int = 3):
+        x = nn.relu(self.norm1(self.conv1(x)))
+        for blk in (self.layer1_0, self.layer1_1, self.layer2_0, self.layer2_1,
+                    self.layer3_0, self.layer3_1):
+            x = blk(x)
+        trunk = None
+        if dual_inp:
+            trunk = x
+            x = x[: x.shape[0] // 2]
+
+        out08 = [head_conv(head_res(x)) for head_res, head_conv in self.heads08]
+        outputs = [out08]
+        if num_layers >= 2:
+            y = self.layer4_1(self.layer4_0(x))
+            outputs.append([hc(hr(y)) for hr, hc in self.heads16])
+        if num_layers >= 3:
+            z = self.layer5_1(self.layer5_0(y))
+            outputs.append([hc(z) for hc in self.heads32])
+        if dual_inp:
+            return outputs, trunk
+        return outputs

@@ -1,0 +1,121 @@
+"""Shared building blocks: norm factory, torch-compatible convs, residual blocks.
+
+Numerical parity notes (for checkpoint conversion against the reference):
+
+* Convs use explicit torch-style padding tuples, never 'SAME' — XLA's SAME
+  places stride-2 windows differently from torch's symmetric padding.
+* InstanceNorm == GroupNorm with one channel per group, no affine params,
+  eps 1e-5 (torch InstanceNorm2d defaults; reference: core/extractor.py:29).
+* BatchNorm always runs in frozen (inference-stats) mode: the reference keeps
+  BN frozen for the entire training run (reference: train_stereo.py:152,
+  core/raft_stereo.py:41-44), so `use_running_average=True` is the training
+  semantics too, while scale/bias stay trainable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# torch kaiming_normal_(mode='fan_out', nonlinearity='relu'), the reference's
+# conv init (core/extractor.py:155-162).
+kaiming_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def conv(features: int, kernel: int, stride: int = 1, padding: Optional[int] = None,
+         dtype: Any = jnp.float32, name: Optional[str] = None) -> nn.Conv:
+    """Conv2D with torch-default geometry (explicit symmetric padding)."""
+    if padding is None:
+        padding = kernel // 2
+    return nn.Conv(features, (kernel, kernel), strides=(stride, stride),
+                   padding=((padding, padding), (padding, padding)),
+                   kernel_init=kaiming_out, dtype=dtype, name=name)
+
+
+class Identity(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x
+
+
+def make_norm(norm_fn: str, channels: int, dtype: Any = jnp.float32,
+              num_groups: Optional[int] = None, name: Optional[str] = None) -> nn.Module:
+    """Norm factory mirroring the reference's four options
+    (reference: core/extractor.py:16-38)."""
+    if norm_fn == "group":
+        return nn.GroupNorm(num_groups=num_groups or channels // 8,
+                            epsilon=1e-5, dtype=dtype, name=name)
+    if norm_fn == "batch":
+        return nn.BatchNorm(use_running_average=True, epsilon=1e-5,
+                            dtype=dtype, name=name)
+    if norm_fn == "instance":
+        return nn.GroupNorm(num_groups=channels, use_scale=False, use_bias=False,
+                            epsilon=1e-5, dtype=dtype, name=name)
+    if norm_fn == "none":
+        return Identity(name=name)
+    raise ValueError(f"unknown norm: {norm_fn}")
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs with norms + identity/projection shortcut
+    (reference: core/extractor.py:6-60)."""
+
+    in_planes: int
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.conv1 = conv(self.planes, 3, self.stride, dtype=self.dtype)
+        self.conv2 = conv(self.planes, 3, 1, dtype=self.dtype)
+        self.norm1 = make_norm(self.norm_fn, self.planes, self.dtype)
+        self.norm2 = make_norm(self.norm_fn, self.planes, self.dtype)
+        self.has_projection = not (self.stride == 1 and self.in_planes == self.planes)
+        if self.has_projection:
+            self.downsample_conv = conv(self.planes, 1, self.stride, padding=0,
+                                        dtype=self.dtype)
+            self.downsample_norm = make_norm(self.norm_fn, self.planes, self.dtype)
+
+    def __call__(self, x):
+        y = nn.relu(self.norm1(self.conv1(x)))
+        y = nn.relu(self.norm2(self.conv2(y)))
+        if self.has_projection:
+            x = self.downsample_norm(self.downsample_conv(x))
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (reference: core/extractor.py:64-120;
+    defined for capability parity — unused by the default architecture)."""
+
+    in_planes: int
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        p4 = self.planes // 4
+        g = self.planes // 8
+        self.conv1 = conv(p4, 1, 1, padding=0, dtype=self.dtype)
+        self.conv2 = conv(p4, 3, self.stride, dtype=self.dtype)
+        self.conv3 = conv(self.planes, 1, 1, padding=0, dtype=self.dtype)
+        self.norm1 = make_norm(self.norm_fn, p4, self.dtype, num_groups=g)
+        self.norm2 = make_norm(self.norm_fn, p4, self.dtype, num_groups=g)
+        self.norm3 = make_norm(self.norm_fn, self.planes, self.dtype, num_groups=g)
+        if self.stride != 1:
+            self.downsample_conv = conv(self.planes, 1, self.stride, padding=0,
+                                        dtype=self.dtype)
+            self.downsample_norm = make_norm(self.norm_fn, self.planes, self.dtype,
+                                             num_groups=g)
+
+    def __call__(self, x):
+        y = nn.relu(self.norm1(self.conv1(x)))
+        y = nn.relu(self.norm2(self.conv2(y)))
+        y = nn.relu(self.norm3(self.conv3(y)))
+        if self.stride != 1:
+            x = self.downsample_norm(self.downsample_conv(x))
+        return nn.relu(x + y)

@@ -1,0 +1,183 @@
+"""Iterative refinement: motion encoder + multilevel ConvGRU stack + heads.
+
+Capability mirror of the reference's update module (reference: core/update.py),
+NHWC + flax.linen.  Differences by design:
+
+* The GRU context biases (cz, cr, cq) are precomputed once outside the loop
+  (reference does the same: core/raft_stereo.py:32,88) and passed in.
+* Disparity is carried as a single channel; the 2-channel flow the motion
+  encoder expects (its 7x7 conv has 2 input channels) is materialised with a
+  zero y channel, preserving converted-weight compatibility while halving the
+  recurrent flow state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config import RAFTStereoConfig
+from ..ops.image import avg_pool2x, resize_bilinear_align_corners
+from .layers import conv
+
+
+class FlowHead(nn.Module):
+    """3x3 conv -> relu -> 3x3 conv (reference: core/update.py:6-14).
+    Output stays 2-channel for weight parity; the model uses channel 0."""
+
+    hidden_dim: int = 256
+    output_dim: int = 2
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.conv1 = conv(self.hidden_dim, 3, dtype=self.dtype)
+        self.conv2 = conv(self.output_dim, 3, dtype=self.dtype)
+
+    def __call__(self, x):
+        return self.conv2(nn.relu(self.conv1(x)))
+
+
+class ConvGRU(nn.Module):
+    """Conv gated recurrent unit with external context biases
+    (reference: core/update.py:16-32).  Concat order [h, x] and [r*h, x]
+    is preserved for checkpoint conversion."""
+
+    hidden_dim: int
+    kernel_size: int = 3
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        k = self.kernel_size
+        self.convz = conv(self.hidden_dim, k, dtype=self.dtype)
+        self.convr = conv(self.hidden_dim, k, dtype=self.dtype)
+        self.convq = conv(self.hidden_dim, k, dtype=self.dtype)
+
+    def __call__(self, h, cz, cr, cq, *x_list):
+        x = jnp.concatenate(x_list, axis=-1)
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(self.convz(hx) + cz)
+        r = nn.sigmoid(self.convr(hx) + cr)
+        q = nn.tanh(self.convq(jnp.concatenate([r * h, x], axis=-1)) + cq)
+        return (1 - z) * h + z * q
+
+
+class SepConvGRU(nn.Module):
+    """Separable (1x5 then 5x1) ConvGRU (reference: core/update.py:34-62;
+    capability parity — unused by the default path)."""
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        def c(name, kh, kw, ph, pw):
+            return nn.Conv(self.hidden_dim, (kh, kw),
+                           padding=((ph, ph), (pw, pw)), dtype=self.dtype,
+                           name=name)
+        self.convz1 = c("convz1", 1, 5, 0, 2)
+        self.convr1 = c("convr1", 1, 5, 0, 2)
+        self.convq1 = c("convq1", 1, 5, 0, 2)
+        self.convz2 = c("convz2", 5, 1, 2, 0)
+        self.convr2 = c("convr2", 5, 1, 2, 0)
+        self.convq2 = c("convq2", 5, 1, 2, 0)
+
+    def __call__(self, h, *x_list):
+        x = jnp.concatenate(x_list, axis=-1)
+        for convz, convr, convq in ((self.convz1, self.convr1, self.convq1),
+                                    (self.convz2, self.convr2, self.convq2)):
+            hx = jnp.concatenate([h, x], axis=-1)
+            z = nn.sigmoid(convz(hx))
+            r = nn.sigmoid(convr(hx))
+            q = nn.tanh(convq(jnp.concatenate([r * h, x], axis=-1)))
+            h = (1 - z) * h + z * q
+        return h
+
+
+class BasicMotionEncoder(nn.Module):
+    """Fuses correlation features and current flow into 128 motion channels,
+    the last 2 being the raw flow (reference: core/update.py:64-85)."""
+
+    cor_planes: int
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.convc1 = conv(64, 1, padding=0, dtype=self.dtype)
+        self.convc2 = conv(64, 3, dtype=self.dtype)
+        self.convf1 = conv(64, 7, padding=3, dtype=self.dtype)
+        self.convf2 = conv(64, 3, dtype=self.dtype)
+        self.conv = conv(128 - 2, 3, dtype=self.dtype)
+
+    def __call__(self, flow, corr):
+        cor = nn.relu(self.convc2(nn.relu(self.convc1(corr))))
+        flo = nn.relu(self.convf2(nn.relu(self.convf1(flow))))
+        out = nn.relu(self.conv(jnp.concatenate([cor, flo], axis=-1)))
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+def _interp_to(x, dest):
+    return resize_bilinear_align_corners(x, dest.shape[1:3])
+
+
+class BasicMultiUpdateBlock(nn.Module):
+    """Coupled multilevel GRU update (reference: core/update.py:97-138).
+
+    Levels are indexed finest-first: net[0] is the 1/2^n_downsample state
+    (the reference's net_list ordering, core/raft_stereo.py:84).  GRU call
+    order is coarsest -> finest, with avg-pooled finer state and bilinearly
+    upsampled coarser state as cross-level inputs.
+    """
+
+    config: RAFTStereoConfig
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        hd = cfg.hidden_dims
+        n = cfg.n_gru_layers
+        self.encoder = BasicMotionEncoder(cfg.cor_planes, dtype=self.dtype)
+        encoder_output_dim = 128
+        # Input widths mirror reference wiring (core/update.py:104-106).
+        self.gru0 = ConvGRU(hd[0], dtype=self.dtype)   # finest ("gru08")
+        if n >= 2:
+            self.gru1 = ConvGRU(hd[1], dtype=self.dtype)   # mid ("gru16")
+        if n == 3:
+            self.gru2 = ConvGRU(hd[2], dtype=self.dtype)   # coarsest ("gru32")
+        self.flow_head = FlowHead(hidden_dim=256, output_dim=2, dtype=self.dtype)
+        factor = cfg.factor
+        self.mask_conv1 = conv(256, 3, dtype=self.dtype)
+        self.mask_conv2 = conv(factor * factor * 9, 1, padding=0, dtype=self.dtype)
+
+    def __call__(self, net: Sequence[jax.Array], inp: Sequence[Tuple],
+                 corr: Optional[jax.Array] = None,
+                 flow: Optional[jax.Array] = None,
+                 iter0: bool = True, iter1: bool = True, iter2: bool = True,
+                 update: bool = True):
+        cfg = self.config
+        n = cfg.n_gru_layers
+        net = list(net)
+
+        if n == 3 and iter2:
+            net[2] = self.gru2(net[2], *inp[2], avg_pool2x(net[1]))
+        if n >= 2 and iter1:
+            if n > 2:
+                net[1] = self.gru1(net[1], *inp[1], avg_pool2x(net[0]),
+                                   _interp_to(net[2], net[1]))
+            else:
+                net[1] = self.gru1(net[1], *inp[1], avg_pool2x(net[0]))
+        if iter0:
+            motion_features = self.encoder(flow, corr)
+            if n > 1:
+                net[0] = self.gru0(net[0], *inp[0], motion_features,
+                                   _interp_to(net[1], net[0]))
+            else:
+                net[0] = self.gru0(net[0], *inp[0], motion_features)
+
+        if not update:
+            return net
+
+        delta = self.flow_head(net[0])
+        # 0.25 scaling balances mask-head gradients (reference: core/update.py:137).
+        mask = 0.25 * self.mask_conv2(nn.relu(self.mask_conv1(net[0])))
+        return net, mask, delta

@@ -1,0 +1,5 @@
+"""Utilities: checkpoint conversion, logging, misc."""
+
+from .convert import convert_checkpoint, load_state_dict, torch_to_variables
+
+__all__ = ["convert_checkpoint", "load_state_dict", "torch_to_variables"]

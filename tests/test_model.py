@@ -1,0 +1,159 @@
+"""Model-level tests: shapes, jit, scan semantics, config variants."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raftstereo_tpu import RAFTStereoConfig
+from raftstereo_tpu.models import RAFTStereo, count_parameters
+
+
+def make_images(rng, b=1, h=64, w=96):
+    i1 = rng.uniform(0, 255, (b, h, w, 3)).astype(np.float32)
+    i2 = rng.uniform(0, 255, (b, h, w, 3)).astype(np.float32)
+    return jnp.asarray(i1), jnp.asarray(i2)
+
+
+@pytest.fixture(scope="module")
+def default_model():
+    cfg = RAFTStereoConfig()
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0))
+    return model, variables
+
+
+class TestForward:
+    def test_train_mode_shapes(self, default_model, rng):
+        model, variables = default_model
+        i1, i2 = make_images(rng)
+        preds = model.forward(variables, i1, i2, iters=3)
+        assert preds.shape == (3, 1, 64, 96, 1)
+        assert np.isfinite(np.asarray(preds)).all()
+
+    def test_test_mode_shapes(self, default_model, rng):
+        model, variables = default_model
+        i1, i2 = make_images(rng)
+        low, up = model.forward(variables, i1, i2, iters=3, test_mode=True)
+        assert low.shape == (1, 16, 24, 1)
+        assert up.shape == (1, 64, 96, 1)
+
+    def test_test_mode_final_equals_train_mode_last(self, default_model, rng):
+        """test_mode only skips intermediate upsampling; the final prediction
+        must match train mode's last (reference: core/raft_stereo.py:126-139)."""
+        model, variables = default_model
+        i1, i2 = make_images(rng)
+        preds = model.forward(variables, i1, i2, iters=3)
+        _, up = model.forward(variables, i1, i2, iters=3, test_mode=True)
+        np.testing.assert_allclose(np.asarray(preds[-1]), np.asarray(up),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_flow_init_shifts_start(self, default_model, rng):
+        model, variables = default_model
+        i1, i2 = make_images(rng)
+        init = jnp.full((1, 16, 24, 1), -3.0)
+        a = model.forward(variables, i1, i2, iters=1, test_mode=True)[0]
+        b = model.forward(variables, i1, i2, iters=1, flow_init=init,
+                          test_mode=True)[0]
+        assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-3
+
+    def test_jit_compiles_and_matches_eager(self, default_model, rng):
+        model, variables = default_model
+        i1, i2 = make_images(rng)
+        eager = model.forward(variables, i1, i2, iters=2, test_mode=True)[1]
+        jitted = model.jitted_infer(iters=2)(variables, i1, i2)[1]
+        # XLA fusion reassociates float math; allow fusion-level jitter.
+        np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_iterations_refine(self, default_model, rng):
+        """More iterations should change (refine) the prediction."""
+        model, variables = default_model
+        i1, i2 = make_images(rng)
+        up1 = model.forward(variables, i1, i2, iters=1, test_mode=True)[1]
+        up8 = model.forward(variables, i1, i2, iters=8, test_mode=True)[1]
+        assert np.abs(np.asarray(up1) - np.asarray(up8)).max() > 1e-4
+
+
+class TestConfigVariants:
+    @pytest.mark.parametrize("n_layers", [1, 2, 3])
+    def test_gru_layers(self, rng, n_layers):
+        cfg = RAFTStereoConfig(n_gru_layers=n_layers)
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(1))
+        i1, i2 = make_images(rng, h=32, w=48)
+        low, up = model.forward(variables, i1, i2, iters=2, test_mode=True)
+        assert up.shape == (1, 32, 48, 1)
+
+    def test_slow_fast_gru(self, rng):
+        cfg = RAFTStereoConfig(slow_fast_gru=True)
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(1))
+        i1, i2 = make_images(rng, h=32, w=48)
+        _, up = model.forward(variables, i1, i2, iters=2, test_mode=True)
+        assert np.isfinite(np.asarray(up)).all()
+
+    def test_shared_backbone(self, rng):
+        cfg = RAFTStereoConfig(shared_backbone=True)
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(1))
+        i1, i2 = make_images(rng, h=32, w=48)
+        _, up = model.forward(variables, i1, i2, iters=2, test_mode=True)
+        assert np.isfinite(np.asarray(up)).all()
+
+    def test_realtime_config(self, rng):
+        """The reference's realtime preset (reference: README.md:82-84)."""
+        cfg = RAFTStereoConfig(shared_backbone=True, n_downsample=3,
+                               n_gru_layers=2, slow_fast_gru=True)
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(1))
+        i1, i2 = make_images(rng, h=64, w=96)
+        low, up = model.forward(variables, i1, i2, iters=7, test_mode=True)
+        assert low.shape == (1, 8, 12, 1)
+        assert up.shape == (1, 64, 96, 1)
+
+    def test_n_downsample_3(self, rng):
+        cfg = RAFTStereoConfig(n_downsample=3)
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(1))
+        i1, i2 = make_images(rng, h=64, w=96)
+        low, up = model.forward(variables, i1, i2, iters=2, test_mode=True)
+        assert low.shape == (1, 8, 12, 1)
+        assert up.shape == (1, 64, 96, 1)
+
+    def test_alt_backend_matches_reg(self, rng):
+        i1 = jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)).astype(np.float32))
+        i2 = jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)).astype(np.float32))
+        out = {}
+        for impl in ("reg", "alt"):
+            cfg = RAFTStereoConfig(corr_implementation=impl)
+            model = RAFTStereo(cfg)
+            variables = model.init(jax.random.key(2))
+            out[impl] = np.asarray(
+                model.forward(variables, i1, i2, iters=2, test_mode=True)[1])
+        np.testing.assert_allclose(out["reg"], out["alt"], rtol=1e-4, atol=1e-4)
+
+
+class TestGradients:
+    def test_train_gradients_finite(self, default_model, rng):
+        model, variables = default_model
+        i1, i2 = make_images(rng, h=32, w=48)
+        gt = jnp.asarray(-rng.uniform(0, 10, (1, 32, 48, 1)).astype(np.float32))
+
+        def loss_fn(params):
+            v = dict(variables, params=params)
+            preds = model.forward(v, i1, i2, iters=2)
+            return jnp.abs(preds - gt).mean()
+
+        g = jax.grad(loss_fn)(variables["params"])
+        leaves = jax.tree.leaves(g)
+        assert all(np.isfinite(np.asarray(x)).all() for x in leaves)
+        total = sum(float(jnp.abs(x).sum()) for x in leaves)
+        assert total > 0
+
+
+def test_parameter_count_close_to_reference_scale(default_model):
+    """Default config should be ~11M params (RAFT-Stereo scale)."""
+    _, variables = default_model
+    n = count_parameters(variables)
+    assert 8e6 < n < 15e6, n
