@@ -1,0 +1,187 @@
+"""Pallas TPU kernel for the correlation-pyramid lookup.
+
+This is the TPU-native equivalent of the reference's CUDA extension
+(reference: sampler/sampler.cpp, sampler/sampler_kernel.cu): per output pixel,
+interpolate 2r+1 taps from its correlation row.  Where the CUDA kernel gathers
+2r+2 integer taps and lerps (sampler_kernel.cu:19-60), a TPU kernel must avoid
+per-lane gathers entirely — instead each (row-block, tap) output is computed as
+a masked reduction over the whole W2 row with the hat weight
+
+    w(j) = relu(1 - |j - x_k|)
+
+which is algebraically identical to two-tap linear interpolation with zero
+padding (see ops/sampler.linear_sample_1d_dense, the XLA oracle for this
+kernel).  The reduction is pure VPU work: broadcast-compare-multiply-add over
+a VMEM-resident row block, no scatter/gather anywhere.
+
+The backward pass mirrors the CUDA scatter-add backward
+(sampler_kernel.cu:63-105) but again as a dense product:
+    dvol[w1, j] = sum_k g[w1, k] * w_k(j)
+Gradients w.r.t. coordinates are not needed: the model detaches the disparity
+at the top of every refinement iteration (reference: core/raft_stereo.py:109,
+CorrSampler.backward likewise returns None for coords, core/corr.py:24-29).
+
+Supports fp32 and bf16 volumes (the CUDA kernel's
+AT_DISPATCH_FLOATING_TYPES_AND_HALF, sampler_kernel.cu:126); accumulation is
+always fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Max rows (W1 pixels) per block; lane-width multiple keeps the VPU fully busy.
+_BLOCK_W1 = 256
+
+# None = auto (compile on TPU backends, interpret elsewhere).  Set True to
+# force interpret mode, e.g. when debugging CPU-placed execution on a TPU host
+# (auto-detection keys off the default backend, not actual placement).
+interpret_override = None
+
+
+def _interpret() -> bool:
+    if interpret_override is not None:
+        return interpret_override
+    return jax.default_backend() not in ("tpu",)
+
+
+def _block_w1(w1: int) -> int:
+    """Row-block size: cap at _BLOCK_W1 but don't pad small W1 up to it —
+    the dense reduction's FLOPs scale with the padded row count."""
+    return min(_BLOCK_W1, -(-w1 // 8) * 8)
+
+
+def _lookup_kernel(vol_ref, taps_ref, out_ref):
+    """One (n, w1-block): out[w1, k] = sum_j vol[w1, j] * hat(j - taps[w1, k])."""
+    vol = vol_ref[0].astype(jnp.float32)          # (W1_t, W2)
+    taps = taps_ref[0].astype(jnp.float32)        # (W1_t, K)
+    w2 = vol.shape[-1]
+    k = taps.shape[-1]
+    j = jax.lax.broadcasted_iota(jnp.float32, (1, w2), 1)   # (1, W2)
+    cols = []
+    for ki in range(k):                            # K is small (9): unrolled
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
+        cols.append(jnp.sum(vol * w, axis=-1))
+    out_ref[0] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
+
+
+def _lookup_bwd_kernel(taps_ref, g_ref, dvol_ref):
+    """dvol[w1, j] = sum_k g[w1, k] * hat(j - taps[w1, k])."""
+    taps = taps_ref[0].astype(jnp.float32)        # (W1_t, K)
+    g = g_ref[0].astype(jnp.float32)              # (W1_t, K)
+    w2 = dvol_ref.shape[-1]
+    k = taps.shape[-1]
+    j = jax.lax.broadcasted_iota(jnp.float32, (1, w2), 1)
+    acc = jnp.zeros((taps.shape[0], w2), jnp.float32)
+    for ki in range(k):
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
+        acc = acc + g[:, ki][:, None] * w
+    dvol_ref[0] = acc.astype(dvol_ref.dtype)
+
+
+def _flatten(vol, taps):
+    b, h, w1, w2 = vol.shape
+    kk = taps.shape[-1]
+    return (vol.reshape(b * h, w1, w2), taps.reshape(b * h, w1, kk))
+
+
+def _pad_w1(x, block):
+    w1 = x.shape[1]
+    pad = (-w1) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x, w1
+
+
+def pallas_lookup(vol: jax.Array, taps: jax.Array) -> jax.Array:
+    """Forward-equivalent of :func:`linear_sample_1d` running as a Pallas TPU
+    kernel.  vol: (B, H, W1, W2); taps: (B, H, W1, K) -> (B, H, W1, K) f32.
+
+    Autodiff divergence from the oracle, by design: gradients w.r.t. ``taps``
+    are hard zeros (the model detaches disparity every iteration, and the
+    reference CUDA op likewise returns no coords grad: core/corr.py:29), and
+    forward-mode AD is unsupported (custom_vjp).  Use ``linear_sample_1d`` if
+    you need either.
+    """
+    return _make_lookup(vol.shape, vol.dtype.name)(vol, taps)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_lookup(vol_shape, vol_dtype_name):
+    """custom_vjp instance per static (shape, dtype) — residuals carry only
+    the taps; the volume's shape/dtype ride in the closure."""
+
+    @jax.custom_vjp
+    def f(vol, taps):
+        return _lookup_fwd_impl(vol, taps)
+
+    def fwd(vol, taps):
+        return _lookup_fwd_impl(vol, taps), taps
+
+    def bwd(taps, g):
+        dvol = _lookup_bwd_impl(taps, g, vol_shape, vol_dtype_name)
+        # No coordinate gradient by design (disparity is detached per
+        # iteration; the reference kernel likewise returns None:
+        # core/corr.py:29).
+        return dvol, jnp.zeros_like(taps)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _lookup_fwd_impl(vol, taps):
+    b, h, w1, w2 = vol.shape
+    kk = taps.shape[-1]
+    blk = _block_w1(w1)
+    v, t = _flatten(vol, taps)
+    v, _ = _pad_w1(v, blk)
+    t, _ = _pad_w1(t, blk)
+    n, w1p = v.shape[0], v.shape[1]
+    grid = (n, w1p // blk)
+    out = pl.pallas_call(
+        _lookup_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, w1p, kk), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk, w2), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(v, t)
+    return out[:, :w1].reshape(b, h, w1, kk)
+
+
+def _lookup_bwd_impl(taps, g, vol_shape, vol_dtype_name):
+    b, h, w1, w2 = vol_shape
+    kk = taps.shape[-1]
+    blk = _block_w1(w1)
+    t = taps.reshape(b * h, w1, kk)
+    gg = g.reshape(b * h, w1, kk)
+    t, _ = _pad_w1(t, blk)
+    gg, _ = _pad_w1(gg, blk)
+    n, w1p = t.shape[0], t.shape[1]
+    grid = (n, w1p // blk)
+    dvol = pl.pallas_call(
+        _lookup_bwd_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, w1p, w2), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, blk, w2), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(t, gg)
+    return dvol[:, :w1].reshape(b, h, w1, w2).astype(vol_dtype_name)

@@ -125,13 +125,14 @@ class TestConfigVariants:
         i1 = jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)).astype(np.float32))
         i2 = jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)).astype(np.float32))
         out = {}
-        for impl in ("reg", "alt"):
+        for impl in ("reg", "alt", "pallas"):
             cfg = RAFTStereoConfig(corr_implementation=impl)
             model = RAFTStereo(cfg)
             variables = model.init(jax.random.key(2))
             out[impl] = np.asarray(
                 model.forward(variables, i1, i2, iters=2, test_mode=True)[1])
         np.testing.assert_allclose(out["reg"], out["alt"], rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(out["reg"], out["pallas"], rtol=1e-4, atol=1e-4)
 
 
 class TestGradients:

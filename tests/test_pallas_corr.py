@@ -1,0 +1,86 @@
+"""Pallas lookup kernel vs the XLA oracle (interpret mode on CPU).
+
+The dense-mask formulation is the same math as the gather version, so
+equivalence must be tight (SURVEY.md §4.3: redundant implementations as
+oracles — here automated)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raftstereo_tpu.ops import linear_sample_1d, make_corr_fn, make_reg_corr_fn
+from raftstereo_tpu.ops.pallas_corr import pallas_lookup
+
+
+@pytest.fixture
+def case(rng):
+    vol = rng.standard_normal((2, 3, 40, 48)).astype(np.float32)
+    taps = rng.uniform(-4, 52, (2, 3, 40, 9)).astype(np.float32)
+    return jnp.asarray(vol), jnp.asarray(taps)
+
+
+def test_matches_gather_oracle(case):
+    vol, taps = case
+    got = pallas_lookup(vol, taps)
+    want = linear_sample_1d(vol, taps)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_volume(case):
+    vol, taps = case
+    got = pallas_lookup(vol.astype(jnp.bfloat16), taps)
+    want = linear_sample_1d(vol.astype(jnp.bfloat16).astype(jnp.float32), taps)
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_non_block_aligned_w1(rng):
+    """W1 not a multiple of the 256-row block: padding path."""
+    vol = jnp.asarray(rng.standard_normal((1, 2, 37, 25)).astype(np.float32))
+    taps = jnp.asarray(rng.uniform(-2, 27, (1, 2, 37, 5)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(pallas_lookup(vol, taps)),
+                               np.asarray(linear_sample_1d(vol, taps)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradient_matches_oracle(case):
+    vol, taps = case
+
+    def f_pallas(v):
+        return (pallas_lookup(v, taps) ** 2).sum()
+
+    def f_oracle(v):
+        return (linear_sample_1d(v, taps) ** 2).sum()
+
+    g_p = jax.grad(f_pallas)(vol)
+    g_o = jax.grad(f_oracle)(vol)
+    np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_o),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_no_taps_gradient(case):
+    """Coordinate gradients are zero by design (reference: core/corr.py:29)."""
+    vol, taps = case
+    g = jax.grad(lambda t: pallas_lookup(vol, t).sum())(taps)
+    np.testing.assert_array_equal(np.asarray(g), 0.0)
+
+
+def test_pallas_corr_backend_matches_reg(rng):
+    f1 = jnp.asarray(rng.standard_normal((2, 4, 32, 16)).astype(np.float32))
+    f2 = jnp.asarray(rng.standard_normal((2, 4, 32, 16)).astype(np.float32))
+    x = jnp.asarray(rng.uniform(0, 32, (2, 4, 32, 1)).astype(np.float32))
+    reg = make_corr_fn("reg", f1, f2, 4, 4)(x)
+    pal = make_corr_fn("pallas", f1, f2, 4, 4)(x)
+    np.testing.assert_allclose(np.asarray(reg), np.asarray(pal),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_under_jit(case):
+    vol, taps = case
+    got = jax.jit(pallas_lookup)(vol, taps)
+    want = linear_sample_1d(vol, taps)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
