@@ -1,0 +1,72 @@
+/* PNG scanline defilter — native hot path for the data loader.
+ *
+ * The reference pushes image decode through libpng via OpenCV
+ * (reference: core/utils/frame_utils.py:117-127); this framework's pure-python
+ * PNG codec (raftstereo_tpu/data/png16.py) defilters in Python, which is
+ * decode-bound for KITTI-sized 16-bit disparity maps.  This ~60-line C kernel
+ * runs the per-byte sequential filters (Sub/Up/Average/Paeth) at memory speed;
+ * Python keeps the zlib + header logic.
+ *
+ * Build: gcc -O3 -shared -fPIC pngfilter.c -o libpngfilter.so
+ * ABI: png_defilter(raw, out, h, stride, bpp) -> 0 ok, -1 bad filter byte.
+ *   raw: h*(stride+1) filtered bytes (each row led by its filter type)
+ *   out: h*stride defiltered bytes
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+static inline uint8_t paeth(int a, int b, int c) {
+    int p = a + b - c;
+    int pa = abs(p - a), pb = abs(p - b), pc = abs(p - c);
+    if (pa <= pb && pa <= pc) return (uint8_t)a;
+    if (pb <= pc) return (uint8_t)b;
+    return (uint8_t)c;
+}
+
+int png_defilter(const uint8_t *raw, uint8_t *out,
+                 int64_t h, int64_t stride, int64_t bpp) {
+    const uint8_t *prev = NULL;
+    for (int64_t y = 0; y < h; ++y) {
+        const uint8_t *src = raw + y * (stride + 1);
+        uint8_t *dst = out + y * stride;
+        uint8_t ftype = src[0];
+        ++src;
+        switch (ftype) {
+        case 0:
+            memcpy(dst, src, (size_t)stride);
+            break;
+        case 1: /* Sub */
+            for (int64_t x = 0; x < stride; ++x)
+                dst[x] = (uint8_t)(src[x] + (x >= bpp ? dst[x - bpp] : 0));
+            break;
+        case 2: /* Up */
+            if (prev)
+                for (int64_t x = 0; x < stride; ++x)
+                    dst[x] = (uint8_t)(src[x] + prev[x]);
+            else
+                memcpy(dst, src, (size_t)stride);
+            break;
+        case 3: /* Average */
+            for (int64_t x = 0; x < stride; ++x) {
+                int a = x >= bpp ? dst[x - bpp] : 0;
+                int b = prev ? prev[x] : 0;
+                dst[x] = (uint8_t)(src[x] + ((a + b) >> 1));
+            }
+            break;
+        case 4: /* Paeth */
+            for (int64_t x = 0; x < stride; ++x) {
+                int a = x >= bpp ? dst[x - bpp] : 0;
+                int b = prev ? prev[x] : 0;
+                int c = (prev && x >= bpp) ? prev[x - bpp] : 0;
+                dst[x] = (uint8_t)(src[x] + paeth(a, b, c));
+            }
+            break;
+        default:
+            return -1;
+        }
+        prev = dst;
+    }
+    return 0;
+}

@@ -1,0 +1,19 @@
+"""Data layer: codecs, augmentation, datasets, loader, structured light."""
+
+from . import codecs
+from .augment import ColorJitter, FlowAugmentor, SparseFlowAugmentor, resize_bilinear
+from .datasets import (ETH3D, KITTI, ConcatDataset, FallingThings, Middlebury,
+                       SceneFlowDatasets, SintelStereo, StereoDataset,
+                       TartanAir, build_aug_params, fetch_dataset)
+from .loader import DataLoader, prefetch_to_device
+from .png16 import read_png16, write_png16
+from .sl import SLCalibration, StructuredLightDataset, fetch_sl_dataset, modulation
+
+__all__ = [
+    "codecs", "ColorJitter", "FlowAugmentor", "SparseFlowAugmentor",
+    "resize_bilinear", "ETH3D", "KITTI", "ConcatDataset", "FallingThings",
+    "Middlebury", "SceneFlowDatasets", "SintelStereo", "StereoDataset",
+    "TartanAir", "build_aug_params", "fetch_dataset", "DataLoader",
+    "prefetch_to_device", "read_png16", "write_png16", "SLCalibration",
+    "StructuredLightDataset", "fetch_sl_dataset", "modulation",
+]

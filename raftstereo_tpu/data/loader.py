@@ -1,0 +1,164 @@
+"""Multiprocess prefetching data loader feeding the TPU.
+
+Replaces the reference's torch DataLoader usage
+(reference: core/stereo_datasets.py:311-312): shuffling, fixed-size batches
+with drop_last, N worker processes with per-worker seeding, and bounded
+prefetch.  Batches are stacked NHWC numpy arrays ready for ``jax.device_put``;
+``prefetch_to_device`` overlaps the host->HBM copy with compute.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from concurrent.futures import ProcessPoolExecutor
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+Batch = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+_WORKER_DATASET = None
+
+
+def _init_worker(dataset, seed, counter):
+    global _WORKER_DATASET
+    with counter.get_lock():
+        worker_id = counter.value
+        counter.value += 1
+    dataset.reseed(seed + worker_id)
+    _WORKER_DATASET = dataset
+
+
+def _load_indices(indices):
+    out = []
+    for i in indices:
+        meta, img1, img2, flow, valid = _WORKER_DATASET[i]
+        out.append((img1, img2, flow, valid))
+    return out
+
+
+def default_num_workers() -> int:
+    """SLURM-aware default (reference: core/stereo_datasets.py:312)."""
+    return max(int(os.environ.get("SLURM_CPUS_PER_TASK", 6)) - 2, 0)
+
+
+class DataLoader:
+    """Iterable over stacked (img1, img2, flow, valid) batches.
+
+    num_workers=0 loads inline (deterministic, used by tests); otherwise a
+    process pool decodes and augments ahead of the training step.
+    """
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True,
+                 drop_last: bool = True, num_workers: Optional[int] = None,
+                 seed: int = 0, prefetch_batches: int = 4):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.num_workers = (default_num_workers() if num_workers is None
+                            else num_workers)
+        self.seed = seed
+        self.prefetch_batches = max(prefetch_batches, 1)
+        self.epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _batches(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + self.epoch).permutation(n)
+        end = n - n % self.batch_size if self.drop_last else n
+        for i in range(0, end, self.batch_size):
+            yield order[i:i + self.batch_size].tolist()
+
+    @staticmethod
+    def _collate(samples) -> Batch:
+        img1, img2, flow, valid = (np.stack(x) for x in zip(*samples))
+        return img1, img2, flow, valid
+
+    def __iter__(self) -> Iterator[Batch]:
+        self.epoch += 1
+        if self.num_workers == 0:
+            self.dataset.reseed(self.seed + self.epoch)
+            for idxs in self._batches():
+                yield self._collate([self.dataset[i][1:] for i in idxs])
+            return
+
+        import contextlib
+        import multiprocessing as mp
+
+        # Spawn, not fork: the parent process has JAX's thread pool running
+        # and fork()ing a multithreaded process can deadlock workers.
+        # Workers are pure numpy/PIL — scrub accelerator env vars while
+        # spawning so site hooks don't initialise a TPU client per worker.
+        ctx = mp.get_context("spawn")
+        counter = ctx.Value("i", 0)
+
+        @contextlib.contextmanager
+        def scrubbed_env():
+            saved = {}
+            for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS"):
+                saved[k] = os.environ.pop(k, None)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                yield
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+        with scrubbed_env(), ProcessPoolExecutor(
+                max_workers=self.num_workers, mp_context=ctx,
+                initializer=_init_worker,
+                initargs=(self.dataset, self.seed + 1000 * self.epoch,
+                          counter)) as pool:
+            pending = collections.deque()
+            batches = self._batches()
+            try:
+                for _ in range(self.num_workers * self.prefetch_batches):
+                    pending.append(pool.submit(_load_indices, next(batches)))
+            except StopIteration:
+                batches = iter(())
+            while pending:
+                done = pending.popleft()
+                try:
+                    pending.append(pool.submit(_load_indices, next(batches)))
+                except StopIteration:
+                    pass
+                yield self._collate(done.result())
+
+
+def prefetch_to_device(iterator, size: int = 2, devices=None):
+    """Move batches to device ahead of use (host->HBM overlap).
+
+    The TPU analogue of pin_memory+non_blocking copies in the reference's
+    loader; with a sharding it also shards the batch across the mesh.
+    """
+    import itertools
+
+    import jax
+
+    queue = collections.deque()
+
+    def put(batch):
+        if devices is None:
+            return jax.tree.map(jax.device_put, batch)
+        return jax.tree.map(lambda x: jax.device_put(x, devices), batch)
+
+    it = iter(iterator)
+    for batch in itertools.islice(it, size):
+        queue.append(put(batch))
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

@@ -1,0 +1,178 @@
+"""Structured-light (SL) dataset pipeline — the fork's WIP feature, working.
+
+The reference fork ships a half-finished SL pipeline: its dataset always has
+length 0 (``__len__`` reads a never-populated list, reference:
+core/sl_datasets.py:199-200 vs :209) and its trainer imports a module that
+does not exist (reference: train_stereo.py:18).  This is the same capability
+in working form.
+
+Scene layout (reference: core/sl_datasets.py:104-154,
+utils/dataset_original.py:104-180):
+
+    root/<scene>/ambient_light/<pose>_L.png, <pose>_R.png
+    root/<scene>/pattern_<k>/<pose>_B_l.png, <pose>_B_r.png      k = 0..8
+    root/<scene>/three_phase/<pose>_tp{1,2,3}_l.png, _tp{1,2,3}_r.png
+    root/<scene>/depth/<pose>_depth_L.npy, _depth_R.npy          (optional)
+
+Per sample: ambient left/right images; an 18-channel pattern mask stack
+(9 right + 9 left) gated by a phase-modulation uncertainty mask; and, when
+depth is present, disparity targets via disp = focal * baseline / depth
+(configurable — the reference hardcodes focal 911.70 / baseline 5.563,
+utils/dataset_original.py:159-161).
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+import os.path as osp
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+from PIL import Image
+
+from .augment import resize_bilinear
+
+
+def modulation(i1: np.ndarray, i2: np.ndarray, i3: np.ndarray) -> np.ndarray:
+    """Three-phase modulation amplitude (reference: core/sl_datasets.py:123-126):
+    M = (2*sqrt(2)/3) * sqrt((I1-I2)^2 + (I1-I3)^2 + (I2-I3)^2)."""
+    d12 = i1.astype(np.float32) - i2.astype(np.float32)
+    d13 = i1.astype(np.float32) - i3.astype(np.float32)
+    d23 = i2.astype(np.float32) - i3.astype(np.float32)
+    return (2.0 * np.sqrt(2.0) / 3.0) * np.sqrt(d12 ** 2 + d13 ** 2 + d23 ** 2)
+
+
+@dataclass(frozen=True)
+class SLCalibration:
+    """Stereo rig calibration for depth->disparity conversion."""
+    focal: float = 911.7019228756361
+    baseline: float = 5.563167785169519
+
+
+class StructuredLightDataset:
+    """Map-style SL dataset returning
+    (imgL, imgR, mask18[, disparity, depth_mask]).
+
+    * ``split='training'`` gates pattern masks by a randomised modulation
+      threshold |10 + 9*N(0,1)| per sample; ``'validation'`` uses the fixed
+      threshold 5 (reference: core/sl_datasets.py:135-141).
+    * Images/masks are optionally downscaled by ``scale``.
+    * When ``with_depth``, returns normalised signed disparities
+      (left->right positive, right->left negative, both /W) and validity
+      masks, mirroring utils/dataset_original.py:159-180.
+    """
+
+    def __init__(self, root: str, split: str = "training", scale: float = 0.5,
+                 num_patterns: int = 9, with_depth: bool = False,
+                 calibration: SLCalibration = SLCalibration(),
+                 file_list: Optional[str] = None):
+        assert split in ("training", "validation"), split
+        self.root = root
+        self.split = split
+        self.scale = scale
+        self.num_patterns = num_patterns
+        self.with_depth = with_depth
+        self.calib = calibration
+        self.rng = np.random.default_rng(0)
+
+        if file_list is not None:
+            with open(file_list, "r") as f:
+                entries = [ln.strip() for ln in f if ln.strip()]
+            self.samples = [self._parse_entry(e) for e in entries]
+        else:
+            ambients = sorted(globlib.glob(
+                osp.join(root, "*", "ambient_light", "*_L.png")))
+            self.samples = [
+                (osp.basename(osp.dirname(osp.dirname(p))),
+                 osp.basename(p)[:-len("_L.png")])
+                for p in ambients]
+
+    def _parse_entry(self, entry: str) -> Tuple[str, str]:
+        """File-list entries are paths like <...>/<scene>/<anything>/<pose>_R.png
+        (the fork's SL/img_r_list_full.txt format, core/sl_datasets.py:165-167)."""
+        parts = entry.split("/")
+        return parts[-3], parts[-1][:-len("_R.png")]
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def _scene_path(self, scene: str, sub: str, name: str) -> str:
+        return osp.join(self.root, scene, sub, name)
+
+    def _load(self, scene, sub, name, gray=False) -> np.ndarray:
+        img = Image.open(self._scene_path(scene, sub, name))
+        if gray:
+            img = img.convert("L")
+        arr = np.asarray(img)
+        if self.scale != 1.0:
+            arr = resize_bilinear(arr, self.scale, self.scale)
+        return arr
+
+    def __getitem__(self, index: int):
+        scene, pose = self.samples[index]
+
+        img_l = self._load(scene, "ambient_light", f"{pose}_L.png")
+        img_r = self._load(scene, "ambient_light", f"{pose}_R.png")
+        if img_l.ndim == 2:
+            img_l = np.tile(img_l[..., None], (1, 1, 3))
+            img_r = np.tile(img_r[..., None], (1, 1, 3))
+
+        # Phase-modulation uncertainty gates (full resolution, pre-scaling
+        # in the reference; we compute at native res then scale the gated
+        # masks like the reference does).
+        def load_tp(side):
+            return [np.asarray(Image.open(self._scene_path(
+                scene, "three_phase", f"{pose}_tp{i}_{side}.png")).convert("L"),
+                np.float32) for i in (1, 2, 3)]
+
+        mod_l = modulation(*load_tp("l"))
+        mod_r = modulation(*load_tp("r"))
+        if self.split == "training":
+            threshold = abs(10.0 + 9.0 * self.rng.standard_normal())
+        else:
+            threshold = 5.0
+        gate_l = (mod_l > threshold).astype(np.float32)
+        gate_r = (mod_r > threshold).astype(np.float32)
+
+        masks = []
+        for side, gate in (("r", gate_r), ("l", gate_l)):
+            for k in range(self.num_patterns):
+                pat = np.asarray(Image.open(self._scene_path(
+                    scene, f"pattern_{k}", f"{pose}_B_{side}.png")).convert("L"),
+                    np.float32)
+                gated = pat * gate
+                if self.scale != 1.0:
+                    gated = resize_bilinear(gated, self.scale, self.scale)
+                masks.append(np.round(gated / 255.0))
+        mask = np.stack(masks, axis=-1).astype(np.float32)   # (H, W, 18)
+
+        out = (img_l.astype(np.float32), img_r.astype(np.float32), mask)
+        if not self.with_depth:
+            return out
+
+        depth_l = np.load(self._scene_path(scene, "depth", f"{pose}_depth_L.npy"))
+        depth_r = np.load(self._scene_path(scene, "depth", f"{pose}_depth_R.npy"))
+        if self.scale != 1.0:
+            depth_l = resize_bilinear(depth_l, self.scale, self.scale)
+            depth_r = resize_bilinear(depth_r, self.scale, self.scale)
+        w = depth_l.shape[1]
+        num = self.calib.focal * self.calib.baseline
+        disp_l2r = np.clip(num / (depth_l + 1e-9), 0.0, w) / w
+        disp_r2l = -np.clip(num / (depth_r + 1e-9), 0.0, w) / w
+        disparity = np.stack([disp_r2l, disp_l2r], axis=-1).astype(np.float32)
+        depth_mask = np.stack([(depth_r > 1e-9), (depth_l > 1e-9)],
+                              axis=-1).astype(np.float32)
+        return out + (disparity, depth_mask)
+
+
+def fetch_sl_dataset(root: str, **kwargs) -> StructuredLightDataset:
+    """Working equivalent of the fork's ``sl_datasets.fetch_dataloader``
+    (reference: core/sl_datasets.py:214-234, broken as shipped)."""
+    ds = StructuredLightDataset(root, **kwargs)
+    assert len(ds) > 0, f"no SL samples under {root}"
+    return ds

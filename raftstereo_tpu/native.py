@@ -1,0 +1,56 @@
+"""Loader for the framework's native (C) components.
+
+Native sources live in ``native/`` at the repo root; shared objects are built
+on first use into ``native/build/`` with the system compiler and loaded via
+ctypes (this image has no pybind11; ctypes keeps the binding dependency-free).
+Every native component has a pure-python fallback, so the framework works —
+slower — without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC_DIR = os.path.join(_ROOT, "native")
+_BUILD_DIR = os.path.join(_SRC_DIR, "build")
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _build(name: str) -> Optional[str]:
+    src = os.path.join(_SRC_DIR, f"{name}.c")
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    for cc in ("cc", "gcc", "g++"):
+        try:
+            subprocess.run([cc, "-O3", "-shared", "-fPIC", src, "-o", out],
+                           check=True, capture_output=True, timeout=120)
+            return out
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return None
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load native/<name>.c; None if unavailable."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        lib = None
+        try:
+            path = _build(name)
+            if path is not None:
+                lib = ctypes.CDLL(path)
+        except OSError:
+            lib = None
+        _CACHE[name] = lib
+        return lib

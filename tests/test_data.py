@@ -1,0 +1,356 @@
+"""Data-layer tests: codecs round-trip, augmentor semantics, dataset protocol,
+loader batching — all on synthetic fixture trees (no real datasets needed)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from raftstereo_tpu.data import (DataLoader, FlowAugmentor, KITTI,
+                                 SparseFlowAugmentor, StereoDataset,
+                                 StructuredLightDataset, codecs,
+                                 fetch_sl_dataset, read_png16, resize_bilinear,
+                                 write_png16)
+
+
+# ------------------------------------------------------------------ codecs
+
+class TestPng16:
+    def test_gray_roundtrip(self, tmp_path, rng):
+        arr = rng.integers(0, 65535, (37, 53), dtype=np.uint16)
+        p = str(tmp_path / "g.png")
+        write_png16(p, arr)
+        np.testing.assert_array_equal(read_png16(p), arr)
+
+    def test_rgb_roundtrip(self, tmp_path, rng):
+        arr = rng.integers(0, 65535, (21, 33, 3), dtype=np.uint16)
+        p = str(tmp_path / "c.png")
+        write_png16(p, arr)
+        np.testing.assert_array_equal(read_png16(p), arr)
+
+    def test_reads_pil_written_8bit(self, tmp_path, rng):
+        arr = rng.integers(0, 255, (15, 20, 3), dtype=np.uint8)
+        p = str(tmp_path / "8.png")
+        Image.fromarray(arr).save(p)
+        np.testing.assert_array_equal(read_png16(p), arr)
+
+    def test_reads_pil_written_16bit_gray(self, tmp_path, rng):
+        arr = rng.integers(0, 65535, (15, 20), dtype=np.uint16)
+        p = str(tmp_path / "16g.png")
+        Image.fromarray(arr.astype(np.int32), mode="I").save(p)
+        got = read_png16(p)
+        np.testing.assert_array_equal(got, arr)
+
+    def test_native_and_python_defilter_agree(self, tmp_path, rng):
+        """PIL picks real scanline filters (Sub/Up/Paeth) on natural-ish
+        images; both defilter paths must decode identically."""
+        from raftstereo_tpu import native
+        from raftstereo_tpu.data import png16
+        base = np.cumsum(rng.integers(0, 7, (40, 60, 3)), axis=1)
+        arr = (base % 256).astype(np.uint8)
+        p = str(tmp_path / "nat.png")
+        Image.fromarray(arr).save(p, optimize=True)
+        native_lib = native.load("pngfilter")
+        got_native = read_png16(p) if native_lib is not None else None
+        # Force the python fallback
+        with native._LOCK:
+            saved = native._CACHE.get("pngfilter")
+            native._CACHE["pngfilter"] = None
+        try:
+            got_py = read_png16(p)
+        finally:
+            with native._LOCK:
+                native._CACHE["pngfilter"] = saved
+        np.testing.assert_array_equal(got_py, arr)
+        if got_native is not None:
+            np.testing.assert_array_equal(got_native, arr)
+
+
+class TestCodecs:
+    def test_flo_roundtrip(self, tmp_path, rng):
+        flow = rng.standard_normal((11, 17, 2)).astype(np.float32)
+        p = str(tmp_path / "a.flo")
+        codecs.write_flow(p, flow)
+        np.testing.assert_array_equal(codecs.read_flow(p), flow)
+
+    def test_pfm_roundtrip(self, tmp_path, rng):
+        for shape in ((9, 13), (9, 13, 3)):
+            disp = rng.standard_normal(shape).astype(np.float32)
+            p = str(tmp_path / "a.pfm")
+            codecs.write_pfm(p, disp)
+            np.testing.assert_array_equal(codecs.read_pfm(p), disp)
+
+    def test_kitti_disp_roundtrip(self, tmp_path, rng):
+        disp = (rng.uniform(0, 192, (14, 19)) * 256).astype(np.uint16).astype(
+            np.float32) / 256
+        disp[0, 0] = 0.0
+        p = str(tmp_path / "d.png")
+        codecs.write_disp_kitti(p, disp)
+        got, valid = codecs.read_disp_kitti(p)
+        np.testing.assert_allclose(got, disp, atol=1 / 256)
+        assert not valid[0, 0] and valid[5, 5]
+
+    def test_kitti_flow_roundtrip(self, tmp_path, rng):
+        flow = rng.uniform(-100, 100, (10, 12, 2)).astype(np.float32)
+        flow = np.round(flow * 64) / 64
+        p = str(tmp_path / "f.png")
+        codecs.write_flow_kitti(p, flow)
+        got, valid = codecs.read_flow_kitti(p)
+        np.testing.assert_allclose(got, flow, atol=1 / 64)
+        assert (valid == 1).all()
+
+    def test_sintel_disp(self, tmp_path):
+        os.makedirs(tmp_path / "disparities" / "s")
+        os.makedirs(tmp_path / "occlusions" / "s")
+        disp = np.zeros((6, 8, 3), np.uint8)
+        disp[..., 0] = 10          # -> 40 px disparity
+        Image.fromarray(disp).save(tmp_path / "disparities" / "s" / "f.png")
+        occ = np.zeros((6, 8), np.uint8)
+        occ[0, 0] = 255
+        Image.fromarray(occ).save(tmp_path / "occlusions" / "s" / "f.png")
+        d, valid = codecs.read_disp_sintel(str(tmp_path / "disparities" / "s" / "f.png"))
+        assert d[3, 3] == 40.0
+        assert not valid[0, 0] and valid[3, 3]
+
+    def test_fallingthings_disp(self, tmp_path):
+        depth = np.full((5, 7), 3000, np.int32)
+        Image.fromarray(depth, mode="I").save(tmp_path / "left.depth.png")
+        with open(tmp_path / "_camera_settings.json", "w") as f:
+            json.dump({"camera_settings":
+                       [{"intrinsic_settings": {"fx": 768.0}}]}, f)
+        d, valid = codecs.read_disp_fallingthings(str(tmp_path / "left.depth.png"))
+        np.testing.assert_allclose(d, 768.0 * 600 / 3000)
+
+    def test_tartanair_disp(self, tmp_path):
+        depth = np.full((4, 6), 20.0, np.float32)
+        np.save(tmp_path / "d.npy", depth)
+        d, valid = codecs.read_disp_tartanair(str(tmp_path / "d.npy"))
+        np.testing.assert_allclose(d, 4.0)
+
+    def test_middlebury_disp(self, tmp_path, rng):
+        disp = rng.uniform(1, 60, (8, 10)).astype(np.float32)
+        codecs.write_pfm(str(tmp_path / "disp0GT.pfm"), disp)
+        mask = np.full((8, 10), 255, np.uint8)
+        mask[0] = 128
+        Image.fromarray(mask).save(tmp_path / "mask0nocc.png")
+        d, nocc = codecs.read_disp_middlebury(str(tmp_path / "disp0GT.pfm"))
+        np.testing.assert_allclose(d, disp, rtol=1e-6)
+        assert not nocc[0].any() and nocc[1:].all()
+
+
+# ------------------------------------------------------------------ augment
+
+class TestAugment:
+    def test_color_jitter_factors_bound_per_op(self):
+        """Regression: late-binding closure bug made every op use the hue
+        factor (~0), blacking out images."""
+        from raftstereo_tpu.data import ColorJitter
+        jit = ColorJitter(brightness=0.4, contrast=0.4,
+                          saturation=(0.6, 1.4), hue=0.5 / 3.14)
+        img = np.full((16, 16, 3), 128, np.uint8)
+        means = [jit(img, np.random.default_rng(s)).mean() for s in range(8)]
+        assert all(m > 40 for m in means), means
+
+    def test_resize_uint16_preserves_range(self, rng):
+        arr = np.full((10, 10), 30000, np.uint16)
+        out = resize_bilinear(arr, 0.5, 0.5)
+        assert out.dtype == np.uint16 and (out == 30000).all()
+
+    def test_resize_matches_scale(self, rng):
+        img = rng.integers(0, 255, (40, 60, 3), dtype=np.uint8)
+        out = resize_bilinear(img, 0.5, 2.0)
+        assert out.shape == (80, 30, 3)
+
+    def test_dense_augmentor_output_shapes(self, rng):
+        aug = FlowAugmentor(crop_size=(64, 96), min_scale=-0.2, max_scale=0.4,
+                            do_flip="h", yjitter=True)
+        img1 = rng.integers(0, 255, (128, 180, 3), dtype=np.uint8)
+        img2 = rng.integers(0, 255, (128, 180, 3), dtype=np.uint8)
+        flow = rng.standard_normal((128, 180, 2)).astype(np.float32)
+        g = np.random.default_rng(0)
+        for _ in range(5):
+            a, b, f = aug(img1, img2, flow, g)
+            assert a.shape == (64, 96, 3) and b.shape == (64, 96, 3)
+            assert f.shape == (64, 96, 2)
+
+    def test_dense_flow_rescaled_with_image(self):
+        """Scaling the image by s must scale flow values by s."""
+        aug = FlowAugmentor(crop_size=(32, 32), min_scale=1.0, max_scale=1.0,
+                            do_flip=False, yjitter=False)
+        aug.stretch_prob = 0.0
+        img = np.full((64, 64, 3), 128, np.uint8)
+        flow = np.full((64, 64, 2), 10.0, np.float32)
+        flow[..., 1] = 0
+        g = np.random.default_rng(1)
+        _, _, f = aug(img, img, flow, g)
+        np.testing.assert_allclose(f[..., 0], 20.0, rtol=1e-5)
+
+    def test_sparse_augmentor_shapes_and_validity(self, rng):
+        aug = SparseFlowAugmentor(crop_size=(48, 64))
+        img1 = rng.integers(0, 255, (100, 140, 3), dtype=np.uint8)
+        img2 = rng.integers(0, 255, (100, 140, 3), dtype=np.uint8)
+        flow = rng.standard_normal((100, 140, 2)).astype(np.float32)
+        valid = (rng.random((100, 140)) > 0.5).astype(np.float32)
+        g = np.random.default_rng(2)
+        a, b, f, v = aug(img1, img2, flow, valid, g)
+        assert a.shape == (48, 64, 3) and f.shape == (48, 64, 2)
+        assert v.shape == (48, 64)
+        assert set(np.unique(v)).issubset({0, 1})
+
+    def test_sparse_scatter_rescale_preserves_values(self):
+        flow = np.zeros((10, 10, 2), np.float32)
+        flow[5, 5] = [8.0, 0.0]
+        valid = np.zeros((10, 10), np.float32)
+        valid[5, 5] = 1
+        f2, v2 = SparseFlowAugmentor.resize_sparse_flow_map(flow, valid, 2.0, 2.0)
+        assert f2.shape == (20, 20, 2)
+        assert v2.sum() == 1
+        yy, xx = np.argwhere(v2 == 1)[0]
+        np.testing.assert_allclose(f2[yy, xx], [16.0, 0.0])
+
+
+# ------------------------------------------------------------------ dataset
+
+def make_synthetic_kitti(root, n=6, rng=None):
+    rng = rng or np.random.default_rng(0)
+    os.makedirs(root / "training" / "image_2")
+    os.makedirs(root / "training" / "image_3")
+    os.makedirs(root / "training" / "disp_occ_0")
+    for i in range(n):
+        for cam in ("image_2", "image_3"):
+            img = rng.integers(0, 255, (120, 160, 3), dtype=np.uint8)
+            Image.fromarray(img).save(root / "training" / cam / f"{i:06d}_10.png")
+        disp = (rng.uniform(1, 60, (120, 160)) * 256).astype(np.uint16)
+        write_png16(str(root / "training" / "disp_occ_0" / f"{i:06d}_10.png"), disp)
+
+
+class TestDatasets:
+    def test_kitti_protocol(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        ds = KITTI(aug_params={"crop_size": (64, 96)}, root=str(tmp_path))
+        assert len(ds) == 6
+        meta, img1, img2, flow, valid = ds[0]
+        assert img1.shape == (64, 96, 3) and img1.dtype == np.float32
+        assert flow.shape == (64, 96, 1)
+        assert valid.shape == (64, 96)
+        # stereo convention: flow = -disparity <= 0 where valid
+        assert (flow[valid > 0.5] <= 0).all()
+
+    def test_mul_replication(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        ds = KITTI(aug_params=None, root=str(tmp_path))
+        assert len(ds * 3) == 18
+
+    def test_concat(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        a = KITTI(aug_params=None, root=str(tmp_path))
+        c = a + a * 2
+        assert len(c) == 18
+        _ = c[17]
+
+    def test_no_augmentor_returns_full_frames(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        ds = KITTI(aug_params=None, root=str(tmp_path))
+        meta, img1, img2, flow, valid = ds[1]
+        assert img1.shape == (120, 160, 3)
+
+    def test_is_test_mode(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        ds = KITTI(aug_params=None, root=str(tmp_path))
+        ds.is_test = True
+        ds.extra_info = [[str(i)] for i in range(len(ds))]
+        img1, img2, info = ds[2]
+        assert img1.shape == (120, 160, 3)
+
+
+class TestLoader:
+    def test_inline_loader_batches(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        ds = KITTI(aug_params={"crop_size": (32, 48)}, root=str(tmp_path))
+        loader = DataLoader(ds, batch_size=2, num_workers=0, seed=3)
+        batches = list(loader)
+        assert len(batches) == 3
+        img1, img2, flow, valid = batches[0]
+        assert img1.shape == (2, 32, 48, 3)
+        assert flow.shape == (2, 32, 48, 1)
+
+    def test_multiprocess_loader(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        ds = KITTI(aug_params={"crop_size": (32, 48)}, root=str(tmp_path))
+        loader = DataLoader(ds, batch_size=2, num_workers=2, seed=3)
+        batches = list(loader)
+        assert len(batches) == 3
+        assert batches[0][0].shape == (2, 32, 48, 3)
+
+    def test_drop_last_and_shuffle_determinism(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        ds = KITTI(aug_params=None, root=str(tmp_path))
+        loader = DataLoader(ds, batch_size=4, num_workers=0, seed=5)
+        assert len(loader) == 1
+
+
+# ------------------------------------------------------------------ SL
+
+def make_synthetic_sl(root, scenes=("sceneA",), poses=("0001",), hw=(32, 40),
+                      rng=None):
+    rng = rng or np.random.default_rng(0)
+    h, w = hw
+    for scene in scenes:
+        amb = root / scene / "ambient_light"
+        os.makedirs(amb)
+        for pose in poses:
+            for side in ("L", "R"):
+                img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+                Image.fromarray(img).save(amb / f"{pose}_{side}.png")
+            tp = root / scene / "three_phase"
+            os.makedirs(tp, exist_ok=True)
+            base = rng.integers(60, 190, (h, w), dtype=np.uint8)
+            for i, phase in enumerate((0, 40, 80)):
+                for side in ("l", "r"):
+                    Image.fromarray((base + phase) % 255).save(
+                        tp / f"{pose}_tp{i+1}_{side}.png")
+            for k in range(9):
+                pd = root / scene / f"pattern_{k}"
+                os.makedirs(pd, exist_ok=True)
+                for side in ("l", "r"):
+                    pat = (rng.random((h, w)) > 0.5).astype(np.uint8) * 255
+                    Image.fromarray(pat).save(pd / f"{pose}_B_{side}.png")
+            dp = root / scene / "depth"
+            os.makedirs(dp, exist_ok=True)
+            for side in ("L", "R"):
+                np.save(dp / f"{pose}_depth_{side}.npy",
+                        rng.uniform(50, 200, (h, w)).astype(np.float32))
+
+
+class TestStructuredLight:
+    def test_discovery_and_shapes(self, tmp_path, rng):
+        make_synthetic_sl(tmp_path, rng=rng)
+        ds = fetch_sl_dataset(str(tmp_path), scale=0.5)
+        assert len(ds) == 1
+        img_l, img_r, mask = ds[0]
+        assert img_l.shape == (16, 20, 3)
+        assert mask.shape == (16, 20, 18)
+        assert set(np.unique(mask)).issubset({0.0, 1.0})
+
+    def test_with_depth_targets(self, tmp_path, rng):
+        make_synthetic_sl(tmp_path, rng=rng)
+        ds = StructuredLightDataset(str(tmp_path), scale=1.0, with_depth=True)
+        img_l, img_r, mask, disparity, depth_mask = ds[0]
+        assert disparity.shape == (32, 40, 2)
+        assert (disparity[..., 1] >= 0).all()      # left->right positive
+        assert (disparity[..., 0] <= 0).all()      # right->left negative
+        assert depth_mask.shape == (32, 40, 2)
+
+    def test_validation_threshold_deterministic(self, tmp_path, rng):
+        make_synthetic_sl(tmp_path, rng=rng)
+        ds = StructuredLightDataset(str(tmp_path), split="validation")
+        a = ds[0][2]
+        b = ds[0][2]
+        np.testing.assert_array_equal(a, b)
+
+    def test_nonempty_guard(self, tmp_path):
+        os.makedirs(tmp_path / "empty_root")
+        with pytest.raises(AssertionError):
+            fetch_sl_dataset(str(tmp_path / "empty_root"))
