@@ -7,7 +7,8 @@ from .datasets import (ETH3D, KITTI, ConcatDataset, FallingThings, Middlebury,
                        TartanAir, build_aug_params, fetch_dataset)
 from .loader import DataLoader, prefetch_to_device
 from .png16 import read_png16, write_png16
-from .sl import SLCalibration, StructuredLightDataset, fetch_sl_dataset, modulation
+from .sl import (SLCalibration, SLStereoView, StructuredLightDataset,
+                 fetch_sl_dataset, modulation)
 
 __all__ = [
     "codecs", "ColorJitter", "FlowAugmentor", "SparseFlowAugmentor",
@@ -15,5 +16,5 @@ __all__ = [
     "Middlebury", "SceneFlowDatasets", "SintelStereo", "StereoDataset",
     "TartanAir", "build_aug_params", "fetch_dataset", "DataLoader",
     "prefetch_to_device", "read_png16", "write_png16", "SLCalibration",
-    "StructuredLightDataset", "fetch_sl_dataset", "modulation",
+    "StructuredLightDataset", "SLStereoView", "fetch_sl_dataset", "modulation",
 ]

@@ -157,12 +157,12 @@ class FlowAugmentor:
         ht, wd = img1.shape[:2]
         if rng.random() < self.eraser_aug_prob:
             mean_color = img2.reshape(-1, 3).mean(axis=0)
+            img2 = img2.copy()
             for _ in range(rng.integers(1, 3)):
                 x0 = rng.integers(0, wd)
                 y0 = rng.integers(0, ht)
                 dx = rng.integers(bounds[0], bounds[1])
                 dy = rng.integers(bounds[0], bounds[1])
-                img2 = img2.copy()
                 img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
         return img1, img2
 
@@ -250,12 +250,12 @@ class SparseFlowAugmentor:
         ht, wd = img1.shape[:2]
         if rng.random() < self.eraser_aug_prob:
             mean_color = img2.reshape(-1, 3).mean(axis=0)
+            img2 = img2.copy()
             for _ in range(rng.integers(1, 3)):
                 x0 = rng.integers(0, wd)
                 y0 = rng.integers(0, ht)
                 dx = rng.integers(50, 100)
                 dy = rng.integers(50, 100)
-                img2 = img2.copy()
                 img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
         return img1, img2
 
@@ -295,6 +295,11 @@ class SparseFlowAugmentor:
             flow, valid = self.resize_sparse_flow_map(flow, valid, scale_x, scale_y)
 
         if self.do_flip:
+            if rng.random() < self.h_flip_prob and self.do_flip == "hf":
+                img1 = img1[:, ::-1]
+                img2 = img2[:, ::-1]
+                flow = flow[:, ::-1] * [-1.0, 1.0]
+                valid = valid[:, ::-1]
             if rng.random() < self.h_flip_prob and self.do_flip == "h":
                 img1, img2 = img2[:, ::-1], img1[:, ::-1]
             if rng.random() < self.v_flip_prob and self.do_flip == "v":

@@ -89,50 +89,59 @@ class DataLoader:
                 yield self._collate([self.dataset[i][1:] for i in idxs])
             return
 
-        import contextlib
         import multiprocessing as mp
 
         # Spawn, not fork: the parent process has JAX's thread pool running
         # and fork()ing a multithreaded process can deadlock workers.
-        # Workers are pure numpy/PIL — scrub accelerator env vars while
-        # spawning so site hooks don't initialise a TPU client per worker.
+        # Workers are pure numpy/PIL — scrub accelerator env vars while the
+        # workers spawn so site hooks don't initialise a TPU client per
+        # worker.  Spawned children inherit os.environ at interpreter
+        # startup, so the scrub must be parent-side and cover every spawn;
+        # all workers are created during the initial prefetch burst (each
+        # submit spawns one worker up to max_workers, and the burst submits
+        # num_workers*prefetch_batches tasks — or exhausts the epoch, after
+        # which no further submits happen).  The env is restored BEFORE the
+        # first yield so consumer code (e.g. jax.device_put in
+        # prefetch_to_device) never sees the scrubbed values.
         ctx = mp.get_context("spawn")
         counter = ctx.Value("i", 0)
 
-        @contextlib.contextmanager
-        def scrubbed_env():
-            saved = {}
-            for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS"):
-                saved[k] = os.environ.pop(k, None)
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            try:
-                yield
-            finally:
-                for k, v in saved.items():
-                    if v is None:
-                        os.environ.pop(k, None)
-                    else:
-                        os.environ[k] = v
+        scrub_keys = ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+        saved = {k: os.environ.pop(k, None) for k in scrub_keys}
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
-        with scrubbed_env(), ProcessPoolExecutor(
-                max_workers=self.num_workers, mp_context=ctx,
-                initializer=_init_worker,
-                initargs=(self.dataset, self.seed + 1000 * self.epoch,
-                          counter)) as pool:
-            pending = collections.deque()
-            batches = self._batches()
-            try:
-                for _ in range(self.num_workers * self.prefetch_batches):
-                    pending.append(pool.submit(_load_indices, next(batches)))
-            except StopIteration:
-                batches = iter(())
-            while pending:
-                done = pending.popleft()
+        def restore_env():
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+        try:
+            with ProcessPoolExecutor(
+                    max_workers=self.num_workers, mp_context=ctx,
+                    initializer=_init_worker,
+                    initargs=(self.dataset, self.seed + 1000 * self.epoch,
+                              counter)) as pool:
+                pending = collections.deque()
+                batches = self._batches()
                 try:
-                    pending.append(pool.submit(_load_indices, next(batches)))
+                    for _ in range(self.num_workers * self.prefetch_batches):
+                        pending.append(pool.submit(_load_indices,
+                                                   next(batches)))
                 except StopIteration:
-                    pass
-                yield self._collate(done.result())
+                    batches = iter(())
+                restore_env()
+                while pending:
+                    done = pending.popleft()
+                    try:
+                        pending.append(pool.submit(_load_indices,
+                                                   next(batches)))
+                    except StopIteration:
+                        pass
+                    yield self._collate(done.result())
+        finally:
+            restore_env()
 
 
 def prefetch_to_device(iterator, size: int = 2, devices=None):

@@ -170,6 +170,37 @@ class StructuredLightDataset:
         return out + (disparity, depth_mask)
 
 
+class SLStereoView:
+    """Adapter exposing the SL dataset through the standard stereo-loader
+    contract ``(meta, img1, img2, disp_flow, valid)`` so it can feed
+    ``DataLoader`` / the trainer directly.
+
+    The raw ``StructuredLightDataset`` tuples (imgL, imgR, mask18[, ...]) are
+    a different modality and MUST NOT be passed to the generic loader — its
+    worker would silently mislabel the fields.  This view converts the
+    left->right normalised disparity back to pixel units and to the
+    framework's negative-x-flow convention (core/stereo_datasets.py:77).
+    """
+
+    def __init__(self, dataset: "StructuredLightDataset"):
+        assert dataset.with_depth, "stereo view needs with_depth=True"
+        self._ds = dataset
+
+    def reseed(self, seed: int) -> None:
+        self._ds.reseed(seed)
+
+    def __len__(self) -> int:
+        return len(self._ds)
+
+    def __getitem__(self, index: int):
+        img_l, img_r, _mask, disparity, depth_mask = self._ds[index]
+        w = disparity.shape[1]
+        flow = (-disparity[..., 1:2] * w).astype(np.float32)  # px, negative
+        valid = depth_mask[..., 1].astype(np.float32)
+        meta = list(self._ds.samples[index])
+        return meta, img_l, img_r, flow, valid
+
+
 def fetch_sl_dataset(root: str, **kwargs) -> StructuredLightDataset:
     """Working equivalent of the fork's ``sl_datasets.fetch_dataloader``
     (reference: core/sl_datasets.py:214-234, broken as shipped)."""

@@ -93,13 +93,15 @@ class MultiBasicEncoder(nn.Module):
                               name=f"head08_{hi}_res"),
                 conv(dims[0], 3, dtype=self.dtype, name=f"head08_{hi}_conv"),
             ))
-            heads16.append((
-                ResidualBlock(128, 128, self.norm_fn, 1, self.dtype,
-                              name=f"head16_{hi}_res"),
-                conv(dims[1], 3, dtype=self.dtype, name=f"head16_{hi}_conv"),
-            ))
-            heads32.append(conv(dims[2], 3, dtype=self.dtype,
-                                name=f"head32_{hi}_conv"))
+            if len(dims) >= 2:
+                heads16.append((
+                    ResidualBlock(128, 128, self.norm_fn, 1, self.dtype,
+                                  name=f"head16_{hi}_res"),
+                    conv(dims[1], 3, dtype=self.dtype, name=f"head16_{hi}_conv"),
+                ))
+            if len(dims) >= 3:
+                heads32.append(conv(dims[2], 3, dtype=self.dtype,
+                                    name=f"head32_{hi}_conv"))
         self.heads08 = heads08
         self.heads16 = heads16
         self.heads32 = heads32

@@ -30,13 +30,24 @@ def _build(name: str) -> Optional[str]:
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Compile to a process-unique temp file and atomically rename: several
+    # loader worker processes may race to build the same library, and dlopen
+    # of a partially-written .so can crash the worker.
+    tmp = f"{out}.{os.getpid()}.tmp"
     for cc in ("cc", "gcc", "g++"):
         try:
-            subprocess.run([cc, "-O3", "-shared", "-fPIC", src, "-o", out],
+            subprocess.run([cc, "-O3", "-shared", "-fPIC", src, "-o", tmp],
                            check=True, capture_output=True, timeout=120)
+            os.replace(tmp, out)
             return out
         except (OSError, subprocess.SubprocessError):
             continue
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
     return None
 
 

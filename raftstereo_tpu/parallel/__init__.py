@@ -1,0 +1,16 @@
+"""Parallelism layer: device meshes, shardings, and sharded step compilation.
+
+The reference's only parallelism is single-process ``nn.DataParallel``
+(reference: train_stereo.py:135) — replicate weights, scatter the batch,
+gather outputs.  Here the same capability (and beyond: multi-host) is
+expressed the TPU way: a ``jax.sharding.Mesh`` plus sharding annotations on
+``jax.jit``; XLA inserts the gradient all-reduce over ICI/DCN (SURVEY.md §2.7).
+"""
+
+from .mesh import (DATA_AXIS, SPACE_AXIS, batch_sharded, make_mesh,
+                   replicated, shard_batch, spatial_sharded)
+
+__all__ = [
+    "DATA_AXIS", "SPACE_AXIS", "make_mesh", "replicated", "batch_sharded",
+    "spatial_sharded", "shard_batch",
+]

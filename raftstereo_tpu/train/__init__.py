@@ -1,0 +1,19 @@
+"""Training layer: loss, optimizer, sharded step, checkpointing, logging.
+
+TPU-first replacement for the reference's training loop
+(reference: train_stereo.py:133-212).
+"""
+
+from .checkpoint import CheckpointManager, load_weights, save_weights
+from .logger import Logger
+from .loss import sequence_loss
+from .optim import make_optimizer, onecycle_lr
+from .state import TrainState, create_train_state, state_from_variables
+from .step import jit_train_step, make_train_step
+
+__all__ = [
+    "sequence_loss", "make_optimizer", "onecycle_lr",
+    "TrainState", "create_train_state", "state_from_variables",
+    "make_train_step", "jit_train_step",
+    "CheckpointManager", "save_weights", "load_weights", "Logger",
+]

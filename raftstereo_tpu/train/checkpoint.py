@@ -1,0 +1,72 @@
+"""Orbax checkpointing: full train state + step, with retention.
+
+Upgrades the reference's weights-only ``torch.save(model.state_dict())``
+(reference: train_stereo.py:184-187,209-210; restore :143-148) to exact-resume
+checkpoints: params, frozen BN stats, optimizer state, and step all
+round-trip, so the LR schedule continues instead of restarting (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints under ``directory`` with max_to_keep."""
+
+    def __init__(self, directory: str, keep: int = 5):
+        directory = os.path.abspath(directory)
+        self._mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep,
+                                                 create=True))
+
+    def save(self, step: int, state: TrainState, wait: bool = False) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, state_like: TrainState,
+                step: Optional[int] = None) -> TrainState:
+        """Restore into the structure of ``state_like`` (shapes/dtypes/
+        shardings are taken from it)."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        tgt = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(tgt))
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
+def save_weights(path: str, variables: Dict) -> None:
+    """Weights-only save (the ``.pth`` equivalent) for eval/demo artifacts."""
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), variables)
+    ckptr.wait_until_finished()
+    ckptr.close()
+
+
+def load_weights(path: str, variables_like: Optional[Dict] = None) -> Dict:
+    """Load a weights-only checkpoint; ``variables_like`` (e.g. from
+    ``model.init``) pins the pytree structure if given."""
+    ckptr = ocp.StandardCheckpointer()
+    path = os.path.abspath(path)
+    if variables_like is None:
+        out = ckptr.restore(path)
+    else:
+        tgt = jax.tree.map(ocp.utils.to_shape_dtype_struct, variables_like)
+        out = ckptr.restore(path, tgt)
+    ckptr.close()
+    return out

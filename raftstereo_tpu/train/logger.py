@@ -1,0 +1,95 @@
+"""Training metrics: running-mean console prints + TensorBoard + JSONL.
+
+Mirror of the reference's ``Logger`` (reference: train_stereo.py:83-130):
+running means over ``SUM_FREQ=100`` steps, per-batch live loss / lr scalars
+(:171-172), validation dicts via ``write_dict`` (:122-127).  TensorBoard goes
+through ``torch.utils.tensorboard`` when present (torch is host-side only
+here); a JSONL stream is always written so metrics survive without TB.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+SUM_FREQ = 100
+
+logger = logging.getLogger(__name__)
+
+
+def _make_tb_writer(log_dir: str):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(log_dir=log_dir)
+    except Exception:  # tensorboard not installed — JSONL still covers it
+        return None
+
+
+class Logger:
+    def __init__(self, log_dir: str = "runs", total_steps: int = 0,
+                 jsonl_path: Optional[str] = None):
+        self.total_steps = total_steps
+        self.running: Dict[str, float] = {}
+        self.log_dir = log_dir
+        self.writer = _make_tb_writer(log_dir)
+        self._jsonl = None
+        if jsonl_path is None:
+            jsonl_path = os.path.join(log_dir, "metrics.jsonl")
+        os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+        self._jsonl = open(jsonl_path, "a")
+        self._t0 = time.time()
+
+    # -- per-step -----------------------------------------------------------
+
+    def push(self, metrics: Dict[str, float]) -> None:
+        """Accumulate one step's metrics; print running means every SUM_FREQ
+        steps (reference: train_stereo.py:109-119)."""
+        self.total_steps += 1
+        for k, v in metrics.items():
+            self.running[k] = self.running.get(k, 0.0) + float(v)
+        if self.total_steps % SUM_FREQ == 0:
+            means = {k: v / SUM_FREQ for k, v in self.running.items()}
+            rate = SUM_FREQ / max(time.time() - self._t0, 1e-9)
+            self._t0 = time.time()
+            keys = sorted(means)
+            msg = f"[{self.total_steps:6d}] " + ", ".join(
+                f"{k}={means[k]:10.4f}" for k in keys)
+            logger.info("%s  (%.2f it/s)", msg, rate)
+            self._emit({"step": self.total_steps, "steps_per_sec": rate,
+                        **means})
+            if self.writer is not None:
+                for k, v in means.items():
+                    self.writer.add_scalar(k, v, self.total_steps)
+            self.running = {}
+
+    def write_scalar(self, name: str, value: float,
+                     step: Optional[int] = None) -> None:
+        """Per-batch scalar (live_loss / lr, reference: train_stereo.py:171)."""
+        step = self.total_steps if step is None else step
+        if self.writer is not None:
+            self.writer.add_scalar(name, float(value), step)
+
+    def write_dict(self, results: Dict[str, float]) -> None:
+        """Validation results (reference: train_stereo.py:122-127)."""
+        self._emit({"step": self.total_steps, **{k: float(v)
+                                                 for k, v in results.items()}})
+        if self.writer is not None:
+            for k, v in results.items():
+                self.writer.add_scalar(k, float(v), self.total_steps)
+
+    # -- internals ----------------------------------------------------------
+
+    def _emit(self, record: Dict) -> None:
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(record) + "\n")
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None

@@ -1,0 +1,69 @@
+"""Sequence loss over per-iteration disparity predictions.
+
+Capability mirror of the reference's ``sequence_loss``
+(reference: train_stereo.py:36-70), with the same semantics:
+
+* gamma is adjusted to ``loss_gamma ** (15 / (n_predictions - 1))`` so the
+  weight profile is invariant to the iteration count (train_stereo.py:54)
+* validity mask = ``(valid >= 0.5) & (|flow_gt| < max_flow)``
+  (train_stereo.py:44-47)
+* per-iteration L1 is a mean over VALID pixels only (train_stereo.py:58)
+* metrics: masked EPE mean + fraction of valid pixels under 1/3/5 px
+  (train_stereo.py:60-68)
+
+Predictions carry a single disparity channel (the reference zeroes the y-flow
+each iteration, core/raft_stereo.py:120, so its 2-channel EPE reduces to
+|dx| exactly).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_loss(disp_preds: jax.Array, disp_gt: jax.Array, valid: jax.Array,
+                  loss_gamma: float = 0.9, max_flow: float = 700.0,
+                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """gamma-weighted L1 over all iteration predictions.
+
+    Args:
+      disp_preds: (iters, B, H, W, 1) full-resolution disparity predictions.
+      disp_gt:    (B, H, W, 1) ground-truth disparity (negative x-flow).
+      valid:      (B, H, W) float/bool validity.
+
+    Returns (scalar loss, metrics dict); all values float32 scalars.
+    """
+    n = disp_preds.shape[0]
+    assert n >= 1, n
+    disp_gt = disp_gt.astype(jnp.float32)
+    preds = disp_preds.astype(jnp.float32)
+
+    mag = jnp.abs(disp_gt[..., 0])                       # (B, H, W)
+    mask = (valid.astype(jnp.float32) >= 0.5) & (mag < max_flow)
+    m = mask.astype(jnp.float32)[..., None]              # (B, H, W, 1)
+    denom = jnp.maximum(m.sum(), 1.0)
+
+    gamma = loss_gamma ** (15.0 / (n - 1)) if n > 1 else 1.0
+    # i-th prediction weighted gamma^(n-i-1): final prediction weight 1.
+    weights = jnp.power(jnp.float32(gamma),
+                        jnp.arange(n - 1, -1, -1, dtype=jnp.float32))
+    abs_err = jnp.abs(preds - disp_gt[None])             # (iters, B, H, W, 1)
+    per_iter = (abs_err * m[None]).sum(axis=(1, 2, 3, 4)) / denom
+    loss = jnp.sum(weights * per_iter)
+
+    epe = jnp.abs(preds[-1, ..., 0] - disp_gt[..., 0])   # (B, H, W)
+    mden = jnp.maximum(m[..., 0].sum(), 1.0)
+
+    def frac_under(t):
+        return ((epe < t).astype(jnp.float32) * m[..., 0]).sum() / mden
+
+    metrics = {
+        "epe": (epe * m[..., 0]).sum() / mden,
+        "1px": frac_under(1.0),
+        "3px": frac_under(3.0),
+        "5px": frac_under(5.0),
+    }
+    return loss, metrics

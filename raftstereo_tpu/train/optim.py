@@ -1,0 +1,60 @@
+"""Optimizer: AdamW + one-cycle LR + global-norm gradient clipping.
+
+Mirrors the reference recipe (reference: train_stereo.py:73-80):
+``AdamW(lr, wdecay, eps=1e-8)`` + ``OneCycleLR(lr, num_steps+100,
+pct_start=0.01, anneal_strategy='linear')`` + ``clip_grad_norm_(1.0)``
+(train_stereo.py:176).  The schedule reproduces torch's two-phase linear
+OneCycle exactly (phase boundary at ``pct_start*total - 1``, floor at
+``lr / div_factor / final_div_factor``) — verified numerically against
+``torch.optim.lr_scheduler.OneCycleLR`` in tests/test_train.py.
+
+No GradScaler equivalent is needed: the bf16 policy keeps master weights and
+the loss in float32, and bf16 has the same exponent range as float32, so the
+underflow problem torch's AMP scaler solves (train_stereo.py:156) does not
+exist on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from ..config import TrainConfig
+
+
+def onecycle_lr(max_lr: float, total_steps: int, pct_start: float = 0.01,
+                div_factor: float = 25.0, final_div_factor: float = 1e4):
+    """Two-phase linear one-cycle schedule, torch-semantics.
+
+    Phase 1 (steps 0 .. up_end): linear initial_lr -> max_lr,
+    up_end = pct_start*total_steps - 1.
+    Phase 2 (up_end .. total_steps-1): linear max_lr -> min_lr.
+    """
+    initial_lr = max_lr / div_factor
+    min_lr = initial_lr / final_div_factor
+    up_end = pct_start * total_steps - 1.0
+    down_span = (total_steps - 1.0) - up_end
+
+    def schedule(count):
+        s = jnp.asarray(count, jnp.float32)
+        if up_end > 0:
+            lr_up = initial_lr + (max_lr - initial_lr) * jnp.clip(
+                s / up_end, 0.0, 1.0)
+        else:
+            lr_up = jnp.float32(max_lr)
+        lr_down = max_lr + (min_lr - max_lr) * jnp.clip(
+            (s - up_end) / down_span, 0.0, 1.0)
+        return jnp.where(s <= up_end, lr_up, lr_down)
+
+    return schedule
+
+
+def make_optimizer(cfg: TrainConfig):
+    """(optax transform, lr schedule) for the reference training recipe."""
+    schedule = onecycle_lr(cfg.lr, cfg.num_steps + 100, pct_start=0.01)
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(learning_rate=schedule, b1=0.9, b2=0.999, eps=1e-8,
+                    weight_decay=cfg.wdecay),
+    )
+    return tx, schedule

@@ -1,0 +1,58 @@
+"""Train state pytree: step + weights + frozen BN stats + optimizer state.
+
+Unlike the reference, which checkpoints weights only and restarts the LR
+schedule on resume (reference: train_stereo.py:143-148, SURVEY.md §5), the
+full state here round-trips through Orbax so resume is exact.
+
+``batch_stats`` is constant during training: the reference freezes BatchNorm
+from step 0 (``model.freeze_bn()``, train_stereo.py:152; core/raft_stereo.py:
+41-44), so running stats are never updated — they only change when loading a
+converted torch checkpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array          # () int32, number of completed updates
+    params: Any
+    batch_stats: Any         # {} when the model has no BatchNorm
+    opt_state: Any
+
+    @property
+    def variables(self) -> Dict:
+        v = {"params": self.params}
+        if self.batch_stats:
+            v["batch_stats"] = self.batch_stats
+        return v
+
+
+def create_train_state(model, rng: jax.Array, tx,
+                       image_hw: Tuple[int, int]) -> TrainState:
+    variables = model.init(rng, image_hw)
+    params = variables["params"]
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=tx.init(params),
+    )
+
+
+def state_from_variables(variables: Dict, tx) -> TrainState:
+    """Wrap converted/loaded weights (e.g. a torch .pth via utils.convert)
+    into a fresh train state for fine-tuning."""
+    params = variables["params"]
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=tx.init(params),
+    )

@@ -1,0 +1,62 @@
+"""The training step: loss, grads, update — compiled once, sharded over a mesh.
+
+Replaces the reference's per-batch body (reference: train_stereo.py:162-200):
+forward through DataParallel, sequence loss, AMP-scaled backward, clip, step,
+scheduler step.  Here the whole thing is ONE jitted function; data parallelism
+is expressed by sharding the batch over the mesh's ``data`` axis while state
+stays replicated — XLA emits the gradient all-reduce (SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import optax
+
+from ..config import TrainConfig
+from ..parallel import batch_sharded, replicated
+from .loss import sequence_loss
+from .state import TrainState
+
+Batch = Tuple[jax.Array, jax.Array, jax.Array, jax.Array]  # img1,img2,disp,valid
+
+
+def make_train_step(model, tx, cfg: TrainConfig,
+                    lr_schedule=None) -> Callable[[TrainState, Batch],
+                                                  Tuple[TrainState, Dict]]:
+    """Build the un-jitted (state, batch) -> (state, metrics) step."""
+
+    def loss_fn(params, batch_stats, img1, img2, disp_gt, valid):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        preds = model.forward(variables, img1, img2, iters=cfg.train_iters)
+        return sequence_loss(preds, disp_gt, valid,
+                             loss_gamma=cfg.loss_gamma, max_flow=cfg.max_flow)
+
+    def step(state: TrainState, batch: Batch):
+        img1, img2, disp_gt, valid = batch
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.batch_stats, img1, img2, disp_gt, valid)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics, loss=loss, grad_norm=optax.global_norm(grads))
+        if lr_schedule is not None:
+            metrics["lr"] = lr_schedule(state.step)
+        new_state = state.replace(step=state.step + 1, params=params,
+                                  opt_state=opt_state)
+        return new_state, metrics
+
+    return step
+
+
+def jit_train_step(step_fn, mesh):
+    """Compile the step over a mesh: state/metrics replicated, batch sharded
+    on ``data``.  ``donate_argnums=0`` reuses the old state's HBM buffers."""
+    repl = replicated(mesh)
+    data = batch_sharded(mesh)
+    return jax.jit(step_fn,
+                   in_shardings=(repl, (data, data, data, data)),
+                   out_shardings=(repl, repl),
+                   donate_argnums=(0,))

@@ -354,3 +354,38 @@ class TestStructuredLight:
         os.makedirs(tmp_path / "empty_root")
         with pytest.raises(AssertionError):
             fetch_sl_dataset(str(tmp_path / "empty_root"))
+
+    def test_stereo_view_loader_contract(self, tmp_path, rng):
+        from raftstereo_tpu.data import DataLoader, SLStereoView
+        make_synthetic_sl(tmp_path, rng=rng)
+        ds = SLStereoView(StructuredLightDataset(str(tmp_path), scale=1.0,
+                                                 with_depth=True))
+        meta, img1, img2, flow, valid = ds[0]
+        assert img1.shape == (32, 40, 3) and img2.shape == (32, 40, 3)
+        assert flow.shape == (32, 40, 1) and (flow <= 0).all()
+        assert valid.shape == (32, 40)
+        loader = DataLoader(ds, batch_size=1, num_workers=0, seed=3)
+        b1, b2, bf, bv = next(iter(loader))
+        assert b1.shape == (1, 32, 40, 3) and bf.shape == (1, 32, 40, 1)
+
+
+class TestSparseFlips:
+    def test_hf_flip_mirrors_flow(self, rng):
+        from raftstereo_tpu.data import SparseFlowAugmentor
+        aug = SparseFlowAugmentor(crop_size=(48, 64), min_scale=0.0,
+                                  max_scale=0.0, do_flip="hf")
+        aug.spatial_aug_prob = 0.0
+        aug.eraser_aug_prob = 0.0
+        aug.h_flip_prob = 1.0
+        aug.photo = lambda img, g: img  # identity photometrics
+        img1 = rng.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+        img2 = rng.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+        flow = np.zeros((48, 64, 2), np.float32)
+        flow[10, 20] = [-7.0, 0.0]
+        valid = np.zeros((48, 64), np.float32)
+        valid[10, 20] = 1
+        g = np.random.default_rng(5)
+        a, b, f, v = aug(img1, img2, flow, valid, g)
+        np.testing.assert_array_equal(a, img1[:, ::-1])
+        assert v[10, 64 - 1 - 20] == 1
+        np.testing.assert_allclose(f[10, 64 - 1 - 20], [7.0, 0.0])

@@ -1,0 +1,216 @@
+"""Training-layer tests: loss semantics, optimizer parity with torch,
+sharded train step correctness, checkpoint roundtrip."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from raftstereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raftstereo_tpu.models.raft_stereo import RAFTStereo
+from raftstereo_tpu.parallel import make_mesh, shard_batch
+from raftstereo_tpu.train import (CheckpointManager, TrainState,
+                                  create_train_state, jit_train_step,
+                                  make_optimizer, make_train_step, onecycle_lr,
+                                  sequence_loss)
+
+TINY = RAFTStereoConfig(corr_levels=2, corr_radius=2, n_gru_layers=2,
+                        hidden_dims=(32, 32), context_norm="batch")
+
+
+# ---------------------------------------------------------------------------
+# sequence loss
+# ---------------------------------------------------------------------------
+
+def _loss_oracle(preds, gt, valid, gamma=0.9, max_flow=700.0):
+    """Straight numpy transcription of the reference formula
+    (train_stereo.py:36-68)."""
+    n = preds.shape[0]
+    mag = np.abs(gt[..., 0])
+    mask = (valid >= 0.5) & (mag < max_flow)
+    adj = gamma ** (15.0 / (n - 1)) if n > 1 else 1.0
+    loss = 0.0
+    for i in range(n):
+        w = adj ** (n - i - 1)
+        err = np.abs(preds[i] - gt)
+        loss += w * err[mask[..., None] & np.ones_like(err, bool)].mean()
+    epe = np.abs(preds[-1][..., 0] - gt[..., 0])[mask]
+    return loss, {"epe": epe.mean(), "1px": (epe < 1).mean(),
+                  "3px": (epe < 3).mean(), "5px": (epe < 5).mean()}
+
+
+def test_sequence_loss_matches_oracle(rng):
+    preds = rng.normal(size=(5, 2, 8, 10, 1)).astype(np.float32) * 3
+    gt = rng.normal(size=(2, 8, 10, 1)).astype(np.float32) * 3
+    valid = (rng.random((2, 8, 10)) > 0.3).astype(np.float32)
+    gt[0, 0, 0, 0] = 900.0  # excluded by max_flow
+    loss, metrics = jax.jit(sequence_loss)(jnp.asarray(preds), jnp.asarray(gt),
+                                           jnp.asarray(valid))
+    eloss, emetrics = _loss_oracle(preds, gt, valid)
+    np.testing.assert_allclose(float(loss), eloss, rtol=1e-5)
+    for k, v in emetrics.items():
+        np.testing.assert_allclose(float(metrics[k]), v, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_loss_single_prediction(rng):
+    preds = rng.normal(size=(1, 1, 4, 6, 1)).astype(np.float32)
+    gt = np.zeros((1, 4, 6, 1), np.float32)
+    valid = np.ones((1, 4, 6), np.float32)
+    loss, _ = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                            jnp.asarray(valid))
+    np.testing.assert_allclose(float(loss), np.abs(preds).mean(), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# optimizer: schedule + AdamW parity with torch
+# ---------------------------------------------------------------------------
+
+def test_onecycle_matches_torch():
+    torch = pytest.importorskip("torch")
+    total, max_lr = 400, 2e-4
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.AdamW([p], lr=max_lr)
+    sched = torch.optim.lr_scheduler.OneCycleLR(
+        opt, max_lr, total, pct_start=0.01, cycle_momentum=False,
+        anneal_strategy="linear")
+    ours = onecycle_lr(max_lr, total, pct_start=0.01)
+    for step in range(total):
+        torch_lr = opt.param_groups[0]["lr"]
+        np.testing.assert_allclose(float(ours(step)), torch_lr,
+                                   rtol=1e-4, atol=1e-10,
+                                   err_msg=f"step {step}")
+        opt.step()
+        sched.step()
+
+
+def test_adamw_clip_matches_torch(rng):
+    torch = pytest.importorskip("torch")
+    cfg = TrainConfig(lr=1e-3, num_steps=50, wdecay=1e-4, grad_clip=1.0)
+    w0 = rng.normal(size=(7,)).astype(np.float32)
+    grads = [rng.normal(size=(7,)).astype(np.float32) * s
+             for s in (0.5, 5.0, 0.1, 2.0)]  # one grad exceeds the clip norm
+
+    p = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.AdamW([p], lr=cfg.lr, weight_decay=cfg.wdecay, eps=1e-8)
+    tsched = torch.optim.lr_scheduler.OneCycleLR(
+        topt, cfg.lr, cfg.num_steps + 100, pct_start=0.01,
+        cycle_momentum=False, anneal_strategy="linear")
+    for g in grads:
+        topt.zero_grad()
+        p.grad = torch.tensor(g)
+        torch.nn.utils.clip_grad_norm_([p], cfg.grad_clip)
+        topt.step()
+        tsched.step()
+
+    tx, _ = make_optimizer(cfg)
+    params = jnp.asarray(w0)
+    opt_state = tx.init(params)
+    for g in grads:
+        updates, opt_state = tx.update(jnp.asarray(g), opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+    np.testing.assert_allclose(np.asarray(params), p.detach().numpy(),
+                               rtol=2e-4, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# train step: runs sharded, loss decreases, sharded == single-device
+# ---------------------------------------------------------------------------
+
+def _tiny_batch(rng, b=8, h=48, w=64):
+    img1 = rng.integers(0, 255, (b, h, w, 3)).astype(np.float32)
+    img2 = rng.integers(0, 255, (b, h, w, 3)).astype(np.float32)
+    disp = -np.abs(rng.normal(size=(b, h, w, 1))).astype(np.float32) * 5
+    valid = np.ones((b, h, w), np.float32)
+    return img1, img2, disp, valid
+
+
+def _make_all(num_steps=50, train_iters=2):
+    cfg = TrainConfig(lr=1e-3, num_steps=num_steps, train_iters=train_iters,
+                      batch_size=8)
+    model = RAFTStereo(TINY)
+    tx, sched = make_optimizer(cfg)
+    state = create_train_state(model, jax.random.key(0), tx, (48, 64))
+    step = make_train_step(model, tx, cfg, lr_schedule=sched)
+    return model, tx, state, step
+
+
+def test_train_step_descends(rng):
+    _, _, state, step = _make_all()
+    mesh = make_mesh(data=8)
+    jstep = jit_train_step(step, mesh)
+    batch = shard_batch(mesh, _tiny_batch(rng))
+    losses = []
+    for _ in range(8):
+        state, metrics = jstep(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert int(state.step) == 8
+    assert np.isfinite(losses).all()
+
+
+def test_sharded_matches_single_device(rng):
+    batch = _tiny_batch(rng)
+    results = []
+    for ndev in (1, 8):
+        _, _, state, step = _make_all()
+        mesh = make_mesh(data=ndev)
+        jstep = jit_train_step(step, mesh)
+        st = state
+        first = None
+        for _ in range(3):
+            st, metrics = jstep(st, shard_batch(mesh, batch))
+            if first is None:
+                first = (np.asarray(metrics["loss"]),
+                         np.asarray(metrics["epe"]))
+        results.append((first, np.asarray(metrics["loss"]),
+                        jax.tree.leaves(st.params)[0]))
+    # Step 1 (identical params): only reduction order differs across shards.
+    np.testing.assert_allclose(results[0][0][0], results[1][0][0], rtol=1e-5)
+    np.testing.assert_allclose(results[0][0][1], results[1][0][1], rtol=1e-5)
+    # After 3 Adam updates float32 reduction-order noise is amplified; a
+    # broken gradient all-reduce would be off by ~x8, not <1%.
+    np.testing.assert_allclose(results[0][1], results[1][1], rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(results[0][2]),
+                               np.asarray(results[1][2]), rtol=5e-2, atol=1e-4)
+
+
+def test_lr_metric_follows_schedule(rng):
+    _, _, state, step = _make_all(num_steps=50)
+    mesh = make_mesh(data=1)
+    jstep = jit_train_step(step, mesh)
+    sched = onecycle_lr(1e-3, 150, pct_start=0.01)
+    batch = shard_batch(mesh, _tiny_batch(rng, b=2, h=48, w=64))
+    for i in range(3):
+        state, metrics = jstep(state, batch)
+        np.testing.assert_allclose(float(metrics["lr"]), float(sched(i)),
+                                   rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint roundtrip
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip(tmp_path, rng):
+    _, tx, state, step = _make_all()
+    mesh = make_mesh(data=2)
+    jstep = jit_train_step(step, mesh)
+    batch = shard_batch(mesh, _tiny_batch(rng, b=2))
+    state, _ = jstep(state, batch)
+
+    mngr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+    mngr.save(int(state.step), state, wait=True)
+    assert mngr.latest_step() == 1
+
+    _, tx2, fresh, _ = _make_all()
+    restored = mngr.restore(fresh)
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # optimizer state round-trips too (exact resume, unlike the reference)
+    for a, b in zip(jax.tree.leaves(state.opt_state),
+                    jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mngr.close()
