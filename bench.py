@@ -1,0 +1,157 @@
+"""Benchmark CLI: stereo-pairs/sec on the flagship inference path.
+
+Measures the BASELINE.json headline metric — stereo pairs/sec/chip at
+960x540 with 32 GRU iterations — on whatever accelerator JAX sees (the
+real TPU chip under the driver; CPU with ``--quick`` for development).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "pairs/sec", "vs_baseline": N}
+
+``vs_baseline`` compares against the PyTorch reference model running the same
+config, measured once on this machine's CPU (the only hardware the torch
+reference runs on here — no CUDA) and cached in BENCH_BASELINE.json.  Refresh
+with ``--measure-baseline``.  The reference's own FPS measurement protocol
+(warmup then mean wall-clock over repeats, evaluate_stereo.py:77-81,105-107)
+is mirrored.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_CACHE = os.path.join(REPO, "BENCH_BASELINE.json")
+METRIC = "stereo-pairs/sec/chip @960x540, 32 GRU iters"
+
+
+def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
+              reps: int, warmup: int, compute_dtype: str) -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raftstereo_tpu.config import RAFTStereoConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.ops.image import InputPadder
+
+    if corr == "auto":
+        corr = "reg" if jax.default_backend() == "cpu" else "pallas"
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+
+    rng = np.random.default_rng(0)
+    img1 = rng.integers(0, 255, (batch, height, width, 3)).astype(np.float32)
+    img2 = rng.integers(0, 255, (batch, height, width, 3)).astype(np.float32)
+    padder = InputPadder((batch, height, width, 3), divis_by=32)
+    img1, img2 = padder.pad(jnp.asarray(img1), jnp.asarray(img2))
+    img1, img2 = jax.device_put(img1), jax.device_put(img2)
+
+    fn = model.jitted_infer(iters=iters)
+    # Under the axon tunnel block_until_ready returns without waiting for
+    # remote execution; only a host fetch forces completion.  Reduce each
+    # output to one scalar on-device and fetch that (4 bytes/rep) so the
+    # timing covers real execution, not enqueue time.
+    reduce = jax.jit(lambda o: o[0].sum() + o[1].sum())
+    fetch = lambda: float(reduce(fn(variables, img1, img2)))
+    fetch()  # compile
+    for _ in range(warmup):
+        fetch()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fetch()
+    dt = time.perf_counter() - t0
+    return batch * reps / dt
+
+
+def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
+                           reps: int) -> float:
+    """Run the reference PyTorch model (random weights) on CPU at the same
+    config.  Imported from /root/reference, never copied."""
+    import torch
+
+    sys.path.insert(0, "/root/reference")
+    sys.path.insert(0, "/root/reference/core")
+    from core.raft_stereo import RAFTStereo as TorchRAFTStereo
+
+    ns = argparse.Namespace(
+        corr_implementation="reg", corr_levels=4, corr_radius=4,
+        n_downsample=2, n_gru_layers=3, hidden_dims=[128, 128, 128],
+        slow_fast_gru=False, shared_backbone=False, context_norm="batch",
+        mixed_precision=False)
+    model = TorchRAFTStereo(ns).eval()
+    pad_h = (32 - height % 32) % 32
+    pad_w = (32 - width % 32) % 32
+    img = torch.zeros(batch, 3, height + pad_h, width + pad_w)
+    with torch.no_grad():
+        model(img, img, iters=iters, test_mode=True)  # warmup
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            model(img, img, iters=iters, test_mode=True)
+        dt = time.perf_counter() - t0
+    return batch * reps / dt
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--height", type=int, default=540)
+    p.add_argument("--width", type=int, default=960)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--iters", type=int, default=32)
+    p.add_argument("--corr", default="auto",
+                   choices=["auto", "reg", "alt", "pallas"])
+    p.add_argument("--reps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--compute_dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--quick", action="store_true",
+                   help="tiny shapes / few reps (CPU development)")
+    p.add_argument("--measure-baseline", action="store_true",
+                   help="re-measure the torch reference baseline (slow)")
+    args = p.parse_args()
+
+    if args.quick:
+        args.height, args.width, args.iters, args.reps = 256, 320, 8, 3
+
+    # The image's site hook imports jax at interpreter startup, freezing the
+    # platform before JAX_PLATFORMS from the shell can apply — push it
+    # through jax.config so `JAX_PLATFORMS=cpu python bench.py` works.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    value = bench_jax(args.height, args.width, args.batch, args.iters,
+                      args.corr, args.reps, args.warmup, args.compute_dtype)
+
+    baseline = None
+    if not args.quick:
+        if args.measure_baseline or not os.path.exists(BASELINE_CACHE):
+            try:
+                bval = measure_torch_baseline(args.height, args.width,
+                                              args.batch, args.iters, reps=2)
+                with open(BASELINE_CACHE, "w") as f:
+                    json.dump({"pairs_per_sec": bval,
+                               "config": f"{args.width}x{args.height}/"
+                                         f"{args.iters}it torch-cpu reg"},
+                              f, indent=1)
+            except Exception as e:  # baseline is best-effort
+                print(f"baseline measurement failed: {e}", file=sys.stderr)
+        if os.path.exists(BASELINE_CACHE):
+            with open(BASELINE_CACHE) as f:
+                baseline = json.load(f)["pairs_per_sec"]
+
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(value, 4),
+        "unit": "pairs/sec",
+        "vs_baseline": round(value / baseline, 4) if baseline else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

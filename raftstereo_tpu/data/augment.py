@@ -187,14 +187,14 @@ class FlowAugmentor:
             if rng.random() < self.h_flip_prob and self.do_flip == "hf":
                 img1 = img1[:, ::-1]
                 img2 = img2[:, ::-1]
-                flow = flow[:, ::-1] * [-1.0, 1.0]
+                flow = flow[:, ::-1] * np.array([-1.0, 1.0], np.float32)
             if rng.random() < self.h_flip_prob and self.do_flip == "h":
                 # Stereo flip: swap eyes AND mirror (preserves sign convention).
                 img1, img2 = img2[:, ::-1], img1[:, ::-1]
             if rng.random() < self.v_flip_prob and self.do_flip == "v":
                 img1 = img1[::-1, :]
                 img2 = img2[::-1, :]
-                flow = flow[::-1, :] * [1.0, -1.0]
+                flow = flow[::-1, :] * np.array([1.0, -1.0], np.float32)
 
         ch, cw = self.crop_size
         if self.yjitter:
@@ -298,14 +298,14 @@ class SparseFlowAugmentor:
             if rng.random() < self.h_flip_prob and self.do_flip == "hf":
                 img1 = img1[:, ::-1]
                 img2 = img2[:, ::-1]
-                flow = flow[:, ::-1] * [-1.0, 1.0]
+                flow = flow[:, ::-1] * np.array([-1.0, 1.0], np.float32)
                 valid = valid[:, ::-1]
             if rng.random() < self.h_flip_prob and self.do_flip == "h":
                 img1, img2 = img2[:, ::-1], img1[:, ::-1]
             if rng.random() < self.v_flip_prob and self.do_flip == "v":
                 img1 = img1[::-1, :]
                 img2 = img2[::-1, :]
-                flow = flow[::-1, :] * [1.0, -1.0]
+                flow = flow[::-1, :] * np.array([1.0, -1.0], np.float32)
                 valid = valid[::-1, :]
 
         # Margin-biased crop favouring image borders

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import collections
 import os
-from concurrent.futures import ProcessPoolExecutor
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
@@ -95,22 +94,25 @@ class DataLoader:
         # and fork()ing a multithreaded process can deadlock workers.
         # Workers are pure numpy/PIL — scrub accelerator env vars while the
         # workers spawn so site hooks don't initialise a TPU client per
-        # worker.  Spawned children inherit os.environ at interpreter
-        # startup, so the scrub must be parent-side and cover every spawn;
-        # all workers are created during the initial prefetch burst (each
-        # submit spawns one worker up to max_workers, and the burst submits
-        # num_workers*prefetch_batches tasks — or exhausts the epoch, after
-        # which no further submits happen).  The env is restored BEFORE the
-        # first yield so consumer code (e.g. jax.device_put in
-        # prefetch_to_device) never sees the scrubbed values.
+        # worker.  Spawned children inherit os.environ at process-creation
+        # time, so the scrub must be parent-side and cover every spawn.
+        # mp.Pool (unlike ProcessPoolExecutor) starts ALL workers eagerly in
+        # its constructor, so the scrub window is exactly the Pool() call and
+        # the env is restored before the first yield — consumer code (e.g.
+        # jax.device_put in prefetch_to_device) never sees scrubbed values.
+        # (Caveat: if a worker dies, Pool's maintenance thread respawns it
+        # with the restored env; worker death is already a hard error.)
         ctx = mp.get_context("spawn")
         counter = ctx.Value("i", 0)
 
         scrub_keys = ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
         saved = {k: os.environ.pop(k, None) for k in scrub_keys}
         os.environ["JAX_PLATFORMS"] = "cpu"
-
-        def restore_env():
+        try:
+            pool = ctx.Pool(self.num_workers, initializer=_init_worker,
+                            initargs=(self.dataset,
+                                      self.seed + 1000 * self.epoch, counter))
+        finally:
             for k, v in saved.items():
                 if v is None:
                     os.environ.pop(k, None)
@@ -118,30 +120,25 @@ class DataLoader:
                     os.environ[k] = v
 
         try:
-            with ProcessPoolExecutor(
-                    max_workers=self.num_workers, mp_context=ctx,
-                    initializer=_init_worker,
-                    initargs=(self.dataset, self.seed + 1000 * self.epoch,
-                              counter)) as pool:
-                pending = collections.deque()
-                batches = self._batches()
+            pending = collections.deque()
+            batches = self._batches()
+            try:
+                for _ in range(self.num_workers * self.prefetch_batches):
+                    pending.append(pool.apply_async(_load_indices,
+                                                    (next(batches),)))
+            except StopIteration:
+                batches = iter(())
+            while pending:
+                done = pending.popleft()
                 try:
-                    for _ in range(self.num_workers * self.prefetch_batches):
-                        pending.append(pool.submit(_load_indices,
-                                                   next(batches)))
+                    pending.append(pool.apply_async(_load_indices,
+                                                    (next(batches),)))
                 except StopIteration:
-                    batches = iter(())
-                restore_env()
-                while pending:
-                    done = pending.popleft()
-                    try:
-                        pending.append(pool.submit(_load_indices,
-                                                   next(batches)))
-                    except StopIteration:
-                        pass
-                    yield self._collate(done.result())
+                    pass
+                yield self._collate(done.get())
         finally:
-            restore_env()
+            pool.terminate()
+            pool.join()
 
 
 def prefetch_to_device(iterator, size: int = 2, devices=None):

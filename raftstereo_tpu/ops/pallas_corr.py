@@ -62,7 +62,8 @@ def _lookup_kernel(vol_ref, taps_ref, out_ref):
     taps = taps_ref[0].astype(jnp.float32)        # (W1_t, K)
     w2 = vol.shape[-1]
     k = taps.shape[-1]
-    j = jax.lax.broadcasted_iota(jnp.float32, (1, w2), 1)   # (1, W2)
+    # Mosaic requires integer iota; cast to f32 for the hat weights.
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, w2), 1).astype(jnp.float32)
     cols = []
     for ki in range(k):                            # K is small (9): unrolled
         w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
@@ -76,7 +77,7 @@ def _lookup_bwd_kernel(taps_ref, g_ref, dvol_ref):
     g = g_ref[0].astype(jnp.float32)              # (W1_t, K)
     w2 = dvol_ref.shape[-1]
     k = taps.shape[-1]
-    j = jax.lax.broadcasted_iota(jnp.float32, (1, w2), 1)
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, w2), 1).astype(jnp.float32)
     acc = jnp.zeros((taps.shape[0], w2), jnp.float32)
     for ki in range(k):
         w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))

@@ -41,6 +41,10 @@ def make_mesh(data: Optional[int] = None, space: int = 1,
     if space <= 0:
         raise ValueError(f"space must be >= 1, got {space}")
     if data is None:
+        if total % space:
+            raise ValueError(
+                f"{total} devices not divisible by space={space}; pass data= "
+                f"explicitly to use a subset")
         data = max(total // space, 1)
     use = data * space
     if use > total:

@@ -53,7 +53,7 @@ class CheckpointManager:
 def save_weights(path: str, variables: Dict) -> None:
     """Weights-only save (the ``.pth`` equivalent) for eval/demo artifacts."""
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.abspath(path), variables)
+    ckptr.save(os.path.abspath(path), variables, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
 

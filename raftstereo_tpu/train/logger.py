@@ -32,6 +32,7 @@ class Logger:
     def __init__(self, log_dir: str = "runs", total_steps: int = 0,
                  jsonl_path: Optional[str] = None):
         self.total_steps = total_steps
+        self._window = 0
         self.running: Dict[str, float] = {}
         self.log_dir = log_dir
         self.writer = _make_tb_writer(log_dir)
@@ -48,11 +49,15 @@ class Logger:
         """Accumulate one step's metrics; print running means every SUM_FREQ
         steps (reference: train_stereo.py:109-119)."""
         self.total_steps += 1
+        self._window += 1
         for k, v in metrics.items():
             self.running[k] = self.running.get(k, 0.0) + float(v)
         if self.total_steps % SUM_FREQ == 0:
-            means = {k: v / SUM_FREQ for k, v in self.running.items()}
-            rate = SUM_FREQ / max(time.time() - self._t0, 1e-9)
+            # Divide by the actual window size: after a resume, the first
+            # window to a SUM_FREQ boundary is partial.
+            means = {k: v / self._window for k, v in self.running.items()}
+            rate = self._window / max(time.time() - self._t0, 1e-9)
+            self._window = 0
             self._t0 = time.time()
             keys = sorted(means)
             msg = f"[{self.total_steps:6d}] " + ", ".join(
