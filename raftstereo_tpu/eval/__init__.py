@@ -1,0 +1,11 @@
+"""Evaluation harness: compiled inference runner + benchmark validators."""
+
+from .runner import Evaluator  # noqa: F401
+from .validate import (  # noqa: F401
+    VALIDATORS,
+    validate,
+    validate_eth3d,
+    validate_kitti,
+    validate_middlebury,
+    validate_things,
+)

@@ -1,0 +1,81 @@
+"""Compiled inference runner for evaluation and demo.
+
+Wraps the model's test-mode forward behind an ``InputPadder``; ``jax.jit``
+caches one executable per distinct padded shape, so a dataset with varying
+image sizes (e.g. ETH3D) compiles once per shape instead of per image
+(SURVEY.md §7 hard-part 4: dynamic shapes vs XLA recompilation).
+``bucket_multiple`` optionally rounds the padded shape up to a coarser grid
+to share compiles across near-identical sizes.
+
+Replaces the per-image boilerplate of the reference evaluators
+(reference: evaluate_stereo.py:28-36,70-83): pad -> forward(test_mode) ->
+unpad, plus wall-clock timing of the compiled step.  Timing spans the host
+fetch of the output: under a remote-device tunnel ``block_until_ready``
+returns at enqueue time, and only a host fetch proves execution finished
+(same protocol as bench.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.image import InputPadder, replicate_pad
+
+
+class Evaluator:
+    """Stateful wrapper: (H, W, 3) numpy image pair -> (H, W) x-flow field.
+
+    Predictions follow the dataset sign convention (negative disparity,
+    reference: core/stereo_datasets.py:77), so they compare directly against
+    the ``flow`` channel produced by the data layer.
+
+    ``last_runtime`` is the wall-clock of the latest call (forward + host
+    fetch); ``last_included_compile`` flags calls whose padded shape had not
+    been executed before, i.e. whose runtime contains an XLA compile — FPS
+    protocols should drop those samples.
+    """
+
+    def __init__(self, model, variables, iters: int = 32,
+                 divis_by: int = 32, bucket_multiple: Optional[int] = None):
+        self.model = model
+        self.variables = variables
+        self.iters = iters
+        self.divis_by = divis_by
+        self.bucket_multiple = bucket_multiple
+        self._fn = jax.jit(lambda v, a, b: model.forward(
+            v, a, b, iters=iters, test_mode=True))
+        self.compiled_shapes: Set[Tuple[int, int]] = set()
+        self.last_runtime: float = float("nan")
+        self.last_included_compile: bool = True
+
+    def __call__(self, image1: np.ndarray, image2: np.ndarray) -> np.ndarray:
+        if image1.ndim == 3:
+            image1, image2 = image1[None], image2[None]
+        assert image1.shape[0] == 1, (
+            f"Evaluator is single-pair; got batch {image1.shape[0]}")
+        padder = InputPadder(image1.shape, divis_by=self.divis_by)
+        i1, i2 = padder.pad(jnp.asarray(image1), jnp.asarray(image2))
+        extra_h = extra_w = 0
+        if self.bucket_multiple:
+            m = self.bucket_multiple
+            ph, pw = i1.shape[1:3]
+            extra_h, extra_w = (-ph) % m, (-pw) % m
+            if extra_h or extra_w:
+                i1 = replicate_pad(i1, (0, extra_w, 0, extra_h))
+                i2 = replicate_pad(i2, (0, extra_w, 0, extra_h))
+        shape = tuple(i1.shape[1:3])
+        self.last_included_compile = shape not in self.compiled_shapes
+        start = time.perf_counter()
+        _, flow_up = self._fn(self.variables, i1, i2)
+        flow_up = np.asarray(flow_up, np.float32)  # host fetch = completion
+        self.last_runtime = time.perf_counter() - start
+        self.compiled_shapes.add(shape)
+        if extra_h or extra_w:
+            flow_up = flow_up[:, :flow_up.shape[1] - extra_h,
+                              :flow_up.shape[2] - extra_w]
+        return padder.unpad(flow_up)[0, ..., 0]

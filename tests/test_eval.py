@@ -1,0 +1,211 @@
+"""Eval-harness tests: validator protocols (metrics, masks, aggregation) via
+an oracle evaluator, plus an end-to-end smoke run with a tiny real model.
+
+The oracle evaluator replays ground truth (optionally with a known error
+pattern injected), so every expected EPE/D1 value is computable by hand —
+this pins the reference's aggregation semantics (per-image vs pooled D1,
+validity quirks; reference: evaluate_stereo.py:18-189) without model cost.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from raftstereo_tpu.config import RAFTStereoConfig
+from raftstereo_tpu.data import datasets as ds
+from raftstereo_tpu.data.codecs import write_pfm
+from raftstereo_tpu.data.png16 import write_png16
+from raftstereo_tpu.eval import (Evaluator, validate, validate_eth3d,
+                                 validate_kitti, validate_middlebury,
+                                 validate_things)
+from raftstereo_tpu.models.raft_stereo import RAFTStereo
+
+from test_data import make_synthetic_kitti
+
+
+class OracleEvaluator:
+    """Returns ground truth plus a fixed per-pixel error field."""
+
+    def __init__(self, dataset, error=0.0):
+        self._gt = [dataset[i][3][..., 0] for i in range(len(dataset))]
+        self.error = error
+        self.last_runtime = 1e-3
+        self.last_included_compile = False
+        self._i = 0
+
+    def __call__(self, image1, image2):
+        gt = self._gt[self._i % len(self._gt)]
+        self._i += 1
+        return gt + self.error
+
+
+# ------------------------------------------------------------- synthetic data
+
+def make_synthetic_eth3d(root, n=3, hw=(96, 128), rng=None):
+    rng = rng or np.random.default_rng(0)
+    h, w = hw
+    for i in range(n):
+        scene = root / "two_view_training" / f"scene{i}"
+        gt = root / "two_view_training_gt" / f"scene{i}"
+        os.makedirs(scene), os.makedirs(gt)
+        for name in ("im0.png", "im1.png"):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(scene / name)
+        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
+        write_pfm(str(gt / "disp0GT.pfm"), disp)
+
+
+def make_synthetic_middlebury(root, scenes=("Adirondack", "Jadeplant"),
+                              hw=(96, 128), rng=None):
+    rng = rng or np.random.default_rng(0)
+    h, w = hw
+    base = root / "MiddEval3"
+    os.makedirs(base)
+    (base / "official_train.txt").write_text("\n".join(scenes) + "\n")
+    for scene in scenes:
+        d = base / "trainingF" / scene
+        os.makedirs(d)
+        for name in ("im0.png", "im1.png"):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(d / name)
+        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
+        disp[:4] = np.inf  # occluded/unknown rows -> flow -inf, filtered
+        write_pfm(str(d / "disp0GT.pfm"), disp)
+        mask = np.full((h, w), 255, np.uint8)
+        mask[:8] = 128  # occluded band
+        Image.fromarray(mask).save(d / "mask0nocc.png")
+
+
+def make_synthetic_things_test(root, n=2, hw=(96, 128), rng=None):
+    rng = rng or np.random.default_rng(0)
+    h, w = hw
+    # 400-image seeded val subset selects indices from the TEST file list
+    # (reference: core/stereo_datasets.py:146-149); with n<=400 all survive.
+    for i in range(n):
+        img_dir = root / "FlyingThings3D" / "frames_finalpass" / "TEST" / "A" / f"{i:04d}" / "left"
+        rdir = root / "FlyingThings3D" / "frames_finalpass" / "TEST" / "A" / f"{i:04d}" / "right"
+        ddir = root / "FlyingThings3D" / "disparity" / "TEST" / "A" / f"{i:04d}" / "left"
+        os.makedirs(img_dir), os.makedirs(rdir), os.makedirs(ddir)
+        for d in (img_dir, rdir):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(d / "0006.png")
+        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
+        disp[0, :] = 300.0  # beyond the |gt|<192 filter
+        write_pfm(str(ddir / "0006.pfm"), disp)
+
+
+# ------------------------------------------------------------------ protocol
+
+class TestValidatorProtocols:
+    def test_eth3d_oracle_perfect(self, tmp_path, rng):
+        make_synthetic_eth3d(tmp_path, rng=rng)
+        d = ds.ETH3D(aug_params=None, root=str(tmp_path))
+        assert len(d) == 3
+        r = validate_eth3d(None, None, dataset=d, evaluator=OracleEvaluator(d))
+        assert r["eth3d-epe"] == pytest.approx(0.0, abs=1e-5)
+        assert r["eth3d-d1"] == pytest.approx(0.0, abs=1e-5)
+
+    def test_eth3d_oracle_known_error(self, tmp_path, rng):
+        make_synthetic_eth3d(tmp_path, rng=rng)
+        d = ds.ETH3D(aug_params=None, root=str(tmp_path))
+        # +1.5px everywhere: EPE = 1.5, every pixel > 1px -> D1 = 100
+        r = validate_eth3d(None, None, dataset=d,
+                           evaluator=OracleEvaluator(d, error=1.5))
+        assert r["eth3d-epe"] == pytest.approx(1.5, abs=1e-4)
+        assert r["eth3d-d1"] == pytest.approx(100.0)
+
+    def test_kitti_oracle_and_fps(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, rng=rng)
+        d = ds.KITTI(aug_params=None, root=str(tmp_path))
+        r = validate_kitti(None, None, dataset=d,
+                           evaluator=OracleEvaluator(d, error=2.0), warmup=1)
+        # 2px error: below the 3px D1 threshold
+        assert r["kitti-epe"] == pytest.approx(2.0, abs=1e-4)
+        assert r["kitti-d1"] == pytest.approx(0.0)
+        assert r["kitti-fps"] == pytest.approx(1000.0, rel=0.01)
+
+    def test_things_gt192_filter(self, tmp_path, rng):
+        make_synthetic_things_test(tmp_path, rng=rng)
+        d = ds.SceneFlowDatasets(aug_params=None, root=str(tmp_path),
+                                 dstype="frames_finalpass", things_test=True)
+        assert len(d) == 2
+        ev = OracleEvaluator(d)
+        # corrupt predictions exactly where |gt| >= 192; the filter must hide it
+        for i, gt in enumerate(ev._gt):
+            bad = np.abs(gt) >= 192
+            assert bad.any()
+            ev._gt[i] = gt + bad * 50.0
+        r = validate_things(None, None, dataset=d, evaluator=ev)
+        assert r["things-epe"] == pytest.approx(0.0, abs=1e-5)
+        assert r["things-d1"] == pytest.approx(0.0, abs=1e-5)
+
+    def test_middlebury_validity_quirk(self, tmp_path, rng):
+        make_synthetic_middlebury(tmp_path, rng=rng)
+        d = ds.Middlebury(aug_params=None, root=str(tmp_path), split="F")
+        assert len(d) == 2
+        ev = OracleEvaluator(d)
+        # Corrupt only rows with infinite gt (flow=-inf, rows<4): the
+        # gt>-1000 test must hide them.  Rows 4..7 are nocc-masked (valid=0)
+        # but have FINITE gt — the reference's `valid >= -0.5` quirk means
+        # they ARE scored, so corrupting them must show up.
+        for i, gt in enumerate(ev._gt):
+            pred = gt.copy()
+            pred[:4] = 0.0
+            ev._gt[i] = pred
+        r = validate_middlebury(None, None, dataset=d, evaluator=ev)
+        assert r["middleburyF-epe"] == pytest.approx(0.0, abs=1e-5)
+        assert r["middleburyF-d1"] == pytest.approx(0.0, abs=1e-5)
+
+        ev2 = OracleEvaluator(d)
+        h, w = ev2._gt[0].shape
+        for i, gt in enumerate(ev2._gt):
+            pred = gt.copy()
+            pred[4:8] += 5.0  # occluded-but-finite band: scored per the quirk
+            ev2._gt[i] = pred
+        r2 = validate_middlebury(None, None, dataset=d, evaluator=ev2)
+        frac = 4 * w / ((h - 4) * w)  # rows 4..7 of the h-4 scored rows
+        assert r2["middleburyF-epe"] == pytest.approx(5.0 * frac, rel=1e-4)
+        assert r2["middleburyF-d1"] == pytest.approx(100.0 * frac, rel=1e-4)
+
+    def test_dispatch(self, tmp_path, rng):
+        make_synthetic_eth3d(tmp_path, rng=rng)
+        d = ds.ETH3D(aug_params=None, root=str(tmp_path))
+        r = validate("eth3d", None, None, dataset=d,
+                     evaluator=OracleEvaluator(d))
+        assert "eth3d-epe" in r
+        with pytest.raises(ValueError):
+            validate("nope", None, None)
+
+
+# ------------------------------------------------------------------- end2end
+
+class TestEndToEnd:
+    def test_kitti_smoke_real_model(self, tmp_path, rng):
+        make_synthetic_kitti(tmp_path, n=2, rng=rng)
+        d = ds.KITTI(aug_params=None, root=str(tmp_path))
+        cfg = RAFTStereoConfig(n_gru_layers=2, hidden_dims=(32, 32),
+                               corr_levels=2, corr_radius=2)
+        model = RAFTStereo(cfg)
+        variables = model.init(__import__("jax").random.key(0), (64, 96))
+        r = validate_kitti(model, variables, iters=2, dataset=d, warmup=0)
+        assert np.isfinite(r["kitti-epe"])
+        assert 0.0 <= r["kitti-d1"] <= 100.0
+
+    def test_evaluator_shape_cache_and_bucketing(self, rng):
+        cfg = RAFTStereoConfig(n_gru_layers=1, hidden_dims=(32,),
+                               corr_levels=2, corr_radius=2)
+        import jax
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(0), (64, 96))
+        ev = Evaluator(model, variables, iters=1, bucket_multiple=64)
+        a = rng.integers(0, 255, (70, 100, 3)).astype(np.float32)
+        b = rng.integers(0, 255, (90, 90, 3)).astype(np.float32)
+        out1 = ev(a, a)
+        assert ev.last_included_compile
+        out2 = ev(b, b)
+        assert out1.shape == (70, 100) and out2.shape == (90, 90)
+        # both pad+bucket to the same 128x128 compile
+        assert ev.compiled_shapes == {(128, 128)}
+        assert not ev.last_included_compile
