@@ -1,0 +1,8 @@
+"""Command-line entry points, all consuming the single typed config
+(vs the reference's four duplicated argparse blocks — SURVEY.md §2.6):
+
+* ``python -m raftstereo_tpu.cli.train``     — training loop
+* ``python -m raftstereo_tpu.cli.evaluate``  — benchmark validation
+* ``python -m raftstereo_tpu.cli.demo``      — disparity inference + viz
+* ``python -m raftstereo_tpu.cli.sl_smoke``  — structured-light data check
+"""

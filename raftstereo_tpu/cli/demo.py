@@ -1,0 +1,98 @@
+"""Demo entry point: infer disparity for image pairs and save visualisations
+(reference: demo.py).
+
+    python -m raftstereo_tpu.cli.demo --restore_ckpt models/raftstereo-eth3d.pth \
+        -l "datasets/ETH3D/two_view_training/*/im0.png" \
+        -r "datasets/ETH3D/two_view_training/*/im1.png" \
+        --output_directory demo_output --save_numpy
+
+Outputs jet-colormapped PNGs of POSITIVE disparity (the model predicts
+negative x-flow; the reference negates before saving, demo.py:48-49) and
+optionally raw ``.npy`` fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+import os
+import sys
+
+import numpy as np
+from PIL import Image
+
+from ..config import add_model_args, model_config_from_args
+from ..eval import Evaluator
+from ..models import RAFTStereo
+from ..utils.viz import save_disparity_png
+from .common import load_variables, setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def load_image(path: str) -> np.ndarray:
+    img = np.asarray(Image.open(path), np.uint8)
+    if img.ndim == 2:
+        img = np.tile(img[..., None], (1, 1, 3))
+    return img[..., :3].astype(np.float32)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", required=True,
+                   help=".pth or Orbax weights")
+    p.add_argument("-l", "--left_imgs", required=True,
+                   help="glob for left (reference) images")
+    p.add_argument("-r", "--right_imgs", required=True,
+                   help="glob for right images")
+    p.add_argument("--output_directory", default="demo_output")
+    p.add_argument("--save_numpy", action="store_true",
+                   help="also save raw disparity as .npy")
+    p.add_argument("--valid_iters", type=int, default=32)
+    add_model_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    config = model_config_from_args(args)
+
+    model = RAFTStereo(config)
+    variables = load_variables(args.restore_ckpt, config, model)
+    run = Evaluator(model, variables, iters=args.valid_iters)
+
+    left = sorted(glob.glob(args.left_imgs, recursive=True))
+    right = sorted(glob.glob(args.right_imgs, recursive=True))
+    if not left or len(left) != len(right):
+        logger.error("Bad globs: %d left vs %d right images",
+                     len(left), len(right))
+        return 1
+    logger.info("Found %d image pairs. Saving files to %s/",
+                len(left), args.output_directory)
+    os.makedirs(args.output_directory, exist_ok=True)
+
+    # Output stems: basenames when unique; otherwise the parent directory
+    # (datasets like ETH3D name every left image im0.png — the reference
+    # uses the scene directory for this reason, demo.py:44); index as a
+    # last resort so pairs never overwrite each other.
+    stems = [os.path.splitext(os.path.basename(p))[0] for p in left]
+    if len(set(stems)) != len(stems):
+        stems = [os.path.basename(os.path.dirname(p)) for p in left]
+    if len(set(stems)) != len(stems):
+        stems = [f"{i:06d}_{s}" for i, s in enumerate(stems)]
+
+    for imfile1, imfile2, stem in zip(left, right, stems):
+        flow = run(load_image(imfile1), load_image(imfile2))
+        disparity = -flow  # positive disparity for output (reference: demo.py:48)
+        out = os.path.join(args.output_directory, stem)
+        if args.save_numpy:
+            np.save(f"{out}.npy", disparity)
+        save_disparity_png(f"{out}.png", disparity)
+        logger.info("%s -> %s.png (%.3fs)", imfile1, out, run.last_runtime)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""Evaluation entry point (reference: evaluate_stereo.py:192-243).
+
+    python -m raftstereo_tpu.cli.evaluate --dataset eth3d \
+        --restore_ckpt models/raftstereo-eth3d.pth --corr_implementation reg
+
+Accepts .pth (converted on load) or Orbax weight directories; prints the
+parameter count and the benchmark's EPE/D1 dict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from ..config import add_model_args, model_config_from_args
+from ..eval import VALIDATORS, validate
+from ..models import RAFTStereo
+from ..models.raft_stereo import count_parameters
+from .common import load_variables, setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", default=None,
+                   help=".pth or Orbax weights to evaluate")
+    p.add_argument("--dataset", required=True, choices=sorted(VALIDATORS),
+                   help="benchmark to run")
+    p.add_argument("--valid_iters", type=int, default=32,
+                   help="GRU refinement iterations at eval time")
+    p.add_argument("--dataset_root", default=None,
+                   help="override the default datasets/ root")
+    p.add_argument("--max_images", type=int, default=None,
+                   help="evaluate only the first N images (things only)")
+    add_model_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    config = model_config_from_args(args)
+
+    import jax
+    model = RAFTStereo(config)
+    if args.restore_ckpt:
+        variables = load_variables(args.restore_ckpt, config, model)
+        logger.info("Loaded checkpoint %s", args.restore_ckpt)
+    else:
+        variables = model.init(jax.random.key(0))
+        logger.warning("No --restore_ckpt: evaluating RANDOM weights")
+    logger.info("The model has %.2fM learnable parameters.",
+                count_parameters(variables) / 1e6)
+
+    kwargs = {"iters": args.valid_iters}
+    if args.dataset_root:
+        kwargs["root"] = args.dataset_root
+    if args.max_images is not None and args.dataset == "things":
+        kwargs["max_images"] = args.max_images
+    results = validate(args.dataset, model, variables, **kwargs)
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

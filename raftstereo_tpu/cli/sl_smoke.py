@@ -1,0 +1,46 @@
+"""Structured-light pipeline smoke CLI (the working form of the reference's
+``test.py`` dataset check, reference: test.py:9-46 — which as shipped indexes
+an empty dataset, SURVEY.md §2.5).
+
+    python -m raftstereo_tpu.cli.sl_smoke --root datasets/SL --scale 0.5
+
+Loads the SL dataset, prints its size, and round-trips one sample through
+the loader to prove shapes/dtypes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from ..data.sl import StructuredLightDataset
+from .common import setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--root", required=True, help="SL dataset root")
+    p.add_argument("--split", default="training")
+    p.add_argument("--scale", type=float, default=0.5)
+    p.add_argument("--index", type=int, default=0)
+    args = p.parse_args(argv)
+
+    ds = StructuredLightDataset(args.root, split=args.split, scale=args.scale)
+    logger.info("SL dataset: %d samples", len(ds))
+    if len(ds) == 0:
+        logger.error("Dataset is empty — check --root layout "
+                     "(see raftstereo_tpu/data/sl.py docstring)")
+        return 1
+    sample = ds[args.index]
+    names = ("img_left", "img_right", "mask18", "disparity", "depth_mask")
+    for name, v in zip(names, sample):
+        logger.info("  %s: %s %s", name, v.shape, v.dtype)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Training entry point (reference: train_stereo.py:133-258).
+
+    python -m raftstereo_tpu.cli.train --name raft-stereo --batch_size 8 \
+        --train_datasets sceneflow --num_steps 200000 --mixed_precision
+
+Differences from the reference by design (SURVEY.md §5, §7):
+
+* data parallelism = batch sharding over a ``jax.sharding`` mesh; XLA emits
+  the gradient all-reduce over ICI/DCN (vs ``nn.DataParallel``)
+* checkpoints are full train state via Orbax (params + opt state + step), so
+  ``--restore_ckpt``-less restarts resume exactly where they stopped instead
+  of restarting the LR schedule; ``--restore_ckpt`` additionally accepts
+  reference ``.pth`` files (converted on load) for fine-tuning
+* the whole step (fwd + loss + bwd + clip + update) is one jitted program
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+from ..config import TrainConfig, add_model_args, model_config_from_args
+from ..data.datasets import build_aug_params, fetch_dataset
+from ..data.loader import DataLoader
+from ..eval import validate_things
+from ..models import RAFTStereo
+from ..models.raft_stereo import count_parameters
+from ..parallel import make_mesh, shard_batch
+from ..train.checkpoint import CheckpointManager, save_weights
+from ..train.logger import Logger
+from ..train.optim import make_optimizer
+from ..train.state import create_train_state, state_from_variables
+from ..train.step import jit_train_step, make_train_step
+from .common import load_variables, setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def add_train_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("training")
+    g.add_argument("--name", default="raft-stereo")
+    g.add_argument("--restore_ckpt", default=None,
+                   help=".pth or Orbax weights to start from")
+    g.add_argument("--batch_size", type=int, default=6)
+    g.add_argument("--train_datasets", nargs="+", default=["sceneflow"])
+    g.add_argument("--lr", type=float, default=2e-4)
+    g.add_argument("--num_steps", type=int, default=100000)
+    g.add_argument("--image_size", type=int, nargs=2, default=[320, 720])
+    g.add_argument("--train_iters", type=int, default=16)
+    g.add_argument("--valid_iters", type=int, default=32)
+    g.add_argument("--wdecay", type=float, default=1e-5)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--validation_frequency", type=int, default=10000)
+    g.add_argument("--checkpoint_dir", default="checkpoints")
+    g.add_argument("--dataset_root", default=None)
+    g.add_argument("--data_parallel", type=int, default=None,
+                   help="devices on the data mesh axis (default: all)")
+    g.add_argument("--num_workers", type=int, default=None)
+    g.add_argument("--no_validation", action="store_true",
+                   help="skip the periodic FlyingThings validation")
+    a = p.add_argument_group("augmentation (reference: train_stereo.py:244-248)")
+    a.add_argument("--img_gamma", type=float, nargs=2, default=None)
+    a.add_argument("--saturation_range", type=float, nargs=2, default=None)
+    a.add_argument("--do_flip", choices=["h", "v"], default=None)
+    a.add_argument("--spatial_scale", type=float, nargs=2, default=[0.0, 0.0])
+    a.add_argument("--noyjitter", action="store_true")
+
+
+def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
+    return TrainConfig(
+        name=args.name, batch_size=args.batch_size,
+        train_datasets=tuple(args.train_datasets), lr=args.lr,
+        num_steps=args.num_steps, image_size=tuple(args.image_size),
+        train_iters=args.train_iters, valid_iters=args.valid_iters,
+        wdecay=args.wdecay, seed=args.seed,
+        validation_frequency=args.validation_frequency,
+        checkpoint_dir=args.checkpoint_dir, restore_ckpt=args.restore_ckpt,
+        img_gamma=args.img_gamma, saturation_range=args.saturation_range,
+        do_flip=args.do_flip, spatial_scale=tuple(args.spatial_scale),
+        noyjitter=args.noyjitter, data_parallel=args.data_parallel)
+
+
+def train(model_cfg, cfg: TrainConfig, dataset=None,
+          num_workers=None, no_validation: bool = False,
+          dataset_root=None) -> "TrainState":  # noqa: F821
+    """The training loop; returns the final state.  ``dataset`` injection
+    lets tests run the full loop on synthetic data."""
+    import jax
+
+    np.random.seed(cfg.seed)
+
+    model = RAFTStereo(model_cfg)
+    tx, schedule = make_optimizer(cfg)
+    mesh = make_mesh(data=cfg.data_parallel)
+    n_data = mesh.shape["data"]
+    if cfg.batch_size % n_data:
+        raise ValueError(f"batch_size {cfg.batch_size} not divisible by "
+                         f"{n_data} data-parallel devices")
+    logger.info("Mesh: %s", dict(mesh.shape))
+
+    ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.name)
+    manager = CheckpointManager(ckpt_dir, keep=cfg.keep_checkpoints)
+    state = create_train_state(model, jax.random.key(cfg.seed), tx,
+                               image_hw=cfg.image_size)
+    if manager.latest_step() is not None:
+        state = manager.restore(state)
+        logger.info("Resumed from step %d in %s", int(state.step), ckpt_dir)
+    elif cfg.restore_ckpt:
+        variables = load_variables(cfg.restore_ckpt, model_cfg, model)
+        state = state_from_variables(variables, tx)
+        logger.info("Initialised weights from %s", cfg.restore_ckpt)
+    logger.info("The model has %.2fM learnable parameters.",
+                count_parameters({"params": state.params}) / 1e6)
+
+    if dataset is None:
+        aug = build_aug_params(cfg.image_size, cfg.spatial_scale,
+                               cfg.noyjitter, cfg.saturation_range,
+                               cfg.img_gamma, cfg.do_flip)
+        roots = ({k: dataset_root for k in
+                  ("sceneflow", "kitti", "middlebury", "sintel",
+                   "falling_things", "tartanair")} if dataset_root else None)
+        dataset = fetch_dataset(cfg.train_datasets, aug, roots)
+    loader = DataLoader(dataset, cfg.batch_size, shuffle=True, drop_last=True,
+                        num_workers=num_workers, seed=cfg.seed)
+    logger.info("Train loader: %d samples, %d batches/epoch",
+                len(dataset), len(loader))
+    if len(loader) == 0:
+        raise ValueError(
+            f"empty train loader: {len(dataset)} samples < batch_size "
+            f"{cfg.batch_size} (check --train_datasets/--dataset_root)")
+
+    step_fn = jit_train_step(make_train_step(model, tx, cfg, schedule), mesh)
+    metrics_logger = Logger(log_dir=os.path.join("runs", cfg.name),
+                            total_steps=int(state.step))
+
+    def maybe_validate(state):
+        if no_validation:
+            return
+        try:
+            results = validate_things(
+                model, state.variables, iters=cfg.valid_iters,
+                root=dataset_root, max_images=200)
+        except Exception as e:  # dataset absent on this host — not fatal
+            logger.warning("Skipping validation: %s", e)
+            return
+        logger.info("Validation: %s", results)
+        metrics_logger.write_dict(results)
+
+    total_steps = int(state.step)
+    should_keep_training = total_steps <= cfg.num_steps
+    while should_keep_training:
+        for batch in loader:
+            batch = shard_batch(mesh, batch)
+            state, metrics = step_fn(state, batch)
+            total_steps += 1
+            metrics = {k: float(v) for k, v in metrics.items()}
+            metrics_logger.write_scalar("live_loss", metrics.get("loss", 0.0),
+                                        total_steps)
+            if "lr" in metrics:
+                metrics_logger.write_scalar("lr", metrics["lr"], total_steps)
+            metrics_logger.push(metrics)
+
+            if total_steps % cfg.validation_frequency == 0:
+                manager.save(total_steps, state)
+                maybe_validate(state)
+
+            if total_steps > cfg.num_steps:
+                should_keep_training = False
+                break
+
+        # Per-epoch checkpoint for very long epochs
+        # (reference: train_stereo.py:202-205).
+        if len(loader) >= 10000:
+            manager.save(total_steps, state)
+
+    manager.save(total_steps, state, wait=True)
+    final = os.path.join(ckpt_dir, f"{cfg.name}-final")
+    save_weights(final, state.variables)
+    logger.info("Saved final weights to %s", final)
+    metrics_logger.close()
+    manager.close()
+    return state
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    p = argparse.ArgumentParser(description=__doc__)
+    add_train_args(p)
+    add_model_args(p)
+    args = p.parse_args(argv)
+    train(model_config_from_args(args), train_config_from_args(args),
+          num_workers=args.num_workers, no_validation=args.no_validation,
+          dataset_root=args.dataset_root)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,6 @@ from __future__ import annotations
 import time
 from typing import Optional, Set, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -47,8 +46,7 @@ class Evaluator:
         self.iters = iters
         self.divis_by = divis_by
         self.bucket_multiple = bucket_multiple
-        self._fn = jax.jit(lambda v, a, b: model.forward(
-            v, a, b, iters=iters, test_mode=True))
+        self._fn = model.jitted_infer(iters=iters)
         self.compiled_shapes: Set[Tuple[int, int]] = set()
         self.last_runtime: float = float("nan")
         self.last_included_compile: bool = True

@@ -1,0 +1,199 @@
+"""CLI entry-point tests: train loop end-to-end (incl. exact resume), demo
+output artifacts, evaluate dispatch, and the viz colormap."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+
+from raftstereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raftstereo_tpu.data import datasets as ds
+from raftstereo_tpu.utils.viz import colorize, jet
+
+from test_data import make_synthetic_kitti
+
+
+TINY = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+            corr_radius=2)
+
+
+class TestViz:
+    def test_jet_endpoints(self):
+        out = jet(np.array([0.0, 0.5, 1.0]))
+        # classic jet: dark blue -> green-ish -> dark red
+        assert out.shape == (3, 3)
+        assert out[0, 2] > 100 and out[0, 0] == 0       # low = blue
+        assert out[1, 1] == 255                          # mid = green
+        assert out[2, 0] > 100 and out[2, 2] == 0       # high = red
+
+    def test_colorize_normalises(self):
+        arr = np.array([[10.0, 20.0], [30.0, 40.0]])
+        out = colorize(arr)
+        assert out.shape == (2, 2, 3) and out.dtype == np.uint8
+        flat = colorize(np.zeros((4, 4)))
+        assert (flat == flat[0, 0]).all()  # constant input, no div-by-zero
+
+
+class TestTrainCLI:
+    def test_train_and_resume(self, tmp_path, rng, monkeypatch):
+        from raftstereo_tpu.cli.train import train
+
+        make_synthetic_kitti(tmp_path / "kitti", n=4, rng=rng)
+        dataset = ds.KITTI(aug_params={"crop_size": (48, 64)},
+                           root=str(tmp_path / "kitti"))
+        monkeypatch.chdir(tmp_path)
+        mcfg = RAFTStereoConfig(**TINY)
+        tcfg = TrainConfig(name="t", batch_size=2, num_steps=3,
+                           train_iters=2, image_size=(48, 64),
+                           validation_frequency=2, seed=7,
+                           checkpoint_dir=str(tmp_path / "ckpt"),
+                           data_parallel=2)
+        state = train(mcfg, tcfg, dataset=dataset, num_workers=0,
+                      no_validation=True)
+        assert int(state.step) == 4  # runs to num_steps+1 then stops
+        final = tmp_path / "ckpt" / "t" / "t-final"
+        assert final.exists()
+
+        # Resume: manager restores from step 4; loop exits immediately.
+        state2 = train(mcfg, tcfg, dataset=dataset, num_workers=0,
+                       no_validation=True)
+        assert int(state2.step) == int(state.step)
+        p1 = jax.tree.leaves(state.params)[0]
+        p2 = jax.tree.leaves(state2.params)[0]
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+    def test_empty_loader_fails_fast(self, tmp_path, rng):
+        from raftstereo_tpu.cli.train import train
+
+        make_synthetic_kitti(tmp_path / "kitti", n=2, rng=rng)
+        dataset = ds.KITTI(aug_params={"crop_size": (48, 64)},
+                           root=str(tmp_path / "kitti"))
+        mcfg = RAFTStereoConfig(**TINY)
+        tcfg = TrainConfig(name="e", batch_size=8, num_steps=2,
+                           train_iters=2, image_size=(48, 64),
+                           checkpoint_dir=str(tmp_path / "ckpt"),
+                           data_parallel=8)
+        with pytest.raises(ValueError, match="empty train loader"):
+            train(mcfg, tcfg, dataset=dataset, num_workers=0,
+                  no_validation=True)
+
+    def test_arg_roundtrip(self):
+        from raftstereo_tpu.cli.train import (add_train_args,
+                                              train_config_from_args)
+        import argparse
+
+        p = argparse.ArgumentParser()
+        add_train_args(p)
+        args = p.parse_args(["--batch_size", "4", "--train_datasets",
+                             "sceneflow", "kitti", "--spatial_scale",
+                             "-0.2", "0.4"])
+        cfg = train_config_from_args(args)
+        assert cfg.batch_size == 4
+        assert cfg.train_datasets == ("sceneflow", "kitti")
+        assert cfg.spatial_scale == (-0.2, 0.4)
+
+
+class TestDemoCLI:
+    def test_demo_outputs(self, tmp_path, rng):
+        from raftstereo_tpu.cli.demo import main
+        from raftstereo_tpu.models import RAFTStereo
+        from raftstereo_tpu.train.checkpoint import save_weights
+
+        cfg = RAFTStereoConfig(**TINY)
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(0))
+        ckpt = tmp_path / "weights"
+        save_weights(str(ckpt), variables)
+
+        for i in range(2):
+            for side in ("left", "right"):
+                img = rng.integers(0, 255, (64, 96, 3), dtype=np.uint8)
+                Image.fromarray(img).save(tmp_path / f"{i}_{side}.png")
+        out_dir = tmp_path / "out"
+        rc = main(["--restore_ckpt", str(ckpt),
+                   "-l", str(tmp_path / "*_left.png"),
+                   "-r", str(tmp_path / "*_right.png"),
+                   "--output_directory", str(out_dir),
+                   "--save_numpy", "--valid_iters", "2",
+                   "--n_gru_layers", "2", "--hidden_dims", "32", "32",
+                   "--corr_levels", "2", "--corr_radius", "2"])
+        assert rc == 0
+        for i in range(2):
+            png = out_dir / f"{i}_left.png"
+            npy = out_dir / f"{i}_left.npy"
+            assert png.exists() and npy.exists()
+            assert np.asarray(Image.open(png)).shape == (64, 96, 3)
+            assert np.load(npy).shape == (64, 96)
+
+    def test_demo_colliding_basenames_use_scene_dirs(self, tmp_path, rng):
+        # ETH3D-style layout: every left image is im0.png — outputs must not
+        # overwrite each other (reference: demo.py:44 uses the scene dir).
+        from raftstereo_tpu.cli.demo import main
+        from raftstereo_tpu.models import RAFTStereo
+        from raftstereo_tpu.train.checkpoint import save_weights
+
+        cfg = RAFTStereoConfig(**TINY)
+        variables = RAFTStereo(cfg).init(jax.random.key(0))
+        ckpt = tmp_path / "w"
+        save_weights(str(ckpt), variables)
+        for scene in ("sceneA", "sceneB"):
+            os.makedirs(tmp_path / scene)
+            for name in ("im0.png", "im1.png"):
+                img = rng.integers(0, 255, (64, 96, 3), dtype=np.uint8)
+                Image.fromarray(img).save(tmp_path / scene / name)
+        out_dir = tmp_path / "out"
+        rc = main(["--restore_ckpt", str(ckpt),
+                   "-l", str(tmp_path / "scene*" / "im0.png"),
+                   "-r", str(tmp_path / "scene*" / "im1.png"),
+                   "--output_directory", str(out_dir), "--valid_iters", "2",
+                   "--n_gru_layers", "2", "--hidden_dims", "32", "32",
+                   "--corr_levels", "2", "--corr_radius", "2"])
+        assert rc == 0
+        assert sorted(p.name for p in out_dir.iterdir()) == [
+            "sceneA.png", "sceneB.png"]
+
+    def test_demo_bad_globs(self, tmp_path):
+        from raftstereo_tpu.cli.demo import main
+        from raftstereo_tpu.models import RAFTStereo
+        from raftstereo_tpu.train.checkpoint import save_weights
+
+        cfg = RAFTStereoConfig(**TINY)
+        variables = RAFTStereo(cfg).init(jax.random.key(0))
+        ckpt = tmp_path / "w"
+        save_weights(str(ckpt), variables)
+        rc = main(["--restore_ckpt", str(ckpt), "-l", str(tmp_path / "no*"),
+                   "-r", str(tmp_path / "no*"),
+                   "--n_gru_layers", "2", "--hidden_dims", "32", "32",
+                   "--corr_levels", "2", "--corr_radius", "2"])
+        assert rc == 1
+
+
+class TestEvaluateCLI:
+    def test_evaluate_kitti_random_weights(self, tmp_path, rng, capsys):
+        from raftstereo_tpu.cli.evaluate import main
+
+        make_synthetic_kitti(tmp_path, n=2, rng=rng)
+        rc = main(["--dataset", "kitti", "--dataset_root", str(tmp_path),
+                   "--valid_iters", "2",
+                   "--n_gru_layers", "2", "--hidden_dims", "32", "32",
+                   "--corr_levels", "2", "--corr_radius", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        results = json.loads(out)
+        assert "kitti-epe" in results and np.isfinite(results["kitti-epe"])
+
+
+class TestSLSmokeCLI:
+    def test_sl_smoke(self, tmp_path):
+        from raftstereo_tpu.cli.sl_smoke import main
+        from test_data import make_synthetic_sl
+
+        make_synthetic_sl(tmp_path)
+        assert main(["--root", str(tmp_path), "--scale", "1.0"]) == 0
+        empty = tmp_path / "empty"
+        os.makedirs(empty)
+        assert main(["--root", str(empty)]) == 1
