@@ -50,6 +50,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save_numpy", action="store_true",
                    help="also save raw disparity as .npy")
     p.add_argument("--valid_iters", type=int, default=32)
+    p.add_argument("--tiled", action="store_true",
+                   help="tile very large images (4K+): fixed-shape tiles "
+                        "streamed through HBM, feather-blended on host "
+                        "(BASELINE.json config #5; use with "
+                        "--corr_implementation alt)")
+    p.add_argument("--tile_size", type=int, nargs=2, default=(1056, 1568),
+                   metavar=("H", "W"), help="tile shape for --tiled")
+    p.add_argument("--tile_overlap", type=int, default=128)
+    p.add_argument("--max_disparity", type=int, default=512,
+                   help="--tiled only: untrusted left strip width per tile")
     add_model_args(p)
     return p
 
@@ -83,14 +93,29 @@ def main(argv=None) -> int:
     if len(set(stems)) != len(stems):
         stems = [f"{i:06d}_{s}" for i, s in enumerate(stems)]
 
+    tiled_fn = None
+    if args.tiled:
+        from ..eval.tiled import tiled_infer
+        tiled_fn = model.jitted_infer(iters=args.valid_iters)
+
     for imfile1, imfile2, stem in zip(left, right, stems):
-        flow = run(load_image(imfile1), load_image(imfile2))
+        if args.tiled:
+            flow = tiled_infer(
+                model, variables, load_image(imfile1), load_image(imfile2),
+                iters=args.valid_iters, tile_hw=tuple(args.tile_size),
+                overlap=args.tile_overlap, disp_margin=args.max_disparity,
+                infer_fn=tiled_fn)
+        else:
+            flow = run(load_image(imfile1), load_image(imfile2))
         disparity = -flow  # positive disparity for output (reference: demo.py:48)
         out = os.path.join(args.output_directory, stem)
         if args.save_numpy:
             np.save(f"{out}.npy", disparity)
         save_disparity_png(f"{out}.png", disparity)
-        logger.info("%s -> %s.png (%.3fs)", imfile1, out, run.last_runtime)
+        if args.tiled:
+            logger.info("%s -> %s.png (tiled)", imfile1, out)
+        else:
+            logger.info("%s -> %s.png (%.3fs)", imfile1, out, run.last_runtime)
     return 0
 
 

@@ -62,6 +62,10 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--num_workers", type=int, default=None)
     g.add_argument("--no_validation", action="store_true",
                    help="skip the periodic FlyingThings validation")
+    g.add_argument("--profile_steps", type=int, nargs=2, default=None,
+                   metavar=("START", "STOP"),
+                   help="capture an XLA profiler trace of steps [START, STOP)"
+                        " into runs/<name>/profile (view in TensorBoard)")
     a = p.add_argument_group("augmentation (reference: train_stereo.py:244-248)")
     a.add_argument("--img_gamma", type=float, nargs=2, default=None)
     a.add_argument("--saturation_range", type=float, nargs=2, default=None)
@@ -86,7 +90,7 @@ def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
 
 def train(model_cfg, cfg: TrainConfig, dataset=None,
           num_workers=None, no_validation: bool = False,
-          dataset_root=None) -> "TrainState":  # noqa: F821
+          dataset_root=None, profile_steps=None) -> "TrainState":  # noqa: F821
     """The training loop; returns the final state.  ``dataset`` injection
     lets tests run the full loop on synthetic data."""
     import jax
@@ -136,6 +140,9 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
     step_fn = jit_train_step(make_train_step(model, tx, cfg, schedule), mesh)
     metrics_logger = Logger(log_dir=os.path.join("runs", cfg.name),
                             total_steps=int(state.step))
+    from ..utils.profiling import StepProfiler
+    prof = StepProfiler(os.path.join("runs", cfg.name, "profile"),
+                        *(profile_steps or (-1, -1)))
 
     def maybe_validate(state):
         if no_validation:
@@ -152,30 +159,39 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
 
     total_steps = int(state.step)
     should_keep_training = total_steps <= cfg.num_steps
-    while should_keep_training:
-        for batch in loader:
-            batch = shard_batch(mesh, batch)
-            state, metrics = step_fn(state, batch)
-            total_steps += 1
-            metrics = {k: float(v) for k, v in metrics.items()}
-            metrics_logger.write_scalar("live_loss", metrics.get("loss", 0.0),
-                                        total_steps)
-            if "lr" in metrics:
-                metrics_logger.write_scalar("lr", metrics["lr"], total_steps)
-            metrics_logger.push(metrics)
+    try:
+        while should_keep_training:
+            for batch in loader:
+                batch = shard_batch(mesh, batch)
+                with prof.step(total_steps):
+                    state, metrics = step_fn(state, batch)
+                total_steps += 1
+                metrics = {k: float(v) for k, v in metrics.items()}
+                metrics_logger.write_scalar("live_loss",
+                                            metrics.get("loss", 0.0),
+                                            total_steps)
+                if "lr" in metrics:
+                    metrics_logger.write_scalar("lr", metrics["lr"],
+                                                total_steps)
+                metrics_logger.push(metrics)
 
-            if total_steps % cfg.validation_frequency == 0:
+                if total_steps % cfg.validation_frequency == 0:
+                    manager.save(total_steps, state)
+                    maybe_validate(state)
+
+                if total_steps > cfg.num_steps:
+                    should_keep_training = False
+                    break
+
+            # Per-epoch checkpoint for very long epochs
+            # (reference: train_stereo.py:202-205).
+            if len(loader) >= 10000:
                 manager.save(total_steps, state)
-                maybe_validate(state)
-
-            if total_steps > cfg.num_steps:
-                should_keep_training = False
-                break
-
-        # Per-epoch checkpoint for very long epochs
-        # (reference: train_stereo.py:202-205).
-        if len(loader) >= 10000:
-            manager.save(total_steps, state)
+    finally:
+        # Flush any in-flight profiler trace even when the loop dies between
+        # profiled steps (the step-internal handler only covers exceptions
+        # raised inside the step itself).
+        prof.close()
 
     manager.save(total_steps, state, wait=True)
     final = os.path.join(ckpt_dir, f"{cfg.name}-final")
@@ -194,7 +210,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     train(model_config_from_args(args), train_config_from_args(args),
           num_workers=args.num_workers, no_validation=args.no_validation,
-          dataset_root=args.dataset_root)
+          dataset_root=args.dataset_root, profile_steps=args.profile_steps)
     return 0
 
 

@@ -1,6 +1,7 @@
 """Evaluation harness: compiled inference runner + benchmark validators."""
 
 from .runner import Evaluator  # noqa: F401
+from .tiled import plan_tiles, tile_weight, tiled_infer  # noqa: F401
 from .validate import (  # noqa: F401
     VALIDATORS,
     validate,

@@ -1,8 +1,8 @@
 """TPU-first primitive ops: sampling, resizing, pooling, correlation, upsampling."""
 
 from .image import (InputPadder, avg_pool2x, avg_pool4x, avg_pool_w2,
-                    coords_grid_x, gauss_blur, replicate_pad,
-                    resize_bilinear_align_corners)
+                    coords_grid_x, forward_interpolate, gauss_blur,
+                    replicate_pad, resize_bilinear_align_corners)
 from .sampler import linear_sample_1d, linear_sample_1d_dense
 from .upsample import convex_upsample, extract_3x3_patches, upsample_interp
 from .corr import (build_corr_pyramid, build_corr_volume, make_alt_corr_fn,
@@ -10,7 +10,8 @@ from .corr import (build_corr_pyramid, build_corr_volume, make_alt_corr_fn,
 
 __all__ = [
     "InputPadder", "avg_pool2x", "avg_pool4x", "avg_pool_w2", "coords_grid_x",
-    "gauss_blur", "replicate_pad", "resize_bilinear_align_corners",
+    "forward_interpolate", "gauss_blur", "replicate_pad",
+    "resize_bilinear_align_corners",
     "linear_sample_1d", "linear_sample_1d_dense",
     "convex_upsample", "extract_3x3_patches", "upsample_interp",
     "build_corr_pyramid", "build_corr_volume", "make_alt_corr_fn",

@@ -106,6 +106,43 @@ def gauss_blur(x: jax.Array, n: int = 5, std: float = 1.0) -> jax.Array:
         feature_group_count=c).astype(x.dtype)
 
 
+def forward_interpolate(flow: np.ndarray) -> np.ndarray:
+    """Forward-warp a flow field for warm-starting the next frame's estimate
+    (reference: core/utils/utils.py:28-56).
+
+    Host-side by design (as in the reference, which moves to CPU first): this
+    runs once per frame between device steps, feeding the model's
+    ``flow_init`` hook.  ``flow`` is (2, H, W) [dx, dy] or (H, W) x-flow only
+    (the stereo case); returns the same shape, float32.  Each source pixel's
+    flow is splatted to where it lands; holes are filled by nearest-neighbour
+    interpolation, out-of-frame splats are dropped.
+    """
+    from scipy import interpolate as _interp
+
+    flow = np.asarray(flow, np.float32)
+    stereo = flow.ndim == 2
+    if stereo:
+        flow = np.stack([flow, np.zeros_like(flow)], axis=0)
+    dx, dy = flow[0], flow[1]
+    ht, wd = dx.shape
+    x0, y0 = np.meshgrid(np.arange(wd), np.arange(ht))
+    x1 = (x0 + dx).reshape(-1)
+    y1 = (y0 + dy).reshape(-1)
+    dxf, dyf = dx.reshape(-1), dy.reshape(-1)
+    valid = (x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht)
+    if not valid.any():
+        out = np.zeros_like(flow)
+        return out[0] if stereo else out
+    pts = (x1[valid], y1[valid])
+    fx = _interp.griddata(pts, dxf[valid], (x0, y0), method="nearest",
+                          fill_value=0)
+    if stereo:
+        return fx.astype(np.float32)
+    fy = _interp.griddata(pts, dyf[valid], (x0, y0), method="nearest",
+                          fill_value=0)
+    return np.stack([fx, fy], axis=0).astype(np.float32)
+
+
 def replicate_pad(x: jax.Array, pad: Sequence[int]) -> jax.Array:
     """Edge-replicate pad; pad = (left, right, top, bottom) on (B, H, W, C)."""
     l, r, t, b = pad

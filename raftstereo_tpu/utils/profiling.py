@@ -1,0 +1,137 @@
+"""Tracing / profiling subsystem.
+
+The reference's only observability is wall-clock FPS in the KITTI evaluator
+(reference: evaluate_stereo.py:77-81,105-107).  The TPU-native equivalent is
+the XLA profiler: device traces viewable in TensorBoard / Perfetto, plus
+host-side step annotations that bracket each training step so device work
+lines up with program phases.  This module wraps ``jax.profiler`` so the train
+CLI (``--profile_steps``) and ad-hoc scripts never import it directly, and
+adds a lightweight wall-clock ``Timer`` for the places where a full trace is
+overkill.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Dict, Iterator, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["trace", "step_annotation", "StepProfiler", "Timer"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA device+host trace into ``log_dir``.
+
+    View with ``tensorboard --logdir <log_dir>`` (Profile tab) or open the
+    generated ``.trace.json.gz`` in Perfetto.  Works on TPU and CPU backends.
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    logger.info("Profiler trace started -> %s", log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("Profiler trace written to %s", log_dir)
+
+
+def step_annotation(name: str, step: int):
+    """Named host annotation that the trace viewer correlates with device ops
+    launched inside it (use around one training step)."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+class StepProfiler:
+    """Trace a window of training steps [start, stop).
+
+    Drives ``trace`` + ``step_annotation`` from a plain per-step ``step()``
+    call so the train loop stays branch-free:
+
+        prof = StepProfiler(log_dir, start=100, stop=105)
+        for i in range(num_steps):
+            with prof.step(i):
+                train_step(...)
+    """
+
+    def __init__(self, log_dir: str, start: int = -1, stop: int = -1):
+        self.log_dir = log_dir
+        self.start, self.stop = start, stop
+        self._active = False
+
+    @property
+    def enabled(self) -> bool:
+        return 0 <= self.start < self.stop
+
+    @contextlib.contextmanager
+    def step(self, i: int) -> Iterator[None]:
+        import jax
+
+        if not self.enabled:
+            yield
+            return
+        # >= not ==: a resumed run whose restored step is already inside (or
+        # past the start of) the window must still trace the remainder.
+        if self.start <= i < self.stop and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            logger.info("Profiling steps [%d, %d) -> %s",
+                        self.start, self.stop, self.log_dir)
+        try:
+            if self._active:
+                with step_annotation("train", i):
+                    yield
+            else:
+                yield
+        except BaseException:
+            # Flush the trace even when the profiled step dies — the data is
+            # most wanted exactly then.
+            self.close()
+            raise
+        if self._active and i >= self.stop - 1:
+            jax.profiler.stop_trace()
+            self._active = False
+            logger.info("Profiler trace written to %s", self.log_dir)
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+class Timer:
+    """Wall-clock segment timer with named accumulators.
+
+        t = Timer()
+        with t("data"): batch = next(it)
+        with t("step"): state, m = train_step(state, batch)
+        t.summary()  # {'data': {'total': ..., 'mean': ..., 'count': N}, ...}
+    """
+
+    def __init__(self):
+        self._acc: Dict[str, List[float]] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {"total": sum(v), "mean": sum(v) / len(v), "count": len(v)}
+            for k, v in self._acc.items() if v
+        }
+
+    def reset(self) -> None:
+        self._acc.clear()

@@ -30,6 +30,20 @@ def rng():
     return np.random.default_rng(1234)
 
 
+@pytest.fixture(scope="session")
+def tiny_model():
+    """Small-but-real model bundle (alt corr: O(H*W) memory, exercised by the
+    tiled-inference path) shared across test modules to amortize compiles."""
+    from raftstereo_tpu import RAFTStereoConfig
+    from raftstereo_tpu.models import RAFTStereo
+
+    cfg = RAFTStereoConfig(corr_implementation="alt", n_gru_layers=2,
+                           hidden_dims=(64, 64), corr_levels=2, corr_radius=3)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(7))
+    return model, variables
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "torch_parity: parity tests against the reference PyTorch code")

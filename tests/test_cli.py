@@ -53,10 +53,13 @@ class TestTrainCLI:
                            checkpoint_dir=str(tmp_path / "ckpt"),
                            data_parallel=2)
         state = train(mcfg, tcfg, dataset=dataset, num_workers=0,
-                      no_validation=True)
+                      no_validation=True, profile_steps=(1, 2))
         assert int(state.step) == 4  # runs to num_steps+1 then stops
         final = tmp_path / "ckpt" / "t" / "t-final"
         assert final.exists()
+        # --profile_steps integration: a trace landed in runs/<name>/profile.
+        prof_dir = tmp_path / "runs" / "t" / "profile"
+        assert any(p.is_file() for p in prof_dir.rglob("*"))
 
         # Resume: manager restores from step 4; loop exits immediately.
         state2 = train(mcfg, tcfg, dataset=dataset, num_workers=0,
@@ -128,6 +131,38 @@ class TestDemoCLI:
             assert png.exists() and npy.exists()
             assert np.asarray(Image.open(png)).shape == (64, 96, 3)
             assert np.load(npy).shape == (64, 96)
+
+    def test_demo_tiled(self, tmp_path, rng):
+        """--tiled end-to-end: glue from argparse through tiled_infer to the
+        saved full-resolution outputs (BASELINE.json config #5 CLI path)."""
+        from raftstereo_tpu.cli.demo import main
+        from raftstereo_tpu.models import RAFTStereo
+        from raftstereo_tpu.train.checkpoint import save_weights
+
+        cfg = RAFTStereoConfig(**TINY, corr_implementation="alt")
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(0))
+        ckpt = tmp_path / "weights"
+        save_weights(str(ckpt), variables)
+
+        for side in ("left", "right"):
+            img = rng.integers(0, 255, (72, 200, 3), dtype=np.uint8)
+            Image.fromarray(img).save(tmp_path / f"0_{side}.png")
+        out_dir = tmp_path / "out"
+        rc = main(["--restore_ckpt", str(ckpt),
+                   "-l", str(tmp_path / "*_left.png"),
+                   "-r", str(tmp_path / "*_right.png"),
+                   "--output_directory", str(out_dir),
+                   "--save_numpy", "--valid_iters", "2",
+                   "--tiled", "--tile_size", "64", "128",
+                   "--tile_overlap", "8", "--max_disparity", "32",
+                   "--corr_implementation", "alt",
+                   "--n_gru_layers", "2", "--hidden_dims", "32", "32",
+                   "--corr_levels", "2", "--corr_radius", "2"])
+        assert rc == 0
+        d = np.load(out_dir / "0_left.npy")
+        assert d.shape == (72, 200)
+        assert np.isfinite(d).all()
 
     def test_demo_colliding_basenames_use_scene_dirs(self, tmp_path, rng):
         # ETH3D-style layout: every left image is im0.png — outputs must not

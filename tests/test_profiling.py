@@ -1,0 +1,89 @@
+"""Profiling subsystem (utils/profiling.py) — SURVEY.md §5 tracing equivalent."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raftstereo_tpu.utils.profiling import StepProfiler, Timer, trace
+
+
+def _work():
+    x = jnp.ones((64, 64))
+    return float(jax.jit(lambda a: (a @ a).sum())(x))
+
+
+class TestTrace:
+    def test_trace_writes_artifacts(self, tmp_path):
+        d = str(tmp_path / "tr")
+        with trace(d):
+            _work()
+        files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+        assert any(os.path.isfile(f) for f in files)
+
+
+class TestStepProfiler:
+    def test_disabled_by_default(self, tmp_path):
+        prof = StepProfiler(str(tmp_path / "p"))
+        assert not prof.enabled
+        for i in range(3):
+            with prof.step(i):
+                _work()
+        assert not os.path.exists(str(tmp_path / "p"))
+
+    def test_window_traced_and_stopped(self, tmp_path):
+        d = str(tmp_path / "p")
+        prof = StepProfiler(d, start=1, stop=3)
+        assert prof.enabled
+        for i in range(5):
+            with prof.step(i):
+                _work()
+        assert not prof._active
+        files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+        assert any(os.path.isfile(f) for f in files)
+
+    def test_resume_inside_window_still_traces(self, tmp_path):
+        """A resumed run whose first step index is already inside [start, stop)
+        must trace the remainder, not silently no-op."""
+        d = str(tmp_path / "p")
+        prof = StepProfiler(d, start=0, stop=10)
+        for i in (7, 8, 9):   # restored step > start
+            with prof.step(i):
+                _work()
+        assert not prof._active
+        files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+        assert any(os.path.isfile(f) for f in files)
+
+    def test_exception_inside_step_flushes_trace(self, tmp_path):
+        prof = StepProfiler(str(tmp_path / "p"), start=0, stop=10)
+        try:
+            with prof.step(0):
+                raise RuntimeError("step died")
+        except RuntimeError:
+            pass
+        assert not prof._active   # trace stopped, not leaked
+
+    def test_close_ends_open_trace(self, tmp_path):
+        prof = StepProfiler(str(tmp_path / "p"), start=0, stop=100)
+        with prof.step(0):
+            _work()
+        assert prof._active
+        prof.close()
+        assert not prof._active
+
+
+class TestTimer:
+    def test_accumulates_named_segments(self):
+        t = Timer()
+        for _ in range(3):
+            with t("a"):
+                np.ones(10).sum()
+        with t("b"):
+            pass
+        s = t.summary()
+        assert s["a"]["count"] == 3 and s["b"]["count"] == 1
+        assert s["a"]["total"] >= s["a"]["mean"] > 0
+        t.reset()
+        assert t.summary() == {}

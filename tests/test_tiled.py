@@ -1,0 +1,133 @@
+"""Tiled large-image inference (eval/tiled.py; BASELINE.json config #5)."""
+
+import numpy as np
+import pytest
+
+from raftstereo_tpu.eval.tiled import plan_tiles, tile_weight, tiled_infer
+
+
+class TestPlanTiles:
+    def test_single_tile_when_tile_covers(self):
+        assert plan_tiles(100, 128, 96) == [0]
+        assert plan_tiles(128, 128, 96) == [0]
+
+    def test_last_tile_aligned_to_end(self):
+        starts = plan_tiles(300, 128, 96)
+        assert starts[0] == 0
+        assert starts[-1] == 300 - 128
+        assert all(s + 128 <= 300 for s in starts)
+
+    def test_full_coverage(self):
+        for size, tile, stride in [(300, 128, 96), (997, 64, 40), (65, 64, 1)]:
+            starts = plan_tiles(size, tile, stride)
+            covered = np.zeros(size, bool)
+            for s in starts:
+                covered[s:s + tile] = True
+            assert covered.all()
+
+    def test_monotonic_unique(self):
+        starts = plan_tiles(1000, 256, 200)
+        assert starts == sorted(set(starts))
+
+
+class TestTileWeight:
+    def test_border_tile_full_weight_at_image_edges(self):
+        w = tile_weight(64, 96, 0, 0, 200, 300, overlap=16, disp_margin=32)
+        assert w[0, 0] == 1.0 and w[0, 50] == 1.0 and w[30, 0] == 1.0
+
+    def test_interior_edges_feathered(self):
+        w = tile_weight(64, 96, 50, 50, 200, 300, overlap=16, disp_margin=0)
+        assert w[0, 48] < 1.0 and w[-1, 48] < 1.0   # y feather both sides
+        assert w[32, 0] < 1.0 and w[32, -1] < 1.0   # x feather both sides
+        assert w[32, 48] == 1.0                     # interior full
+
+    def test_disp_margin_zeroed_only_for_interior_x(self):
+        w0 = tile_weight(64, 96, 0, 0, 200, 300, overlap=8, disp_margin=24)
+        wi = tile_weight(64, 96, 0, 60, 200, 300, overlap=8, disp_margin=24)
+        assert w0[32, 0] == 1.0                 # image-left tile: trusted
+        assert (wi[:, :24] == 0.0).all()        # interior tile: dead strip
+        assert wi[32, 40] > 0.0                 # revives after the strip
+
+
+def _coordinate_infer(th, tw):
+    """Fake infer_fn whose 'disparity' is the tile-local x index; stitching is
+    exact iff tiled_infer adds back the right tile offsets via blending of
+    identical overlapping values."""
+
+    def fn(variables, t1, t2):
+        # t1 carries the global x coordinate in channel 0 (set by the test).
+        up = np.asarray(t1)[..., :1]
+        return None, up
+
+    return fn
+
+
+class _NoModel:
+    def jitted_infer(self, iters):  # pragma: no cover - should not be called
+        raise AssertionError("infer_fn override expected")
+
+
+class TestTiledInfer:
+    def test_stitching_reconstructs_global_field(self):
+        h, w = 100, 400
+        gx = np.broadcast_to(np.arange(w, dtype=np.float32), (h, w))
+        img = np.repeat(gx[:, :, None], 3, axis=2)
+        out = tiled_infer(_NoModel(), {}, img, img, iters=1,
+                          tile_hw=(64, 160), overlap=16, disp_margin=64,
+                          infer_fn=_coordinate_infer(64, 160))
+        assert out.shape == (h, w)
+        np.testing.assert_allclose(out, gx, rtol=0, atol=1e-4)
+
+    def test_progress_callback_and_tile_count(self):
+        h, w = 70, 300
+        img = np.zeros((h, w, 3), np.float32)
+        calls = []
+        tiled_infer(_NoModel(), {}, img, img, iters=1,
+                    tile_hw=(64, 160), overlap=16, disp_margin=64,
+                    infer_fn=_coordinate_infer(64, 160),
+                    callback=lambda d, t: calls.append((d, t)))
+        assert calls and calls[-1][0] == calls[-1][1] == len(calls)
+
+    def test_rejects_overlap_taller_than_tile(self):
+        img = np.zeros((300, 128, 3), np.float32)
+        with pytest.raises(ValueError):
+            tiled_infer(_NoModel(), {}, img, img, tile_hw=(64, 128),
+                        overlap=128, disp_margin=0,
+                        infer_fn=_coordinate_infer(64, 128))
+
+    def test_weight_clamps_oversized_overlap(self):
+        # tile_weight itself must not crash for overlap > tile dims.
+        w = tile_weight(32, 48, 10, 10, 200, 300, overlap=64, disp_margin=0)
+        assert w.shape == (32, 48) and np.isfinite(w).all()
+
+    def test_rejects_tile_narrower_than_margin(self):
+        img = np.zeros((64, 500, 3), np.float32)
+        with pytest.raises(ValueError):
+            tiled_infer(_NoModel(), {}, img, img, tile_hw=(64, 96),
+                        overlap=32, disp_margin=96,
+                        infer_fn=_coordinate_infer(64, 96))
+
+    def test_single_tile_matches_plain_inference(self, tiny_model):
+        """tile >= image: tiled_infer must equal the ordinary forward pass."""
+        import jax
+
+        model, variables = tiny_model
+        rng = np.random.default_rng(3)
+        img1 = rng.integers(0, 255, (64, 96, 3)).astype(np.float32)
+        img2 = rng.integers(0, 255, (64, 96, 3)).astype(np.float32)
+        _, up = model.jitted_infer(iters=3)(
+            variables, img1[None], img2[None])
+        ref = np.asarray(jax.device_get(up))[0, :, :, 0]
+        out = tiled_infer(model, variables, img1, img2, iters=3,
+                          tile_hw=(64, 96), overlap=8, disp_margin=16)
+        np.testing.assert_allclose(out, ref, rtol=0, atol=1e-5)
+
+    def test_multi_tile_shape_and_finite(self, tiny_model):
+        model, variables = tiny_model
+        rng = np.random.default_rng(4)
+        img1 = rng.integers(0, 255, (96, 256, 3)).astype(np.float32)
+        img2 = rng.integers(0, 255, (96, 256, 3)).astype(np.float32)
+        out = tiled_infer(model, variables, img1, img2, iters=2,
+                          tile_hw=(64, 160), overlap=16, disp_margin=48)
+        assert out.shape == (96, 256)
+        assert np.isfinite(out).all()
