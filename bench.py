@@ -29,7 +29,8 @@ METRIC = "stereo-pairs/sec/chip @960x540, 32 GRU iters"
 
 
 def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
-              reps: int, warmup: int, compute_dtype: str) -> float:
+              reps: int, warmup: int, compute_dtype: str,
+              corr_dtype: str = "float32", realtime: bool = False) -> float:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -40,8 +41,15 @@ def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
 
     if corr == "auto":
         corr = "reg" if jax.default_backend() == "cpu" else "pallas"
+    model_kw = {}
+    if realtime:
+        # The reference's realtime configuration (reference: README.md:82-84):
+        # shared backbone, 1/8 disparity field, 2 GRU layers, slow-fast.
+        model_kw = dict(shared_backbone=True, n_downsample=3, n_gru_layers=2,
+                        hidden_dims=(128, 128), slow_fast_gru=True)
     cfg = RAFTStereoConfig(corr_implementation=corr,
-                           compute_dtype=compute_dtype)
+                           compute_dtype=compute_dtype,
+                           corr_dtype=corr_dtype, **model_kw)
     model = RAFTStereo(cfg)
     variables = model.init(jax.random.key(0), (64, 96))
 
@@ -104,19 +112,30 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--iters", type=int, default=32)
     p.add_argument("--corr", default="auto",
-                   choices=["auto", "reg", "alt", "pallas"])
+                   choices=["auto", "reg", "alt", "pallas", "pallas_alt"])
     p.add_argument("--reps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--compute_dtype", default="bfloat16",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--corr_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="correlation volume storage dtype; honoured by the "
+                        "pallas backend only (reg/alt/pallas_alt pin fp32, "
+                        "mirroring the reference's fp32-volume torch paths)")
     p.add_argument("--quick", action="store_true",
                    help="tiny shapes / few reps (CPU development)")
+    p.add_argument("--realtime", action="store_true",
+                   help="benchmark the realtime configuration (shared "
+                        "backbone, n_downsample 3, 2 GRU layers, slow_fast, "
+                        "7 iters — BASELINE.json config #2)")
     p.add_argument("--measure-baseline", action="store_true",
                    help="re-measure the torch reference baseline (slow)")
     args = p.parse_args()
 
     if args.quick:
         args.height, args.width, args.iters, args.reps = 256, 320, 8, 3
+    if args.realtime:
+        args.iters = 7
 
     # The image's site hook imports jax at interpreter startup, freezing the
     # platform before JAX_PLATFORMS from the shell can apply — push it
@@ -126,10 +145,13 @@ def main() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     value = bench_jax(args.height, args.width, args.batch, args.iters,
-                      args.corr, args.reps, args.warmup, args.compute_dtype)
+                      args.corr, args.reps, args.warmup, args.compute_dtype,
+                      args.corr_dtype, realtime=args.realtime)
 
     baseline = None
-    if not args.quick:
+    if not args.quick and not args.realtime:
+        # (--realtime has its own model config; the cached torch baseline is
+        # the flagship config and would not be comparable.)
         if args.measure_baseline or not os.path.exists(BASELINE_CACHE):
             try:
                 bval = measure_torch_baseline(args.height, args.width,
@@ -145,8 +167,12 @@ def main() -> None:
             with open(BASELINE_CACHE) as f:
                 baseline = json.load(f)["pairs_per_sec"]
 
+    metric = METRIC
+    if args.realtime:
+        metric = (f"stereo-pairs/sec/chip @{args.width}x{args.height}, "
+                  f"realtime config, {args.iters} GRU iters")
     print(json.dumps({
-        "metric": METRIC,
+        "metric": metric,
         "value": round(value, 4),
         "unit": "pairs/sec",
         "vs_baseline": round(value / baseline, 4) if baseline else 0.0,

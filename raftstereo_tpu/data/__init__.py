@@ -9,6 +9,8 @@ from .loader import DataLoader, prefetch_to_device
 from .png16 import read_png16, write_png16
 from .sl import (SLCalibration, SLStereoView, StructuredLightDataset,
                  fetch_sl_dataset, modulation)
+from .style import (get_eth3d_images, get_kitti_images,
+                    get_middlebury_images, lab_stats, transfer_color)
 
 __all__ = [
     "codecs", "ColorJitter", "FlowAugmentor", "SparseFlowAugmentor",
@@ -17,4 +19,6 @@ __all__ = [
     "TartanAir", "build_aug_params", "fetch_dataset", "DataLoader",
     "prefetch_to_device", "read_png16", "write_png16", "SLCalibration",
     "StructuredLightDataset", "SLStereoView", "fetch_sl_dataset", "modulation",
+    "get_eth3d_images", "get_kitti_images", "get_middlebury_images",
+    "lab_stats", "transfer_color",
 ]

@@ -1,0 +1,109 @@
+"""LAB-space style transfer + benchmark image-list getters.
+
+Working, dependency-free form of the reference's style-transfer utilities
+(reference: core/utils/augmentor.py:18-45), which rely on scikit-image.  The
+sRGB <-> CIELAB conversions are implemented here directly (D65 white point,
+the same convention skimage uses) so the capability exists without cv2/skimage.
+
+``transfer_color`` re-colors an image to match a style's LAB channel
+statistics: subtract the image's per-channel LAB mean, rescale by the ratio of
+standard deviations, add the style mean, clip L to [0, 100].
+"""
+
+from __future__ import annotations
+
+import os
+from glob import glob
+from typing import List, Tuple
+
+import numpy as np
+
+__all__ = ["rgb2lab", "lab2rgb", "lab_stats", "transfer_color",
+           "get_middlebury_images", "get_eth3d_images", "get_kitti_images"]
+
+# D65 reference white (2-degree observer), as used by skimage.color.
+_WHITE = np.array([0.95047, 1.0, 1.08883])
+_RGB2XYZ = np.array([[0.412453, 0.357580, 0.180423],
+                     [0.212671, 0.715160, 0.072169],
+                     [0.019334, 0.119193, 0.950227]])
+_XYZ2RGB = np.linalg.inv(_RGB2XYZ)
+
+
+def _srgb_to_linear(c: np.ndarray) -> np.ndarray:
+    return np.where(c > 0.04045, ((c + 0.055) / 1.055) ** 2.4, c / 12.92)
+
+
+def _linear_to_srgb(c: np.ndarray) -> np.ndarray:
+    return np.where(c > 0.0031308, 1.055 * c ** (1.0 / 2.4) - 0.055, 12.92 * c)
+
+
+def _f(t: np.ndarray) -> np.ndarray:
+    d = 6.0 / 29.0
+    return np.where(t > d ** 3, np.cbrt(t), t / (3 * d * d) + 4.0 / 29.0)
+
+
+def _finv(t: np.ndarray) -> np.ndarray:
+    d = 6.0 / 29.0
+    return np.where(t > d, t ** 3, 3 * d * d * (t - 4.0 / 29.0))
+
+
+def rgb2lab(rgb: np.ndarray) -> np.ndarray:
+    """(H, W, 3) RGB in [0, 1] (or [0, 255] uint8) -> CIELAB float64."""
+    rgb = np.asarray(rgb)
+    if rgb.dtype == np.uint8:
+        rgb = rgb.astype(np.float64) / 255.0
+    xyz = _srgb_to_linear(rgb.astype(np.float64)) @ _RGB2XYZ.T
+    fxyz = _f(xyz / _WHITE)
+    l = 116.0 * fxyz[..., 1] - 16.0
+    a = 500.0 * (fxyz[..., 0] - fxyz[..., 1])
+    b = 200.0 * (fxyz[..., 1] - fxyz[..., 2])
+    return np.stack([l, a, b], axis=-1)
+
+
+def lab2rgb(lab: np.ndarray) -> np.ndarray:
+    """CIELAB -> (H, W, 3) RGB in [0, 1], clipped."""
+    lab = np.asarray(lab, np.float64)
+    fy = (lab[..., 0] + 16.0) / 116.0
+    fx = fy + lab[..., 1] / 500.0
+    fz = fy - lab[..., 2] / 200.0
+    xyz = np.stack([_finv(fx), _finv(fy), _finv(fz)], axis=-1) * _WHITE
+    rgb = xyz @ _XYZ2RGB.T
+    return np.clip(_linear_to_srgb(np.clip(rgb, 0.0, None)), 0.0, 1.0)
+
+
+def lab_stats(image: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel (mean, std) of an image in LAB — the 'style' statistics."""
+    lab = rgb2lab(image)
+    return (np.mean(lab, axis=(0, 1), keepdims=True),
+            np.std(lab, axis=(0, 1), keepdims=True))
+
+
+def transfer_color(image: np.ndarray, style_mean: np.ndarray,
+                   style_stddev: np.ndarray) -> np.ndarray:
+    """Re-color ``image`` to the style's LAB statistics
+    (reference: core/utils/augmentor.py:30-45).  Returns float RGB in
+    [0, 255] like the reference (which multiplies lab2rgb by 255)."""
+    lab = rgb2lab(image)
+    mean = np.mean(lab, axis=(0, 1), keepdims=True)
+    # Guard constant channels (grayscale images have a == b == const): a zero
+    # std would turn the rescale into inf * 0 = NaN.
+    std = np.maximum(np.std(lab, axis=(0, 1), keepdims=True), 1e-6)
+    out = (style_stddev / std) * (lab - mean) + style_mean
+    out[..., 0] = np.clip(out[..., 0], 0.0, 100.0)
+    return lab2rgb(out) * 255.0
+
+
+def get_middlebury_images(root: str = "datasets/Middlebury/MiddEval3") -> List[str]:
+    """(reference: core/utils/augmentor.py:18-22)"""
+    with open(os.path.join(root, "official_train.txt")) as f:
+        lines = f.read().splitlines()
+    return sorted(os.path.join(root, "trainingQ", name, "im0.png")
+                  for name in lines)
+
+
+def get_eth3d_images(root: str = "datasets/ETH3D") -> List[str]:
+    return sorted(glob(os.path.join(root, "two_view_training", "*", "im0.png")))
+
+
+def get_kitti_images(root: str = "datasets/KITTI") -> List[str]:
+    return sorted(glob(os.path.join(root, "training", "image_2", "*_10.png")))

@@ -5,8 +5,9 @@ from .image import (InputPadder, avg_pool2x, avg_pool4x, avg_pool_w2,
                     replicate_pad, resize_bilinear_align_corners)
 from .sampler import linear_sample_1d, linear_sample_1d_dense
 from .upsample import convex_upsample, extract_3x3_patches, upsample_interp
-from .corr import (build_corr_pyramid, build_corr_volume, make_alt_corr_fn,
-                   make_corr_fn, make_reg_corr_fn)
+from .corr import (build_corr_pyramid, build_corr_volume,
+                   build_fmap2_pyramid, make_alt_corr_fn, make_corr_fn,
+                   make_pallas_alt_corr_fn, make_reg_corr_fn)
 
 __all__ = [
     "InputPadder", "avg_pool2x", "avg_pool4x", "avg_pool_w2", "coords_grid_x",
@@ -14,6 +15,7 @@ __all__ = [
     "resize_bilinear_align_corners",
     "linear_sample_1d", "linear_sample_1d_dense",
     "convex_upsample", "extract_3x3_patches", "upsample_interp",
-    "build_corr_pyramid", "build_corr_volume", "make_alt_corr_fn",
-    "make_corr_fn", "make_reg_corr_fn",
+    "build_corr_pyramid", "build_corr_volume", "build_fmap2_pyramid",
+    "make_alt_corr_fn", "make_corr_fn", "make_pallas_alt_corr_fn",
+    "make_reg_corr_fn",
 ]

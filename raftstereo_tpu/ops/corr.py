@@ -93,27 +93,34 @@ def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
     return corr_fn
 
 
+def build_fmap2_pyramid(fmap2: jax.Array, num_levels: int) -> List[jax.Array]:
+    """Pool fmap2's W axis (axis=2 in NHWC) by 2 per level, floor-halving.
+
+    Pooling fmap2 then correlating equals pooling the correlation volume
+    (both are linear in fmap2), so on-demand backends built on this pyramid
+    match ``reg`` exactly (reference: core/corr.py:104)."""
+    c = fmap2.shape[-1]
+    pyramid = [fmap2]
+    for _ in range(num_levels - 1):
+        f2 = pyramid[-1]
+        w = f2.shape[2]
+        f2 = f2[:, :, : (w // 2) * 2, :]
+        f2 = f2.reshape(f2.shape[0], f2.shape[1], w // 2, 2, c).mean(axis=3)
+        pyramid.append(f2)
+    return pyramid
+
+
 def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                      radius: int) -> CorrFn:
     """On-demand backend: O(H*W) memory, recomputes correlation only at the
     sampled taps (reference: PytorchAlternateCorrBlock1D, core/corr.py:64-107).
-
-    Math is identical to ``reg`` because pooling fmap2 then correlating equals
-    pooling the correlation volume (both are linear in fmap2).
     """
     fmap1 = fmap1.astype(jnp.float32)
     fmap2 = fmap2.astype(jnp.float32)
     c = fmap1.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.float32(c))
 
-    # fmap2 pyramid: pool the W axis (axis=2 in NHWC), floor-halving.
-    f2_pyramid = [fmap2]
-    for _ in range(num_levels - 1):
-        f2 = f2_pyramid[-1]
-        w = f2.shape[2]
-        f2 = f2[:, :, : (w // 2) * 2, :]
-        f2 = f2.reshape(f2.shape[0], f2.shape[1], w // 2, 2, c).mean(axis=3)
-        f2_pyramid.append(f2)
+    f2_pyramid = build_fmap2_pyramid(fmap2, num_levels)
     offsets = _tap_offsets(radius)
 
     def corr_fn(coords: jax.Array) -> jax.Array:
@@ -144,6 +151,29 @@ def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
     return corr_fn
 
 
+def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
+                            num_levels: int, radius: int) -> CorrFn:
+    """On-demand Pallas backend: O(H*W) HBM like ``alt``, but each W1-block's
+    correlation rows are recomputed inside a TPU kernel (MXU matmul + hat
+    reduction in VMEM).  Working form of the reference's dead ``alt_cuda``
+    backend (reference: core/corr.py:159-188 raises NotImplementedError)."""
+    from .pallas_alt import pallas_alt_lookup
+
+    fmap1 = fmap1.astype(jnp.float32)
+    f2_pyramid = build_fmap2_pyramid(fmap2.astype(jnp.float32), num_levels)
+    offsets = _tap_offsets(radius)
+
+    def corr_fn(coords: jax.Array) -> jax.Array:
+        x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
+        out = []
+        for i, f2 in enumerate(f2_pyramid):
+            taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
+            out.append(pallas_alt_lookup(fmap1, f2, taps))
+        return jnp.concatenate(out, axis=-1)
+
+    return corr_fn
+
+
 def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
                  num_levels: int, radius: int, dtype=jnp.float32) -> CorrFn:
     """Backend dispatch (reference: core/raft_stereo.py:90-100)."""
@@ -155,4 +185,6 @@ def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
         from .pallas_corr import pallas_lookup
         return make_reg_corr_fn(fmap1, fmap2, num_levels, radius, dtype=dtype,
                                 lookup=pallas_lookup)
+    if implementation == "pallas_alt":
+        return make_pallas_alt_corr_fn(fmap1, fmap2, num_levels, radius)
     raise ValueError(f"unknown corr implementation: {implementation}")

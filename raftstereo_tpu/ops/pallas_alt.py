@@ -1,0 +1,192 @@
+"""Pallas TPU kernel for ON-DEMAND correlation lookup (no precomputed volume).
+
+The reference gestures at this capability twice and ships it broken/slow:
+``AlternateCorrBlock`` (``alt_cuda``) raises NotImplementedError and its CUDA
+extension is absent (reference: core/corr.py:159-188), while the pure-torch
+``alt`` path works but is documented as significantly slower
+(reference: README.md:121).  This module is the working TPU form.
+
+Design: the correlation row for a block of W1 pixels is
+
+    M[x1, j] = <fmap1[x1, :], fmap2[j, :]> / sqrt(C)
+
+— a (blk x C) @ (C x W2) matmul that fits in VMEM and runs on the MXU.  Each
+kernel invocation recomputes its block's rows on the fly, applies the same
+hat-weight tap reduction as the precomputed-volume kernel (ops/pallas_corr.py)
+and throws the rows away: HBM never holds more than the O(H*W) feature
+pyramids, yet the inner loop is MXU matmul + VPU reduction instead of the
+XLA gather chain the ``alt`` backend lowers to.
+
+Backward (for completeness/training) fuses the volume-gradient expansion with
+the feature-gradient matmuls per block:
+
+    dM[x1, j]   = sum_k g[x1, k] * hat(j - t_k(x1)) * scale
+    dfmap1      = dM @ fmap2            (per block, written directly)
+    dfmap2     += dM^T @ fmap1_block    (accumulated across W1 blocks in the
+                                         output block, relying on the TPU
+                                         grid's sequential iteration order)
+
+so the O(W1*W2) gradient also never reaches HBM.  Tap gradients are hard
+zeros (disparity is detached every iteration; reference: core/raft_stereo.py:109).
+Supports fp32 and bf16 feature maps; accumulation is always fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_corr import _block_w1, _interpret, _pad_w1
+
+
+def _alt_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale):
+    """One (n, w1-block): out[x1, k] = sum_j M[x1, j] * hat(j - taps[x1, k])."""
+    f1 = f1_ref[0].astype(jnp.float32)            # (blk, C)
+    f2 = f2_ref[0].astype(jnp.float32)            # (W2, C)
+    taps = taps_ref[0].astype(jnp.float32)        # (blk, K)
+    m = jax.lax.dot_general(f1, f2, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST) * scale
+    w2 = f2.shape[0]
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, w2), 1).astype(jnp.float32)
+    cols = []
+    for ki in range(taps.shape[-1]):              # K is small (9): unrolled
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
+        cols.append(jnp.sum(m * w, axis=-1))
+    out_ref[0] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
+
+
+def _alt_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
+                    scale):
+    f1 = f1_ref[0].astype(jnp.float32)            # (blk, C)
+    f2 = f2_ref[0].astype(jnp.float32)            # (W2, C)
+    taps = taps_ref[0].astype(jnp.float32)        # (blk, K)
+    g = g_ref[0].astype(jnp.float32)              # (blk, K)
+    w2 = f2.shape[0]
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, w2), 1).astype(jnp.float32)
+    dm = jnp.zeros((taps.shape[0], w2), jnp.float32)
+    for ki in range(taps.shape[-1]):
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
+        dm = dm + g[:, ki][:, None] * w
+    dm = dm * scale
+    df1_ref[0] = jax.lax.dot_general(
+        dm, f2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST).astype(df1_ref.dtype)
+
+    # dfmap2 accumulates over all W1 blocks of this row; the W1-block index is
+    # the innermost grid dimension, so iterations land here sequentially.
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        df2_ref[0] = jnp.zeros_like(df2_ref[0])
+
+    df2_ref[0] += jax.lax.dot_general(
+        dm, f1, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST).astype(df2_ref.dtype)
+
+
+def pallas_alt_lookup(fmap1: jax.Array, fmap2: jax.Array,
+                      taps: jax.Array) -> jax.Array:
+    """On-demand correlation at the given taps.
+
+    fmap1: (B, H, W1, C); fmap2: (B, H, W2, C) (same level resolution);
+    taps: (B, H, W1, K) absolute x-coordinates into W2.
+    Returns (B, H, W1, K) float32, scaled by 1/sqrt(C), zero outside
+    [0, W2-1], align-corners linear interpolation — the exact semantics of
+    the ``reg``/``alt`` backends (cross-checked in tests/test_pallas_alt.py).
+    """
+    return _make_alt(fmap1.shape, fmap2.shape, fmap1.dtype.name,
+                     fmap2.dtype.name)(fmap1, fmap2, taps)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_alt(f1_shape, f2_shape, f1_dtype, f2_dtype):
+    @jax.custom_vjp
+    def f(fmap1, fmap2, taps):
+        return _alt_fwd_impl(fmap1, fmap2, taps)
+
+    def fwd(fmap1, fmap2, taps):
+        return _alt_fwd_impl(fmap1, fmap2, taps), (fmap1, fmap2, taps)
+
+    def bwd(res, g):
+        fmap1, fmap2, taps = res
+        df1, df2 = _alt_bwd_impl(fmap1, fmap2, taps, g)
+        return (df1.astype(f1_dtype), df2.astype(f2_dtype),
+                jnp.zeros_like(taps))
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _prep(fmap1, fmap2, taps):
+    b, h, w1, c = fmap1.shape
+    w2 = fmap2.shape[2]
+    kk = taps.shape[-1]
+    blk = _block_w1(w1)
+    f1 = fmap1.reshape(b * h, w1, c)
+    f2 = fmap2.reshape(b * h, w2, c)
+    t = taps.reshape(b * h, w1, kk)
+    f1, _ = _pad_w1(f1, blk)
+    t, _ = _pad_w1(t, blk)
+    return f1, f2, t, blk, (b, h, w1, w2, c, kk)
+
+
+def _alt_fwd_impl(fmap1, fmap2, taps):
+    f1, f2, t, blk, (b, h, w1, w2, c, kk) = _prep(fmap1, fmap2, taps)
+    n, w1p = f1.shape[0], f1.shape[1]
+    scale = 1.0 / float(c) ** 0.5
+    out = pl.pallas_call(
+        functools.partial(_alt_fwd_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((n, w1p, kk), jnp.float32),
+        grid=(n, w1p // blk),
+        in_specs=[
+            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w2, c), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(f1, f2, t)
+    return out[:, :w1].reshape(b, h, w1, kk)
+
+
+def _alt_bwd_impl(fmap1, fmap2, taps, g):
+    f1, f2, t, blk, (b, h, w1, w2, c, kk) = _prep(fmap1, fmap2, taps)
+    gg = g.reshape(b * h, w1, kk)
+    gg, _ = _pad_w1(gg, blk)      # zero-padded: padded rows contribute nothing
+    n, w1p = f1.shape[0], f1.shape[1]
+    scale = 1.0 / float(c) ** 0.5
+    df1, df2 = pl.pallas_call(
+        functools.partial(_alt_bwd_kernel, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((n, w1p, c), jnp.float32),
+                   jax.ShapeDtypeStruct((n, w2, c), jnp.float32)),
+        grid=(n, w1p // blk),
+        in_specs=[
+            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w2, c), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w2, c), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(f1, f2, t, gg)
+    return (df1[:, :w1].reshape(b, h, w1, c),
+            df2.reshape(b, h, w2, c))

@@ -1,0 +1,107 @@
+"""Multi-host (multi-process) runtime: the distributed backend.
+
+The reference has no multi-node support at all — its only parallelism is
+single-process ``nn.DataParallel`` (reference: train_stereo.py:135; SURVEY.md
+§2.7).  The TPU-native distributed story needs no hand-written NCCL/MPI layer:
+every collective is emitted by XLA from sharding annotations and rides ICI
+within a slice and DCN across slices.  What IS needed host-side, and lives
+here, is:
+
+* process-group bring-up (``initialize``) — JAX's coordinator handshake,
+  auto-configured on TPU pods, explicit host/rank wiring elsewhere;
+* per-process input feeding — each host loads only its shard of the global
+  batch and assembles a global jax.Array from process-local data.
+
+Single-process runs (tests, one chip) pass through unchanged: ``initialize``
+is a no-op without peer configuration and the feeding helpers degrade to
+``device_put``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["initialize", "is_multiprocess", "process_local_batch",
+           "global_batch_from_local"]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up the JAX process group (idempotent).
+
+    On TPU pods all three arguments come from the environment and may be left
+    ``None`` (jax.distributed autodetects); on CPU/GPU clusters pass them
+    explicitly.  Calling with everything ``None`` outside a managed TPU/SLURM
+    environment is a silent no-op so single-host entry points need no guard.
+    """
+    global _initialized
+    if _initialized or jax.process_count() > 1:
+        _initialized = True
+        return
+    if (coordinator_address is None and num_processes is None
+            and process_id is None):
+        import os
+        managed = any(v in os.environ for v in
+                      ("TPU_WORKER_HOSTNAMES", "TPU_SKYLARK_HOSTS",
+                       "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID"))
+        if not managed:
+            return
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        # Too late (XLA backend already up — e.g. library imported and used
+        # before the entry point ran) or coordinator handshake failed.
+        # Single-host work proceeds; multi-host callers see the warning.
+        logger.warning("distributed init skipped: %s", e)
+        return
+    _initialized = True
+    logger.info("distributed: process %d/%d, %d local / %d global devices",
+                jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count())
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def process_local_batch(global_batch_size: int) -> Tuple[int, int]:
+    """(local_batch_size, sample_offset) for this process.
+
+    Each host's loader reads only its contiguous slice of the global batch —
+    the multi-host replacement for the reference's single-process DataLoader.
+    """
+    n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{n} processes")
+    local = global_batch_size // n
+    return local, jax.process_index() * local
+
+
+def global_batch_from_local(mesh: Mesh, local_batch):
+    """Assemble global, ``data``-sharded jax.Arrays from each process's local
+    shard (tuple of host arrays with leading local-batch axis).
+
+    Multi-host: wraps ``jax.make_array_from_process_local_data`` so no host
+    ever materialises the global batch.  Single-host: plain sharded
+    device_put (bitwise-identical layout, same code path for callers).
+    """
+    s = NamedSharding(mesh, P(DATA_AXIS))
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, s), local_batch)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(s, x), local_batch)

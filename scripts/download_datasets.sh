@@ -1,0 +1,31 @@
+#!/bin/bash
+# Fetch the Middlebury (MiddEval3 Q/H/F + GT) and ETH3D two-view benchmark
+# data into datasets/ — the layout raftstereo_tpu.data.datasets expects
+# (same public sources as the reference's download_datasets.sh).
+set -e
+
+mkdir -p datasets/Middlebury
+pushd datasets/Middlebury
+wget https://www.dropbox.com/s/fn8siy5muak3of3/official_train.txt -P MiddEval3/
+for res in Q H F; do
+  wget "https://vision.middlebury.edu/stereo/submit3/zip/MiddEval3-data-${res}.zip"
+  unzip "MiddEval3-data-${res}.zip"
+  wget "https://vision.middlebury.edu/stereo/submit3/zip/MiddEval3-GT0-${res}.zip"
+  unzip "MiddEval3-GT0-${res}.zip"
+done
+rm -f ./*.zip
+popd
+
+mkdir -p datasets/ETH3D/two_view_testing
+pushd datasets/ETH3D/two_view_testing
+wget https://www.eth3d.net/data/two_view_test.7z
+7za x two_view_test.7z || echo "install p7zip to extract two_view_test.7z"
+popd
+
+mkdir -p datasets/ETH3D/two_view_training
+pushd datasets/ETH3D/two_view_training
+wget https://www.eth3d.net/data/two_view_training.7z
+7za x two_view_training.7z || echo "install p7zip to extract two_view_training.7z"
+wget https://www.eth3d.net/data/two_view_training_gt.7z
+7za x two_view_training_gt.7z || echo "install p7zip to extract two_view_training_gt.7z"
+popd

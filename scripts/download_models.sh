@@ -1,0 +1,11 @@
+#!/bin/bash
+# Fetch the released RAFT-Stereo checkpoints (same public archive the
+# reference uses: download_models.sh in the upstream repo).  The .pth files
+# load directly via --restore_ckpt (converted to JAX pytrees on load,
+# raftstereo_tpu/utils/convert.py).
+set -e
+mkdir -p models
+cd models
+wget https://www.dropbox.com/s/q4312z8g5znhhkp/models.zip
+unzip models.zip
+rm -f models.zip

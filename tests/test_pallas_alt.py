@@ -1,0 +1,141 @@
+"""On-demand Pallas correlation backend (ops/pallas_alt.py) vs the alt/reg
+oracles (interpret mode on CPU).
+
+The kernel recomputes correlation rows per W1-block instead of reading a
+precomputed volume; since pooling fmap2 commutes with correlating, its output
+must match both ``alt`` (same pyramid) and ``reg`` (pooled volume) exactly
+(SURVEY.md §4.3: redundant implementations as oracles)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raftstereo_tpu.ops import coords_grid_x, make_corr_fn
+from raftstereo_tpu.ops.pallas_alt import pallas_alt_lookup
+
+
+@pytest.fixture
+def fmaps(rng):
+    f1 = rng.standard_normal((2, 3, 40, 32)).astype(np.float32)
+    f2 = rng.standard_normal((2, 3, 40, 32)).astype(np.float32)
+    return jnp.asarray(f1), jnp.asarray(f2)
+
+
+@pytest.fixture
+def coords(rng):
+    x = coords_grid_x(2, 3, 40)
+    return x - jnp.asarray(rng.uniform(0, 12, (2, 3, 40, 1)).astype(np.float32))
+
+
+class TestForward:
+    def test_matches_alt_and_reg(self, fmaps, coords):
+        f1, f2 = fmaps
+        outs = {impl: np.asarray(make_corr_fn(impl, f1, f2, 4, 4)(coords))
+                for impl in ("reg", "alt", "pallas_alt")}
+        np.testing.assert_allclose(outs["pallas_alt"], outs["alt"],
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(outs["pallas_alt"], outs["reg"],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_under_jit(self, fmaps, coords):
+        f1, f2 = fmaps
+        fn = jax.jit(lambda c: make_corr_fn("pallas_alt", f1, f2, 2, 3)(c))
+        want = make_corr_fn("alt", f1, f2, 2, 3)(coords)
+        np.testing.assert_allclose(np.asarray(fn(coords)), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_oob_taps_zero(self, fmaps):
+        f1, f2 = fmaps
+        taps = jnp.full((2, 3, 40, 9), 1e6, jnp.float32)
+        out = np.asarray(pallas_alt_lookup(f1, f2, taps))
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_bf16_fmaps(self, fmaps, coords):
+        f1, f2 = fmaps
+        taps = jnp.broadcast_to(coords[..., 0:1], (2, 3, 40, 5))
+        got = pallas_alt_lookup(f1.astype(jnp.bfloat16),
+                                f2.astype(jnp.bfloat16), taps)
+        want = pallas_alt_lookup(f1.astype(jnp.bfloat16).astype(jnp.float32),
+                                 f2.astype(jnp.bfloat16).astype(jnp.float32),
+                                 taps)
+        assert got.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_non_block_aligned_w1(self, rng):
+        f1 = jnp.asarray(rng.standard_normal((1, 2, 10, 16)).astype(np.float32))
+        f2 = jnp.asarray(rng.standard_normal((1, 2, 13, 16)).astype(np.float32))
+        taps = jnp.asarray(rng.uniform(-2, 15, (1, 2, 10, 7)).astype(np.float32))
+        got = np.asarray(pallas_alt_lookup(f1, f2, taps))
+        assert got.shape == (1, 2, 10, 7)
+        # Oracle: explicit volume + linear sampling.
+        from raftstereo_tpu.ops import build_corr_volume, linear_sample_1d
+        vol = build_corr_volume(f1, f2)
+        want = np.asarray(linear_sample_1d(vol, taps))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestBackward:
+    def test_fmap_grads_match_alt_backend(self, fmaps, coords):
+        """d/dfmap of the summed correlation must match the XLA alt path."""
+        f1, f2 = fmaps
+
+        def loss(impl, a, b):
+            return jnp.sum(make_corr_fn(impl, a, b, 3, 3)(coords) ** 2)
+
+        g_alt = jax.grad(lambda a, b: loss("alt", a, b), argnums=(0, 1))(f1, f2)
+        g_pal = jax.grad(lambda a, b: loss("pallas_alt", a, b),
+                         argnums=(0, 1))(f1, f2)
+        for ga, gp in zip(g_alt, g_pal):
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(ga),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_taps_grad_is_zero(self, fmaps):
+        f1, f2 = fmaps
+        taps = jnp.full((2, 3, 40, 5), 7.3, jnp.float32)
+        g = jax.grad(lambda t: jnp.sum(pallas_alt_lookup(f1, f2, t)))(taps)
+        np.testing.assert_allclose(np.asarray(g), 0.0)
+
+    def test_grad_accumulation_across_blocks(self, rng):
+        """W1 spans multiple blocks: the df2 accumulation over the innermost
+        grid dimension must sum every block's contribution exactly once."""
+        from raftstereo_tpu.ops import pallas_corr as pc
+        old = pc._BLOCK_W1
+        f1 = jnp.asarray(rng.standard_normal((1, 1, 40, 16)).astype(np.float32))
+        f2 = jnp.asarray(rng.standard_normal((1, 1, 24, 16)).astype(np.float32))
+        taps = jnp.asarray(rng.uniform(0, 23, (1, 1, 40, 3)).astype(np.float32))
+
+        def loss(b):
+            return jnp.sum(pallas_alt_lookup(f1, b, taps) ** 2)
+
+        try:
+            pc._BLOCK_W1 = 8   # force 5 blocks over W1=40
+            from raftstereo_tpu.ops.pallas_alt import _make_alt
+            _make_alt.cache_clear()
+            got = jax.grad(loss)(f2)
+        finally:
+            pc._BLOCK_W1 = old
+            _make_alt.cache_clear()
+        want = jax.grad(loss)(f2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestModelIntegration:
+    def test_forward_matches_alt_model(self, rng):
+        from raftstereo_tpu import RAFTStereoConfig
+        from raftstereo_tpu.models import RAFTStereo
+
+        kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                  corr_radius=3)
+        m_alt = RAFTStereo(RAFTStereoConfig(corr_implementation="alt", **kw))
+        m_pal = RAFTStereo(
+            RAFTStereoConfig(corr_implementation="pallas_alt", **kw))
+        variables = m_alt.init(jax.random.key(0))
+        i1 = jnp.asarray(rng.uniform(0, 255, (1, 32, 64, 3)).astype(np.float32))
+        i2 = jnp.asarray(rng.uniform(0, 255, (1, 32, 64, 3)).astype(np.float32))
+        out_alt = m_alt.forward(variables, i1, i2, iters=2)
+        out_pal = m_pal.forward(variables, i1, i2, iters=2)
+        np.testing.assert_allclose(np.asarray(out_pal), np.asarray(out_alt),
+                                   rtol=1e-4, atol=1e-4)

@@ -1,0 +1,66 @@
+"""Parallel layer: mesh construction + multi-host helpers (single-process
+semantics on the virtual 8-device CPU mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+from raftstereo_tpu.parallel import (DATA_AXIS, SPACE_AXIS, batch_sharded,
+                                     global_batch_from_local, initialize,
+                                     is_multiprocess, make_mesh,
+                                     process_local_batch, replicated,
+                                     shard_batch, spatial_sharded)
+
+
+class TestMesh:
+    def test_default_uses_all_devices(self):
+        mesh = make_mesh()
+        assert mesh.shape[DATA_AXIS] == jax.device_count()
+        assert mesh.shape[SPACE_AXIS] == 1
+
+    def test_data_x_space(self):
+        mesh = make_mesh(data=4, space=2)
+        assert dict(mesh.shape) == {DATA_AXIS: 4, SPACE_AXIS: 2}
+
+    def test_oversubscription_rejected(self):
+        with pytest.raises(ValueError):
+            make_mesh(data=jax.device_count() + 1)
+
+    def test_shard_batch_places_on_data_axis(self):
+        mesh = make_mesh(data=4)
+        batch = (np.zeros((8, 6, 6, 3), np.float32),
+                 np.zeros((8, 6, 6), np.float32))
+        out = shard_batch(mesh, batch)
+        for x in out:
+            assert x.sharding == batch_sharded(mesh)
+
+    def test_sharding_specs(self):
+        mesh = make_mesh(data=2, space=2)
+        assert replicated(mesh).spec == jax.sharding.PartitionSpec()
+        assert batch_sharded(mesh).spec == jax.sharding.PartitionSpec(DATA_AXIS)
+        assert spatial_sharded(mesh).spec == jax.sharding.PartitionSpec(
+            None, SPACE_AXIS)
+
+
+class TestDistributed:
+    def test_initialize_noop_single_host(self):
+        # No coordinator config, no managed-cluster env: must not raise and
+        # must not tear down the existing runtime.
+        initialize()
+        assert jax.device_count() >= 1
+        assert not is_multiprocess()
+
+    def test_process_local_batch_single(self):
+        local, offset = process_local_batch(8)
+        assert (local, offset) == (8, 0)
+
+    def test_process_local_batch_indivisible(self):
+        # With 1 process everything divides; the check still guards the API.
+        assert process_local_batch(7) == (7, 0)
+
+    def test_global_batch_from_local_single_host(self):
+        mesh = make_mesh(data=4)
+        batch = (np.arange(8 * 4, dtype=np.float32).reshape(8, 4),)
+        (out,) = global_batch_from_local(mesh, batch)
+        assert out.sharding == batch_sharded(mesh)
+        np.testing.assert_array_equal(np.asarray(out), batch[0])

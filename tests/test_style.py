@@ -1,0 +1,67 @@
+"""LAB style transfer (data/style.py; reference: core/utils/augmentor.py:18-45)."""
+
+import numpy as np
+import pytest
+
+from raftstereo_tpu.data.style import (get_middlebury_images, lab2rgb,
+                                       lab_stats, rgb2lab, transfer_color)
+
+
+class TestLabConversion:
+    def test_known_values(self):
+        # White -> L=100, a=b=0; black -> all zeros (CIELAB definition).
+        white = rgb2lab(np.ones((1, 1, 3)))
+        np.testing.assert_allclose(white[0, 0], [100.0, 0.0, 0.0], atol=1e-2)
+        black = rgb2lab(np.zeros((1, 1, 3)))
+        np.testing.assert_allclose(black[0, 0], [0.0, 0.0, 0.0], atol=1e-2)
+        # Pure sRGB red (checked against skimage.color.rgb2lab output).
+        red = rgb2lab(np.array([[[1.0, 0.0, 0.0]]]))
+        np.testing.assert_allclose(red[0, 0], [53.24, 80.09, 67.20], atol=0.05)
+
+    def test_round_trip(self, rng):
+        img = rng.uniform(0, 1, (16, 20, 3))
+        back = lab2rgb(rgb2lab(img))
+        np.testing.assert_allclose(back, img, atol=1e-6)
+
+    def test_uint8_input(self, rng):
+        img8 = rng.integers(0, 256, (8, 8, 3), dtype=np.uint8)
+        a = rgb2lab(img8)
+        b = rgb2lab(img8.astype(np.float64) / 255.0)
+        np.testing.assert_allclose(a, b)
+
+
+class TestTransferColor:
+    def test_output_matches_style_stats(self, rng):
+        img = rng.uniform(0.2, 0.8, (32, 40, 3))
+        style = rng.uniform(0, 1, (24, 24, 3))
+        s_mean, s_std = lab_stats(style)
+        out = transfer_color(img, s_mean, s_std)
+        assert out.shape == img.shape
+        assert out.min() >= 0.0 and out.max() <= 255.0
+        # The transferred image's LAB stats match the style's (up to the
+        # L-channel clip and the RGB gamut clip).
+        o_mean, o_std = lab_stats(out / 255.0)
+        np.testing.assert_allclose(o_mean, s_mean, atol=2.0)
+        np.testing.assert_allclose(o_std, s_std, atol=2.0)
+
+    def test_grayscale_image_no_nan(self, rng):
+        """Constant a/b channels (grayscale) must not divide by zero std."""
+        gray = np.tile(rng.uniform(0, 1, (12, 12, 1)), (1, 1, 3))
+        style = rng.uniform(0, 1, (8, 8, 3))
+        out = transfer_color(gray, *lab_stats(style))
+        assert np.isfinite(out).all()
+
+    def test_identity_style_is_near_noop(self, rng):
+        img = rng.uniform(0.1, 0.9, (16, 16, 3))
+        mean, std = lab_stats(img)
+        out = transfer_color(img, mean, std)
+        np.testing.assert_allclose(out / 255.0, img, atol=1e-4)
+
+
+def test_middlebury_list_getter(tmp_path):
+    root = tmp_path / "MiddEval3"
+    (root / "trainingQ" / "Adiron").mkdir(parents=True)
+    (root / "trainingQ" / "Teddy").mkdir(parents=True)
+    (root / "official_train.txt").write_text("Teddy\nAdiron\n")
+    paths = get_middlebury_images(str(root))
+    assert [p.split("/")[-2] for p in paths] == ["Adiron", "Teddy"]
