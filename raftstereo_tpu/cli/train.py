@@ -66,6 +66,12 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    metavar=("START", "STOP"),
                    help="capture an XLA profiler trace of steps [START, STOP)"
                         " into runs/<name>/profile (view in TensorBoard)")
+    g.add_argument("--nan_policy", choices=["abort", "skip"], default="abort",
+                   help="non-finite loss/grad: abort (reference assert "
+                        "semantics) or skip the update and continue")
+    g.add_argument("--max_restarts", type=int, default=0,
+                   help="auto-restart the loop from the latest checkpoint "
+                        "this many times after a crash (elastic recovery)")
     a = p.add_argument_group("augmentation (reference: train_stereo.py:244-248)")
     a.add_argument("--img_gamma", type=float, nargs=2, default=None)
     a.add_argument("--saturation_range", type=float, nargs=2, default=None)
@@ -85,7 +91,8 @@ def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
         checkpoint_dir=args.checkpoint_dir, restore_ckpt=args.restore_ckpt,
         img_gamma=args.img_gamma, saturation_range=args.saturation_range,
         do_flip=args.do_flip, spatial_scale=tuple(args.spatial_scale),
-        noyjitter=args.noyjitter, data_parallel=args.data_parallel)
+        noyjitter=args.noyjitter, data_parallel=args.data_parallel,
+        nan_policy=args.nan_policy, max_restarts=args.max_restarts)
 
 
 def train(model_cfg, cfg: TrainConfig, dataset=None,
@@ -108,15 +115,23 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
 
     ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.name)
     manager = CheckpointManager(ckpt_dir, keep=cfg.keep_checkpoints)
-    state = create_train_state(model, jax.random.key(cfg.seed), tx,
-                               image_hw=cfg.image_size)
-    if manager.latest_step() is not None:
-        state = manager.restore(state)
-        logger.info("Resumed from step %d in %s", int(state.step), ckpt_dir)
-    elif cfg.restore_ckpt:
-        variables = load_variables(cfg.restore_ckpt, model_cfg, model)
-        state = state_from_variables(variables, tx)
-        logger.info("Initialised weights from %s", cfg.restore_ckpt)
+
+    def init_state():
+        """Latest checkpoint > --restore_ckpt weights > fresh init.  Also the
+        recovery path after a crash (--max_restarts)."""
+        state = create_train_state(model, jax.random.key(cfg.seed), tx,
+                                   image_hw=cfg.image_size)
+        if manager.latest_step() is not None:
+            state = manager.restore(state)
+            logger.info("Resumed from step %d in %s", int(state.step),
+                        ckpt_dir)
+        elif cfg.restore_ckpt:
+            variables = load_variables(cfg.restore_ckpt, model_cfg, model)
+            state = state_from_variables(variables, tx)
+            logger.info("Initialised weights from %s", cfg.restore_ckpt)
+        return state
+
+    state = init_state()
     logger.info("The model has %.2fM learnable parameters.",
                 count_parameters({"params": state.params}) / 1e6)
 
@@ -157,9 +172,9 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
         logger.info("Validation: %s", results)
         metrics_logger.write_dict(results)
 
-    total_steps = int(state.step)
-    should_keep_training = total_steps <= cfg.num_steps
-    try:
+    def run_loop(state):
+        total_steps = int(state.step)
+        should_keep_training = total_steps <= cfg.num_steps
         while should_keep_training:
             for batch in loader:
                 batch = shard_batch(mesh, batch)
@@ -167,6 +182,13 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                     state, metrics = step_fn(state, batch)
                 total_steps += 1
                 metrics = {k: float(v) for k, v in metrics.items()}
+                if metrics.pop("nonfinite", 0.0) >= 0.5:
+                    if cfg.nan_policy == "abort":
+                        # Reference assert semantics (train_stereo.py:49-52).
+                        raise FloatingPointError(
+                            f"non-finite loss/gradient at step {total_steps}")
+                    logger.warning("step %d: non-finite loss/gradient — "
+                                   "update skipped", total_steps)
                 metrics_logger.write_scalar("live_loss",
                                             metrics.get("loss", 0.0),
                                             total_steps)
@@ -187,13 +209,36 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
             # (reference: train_stereo.py:202-205).
             if len(loader) >= 10000:
                 manager.save(total_steps, state)
+        return state
+
+    restarts = 0
+    try:
+        while True:
+            try:
+                state = run_loop(state)
+                break
+            except (KeyboardInterrupt, FloatingPointError):
+                # FloatingPointError = nan_policy abort: deterministic given
+                # the data — replaying from a checkpoint would hit it again.
+                raise
+            except Exception as e:
+                # Elastic recovery: resume from the latest checkpoint
+                # (the reference's only recovery is a manual restart with
+                # --restore_ckpt, train_stereo.py:143-148).
+                if restarts >= cfg.max_restarts:
+                    raise
+                restarts += 1
+                logger.warning("training loop failed (%s); restart %d/%d",
+                               e, restarts, cfg.max_restarts)
+                state = init_state()
+                logger.info("restarted at step %d", int(state.step))
     finally:
         # Flush any in-flight profiler trace even when the loop dies between
         # profiled steps (the step-internal handler only covers exceptions
         # raised inside the step itself).
         prof.close()
 
-    manager.save(total_steps, state, wait=True)
+    manager.save(int(state.step), state, wait=True)
     final = os.path.join(ckpt_dir, f"{cfg.name}-final")
     save_weights(final, state.variables)
     logger.info("Saved final weights to %s", final)

@@ -102,7 +102,17 @@ class TrainConfig:
     # mesh axis); None = all visible devices.
     data_parallel: Optional[int] = None
 
+    # Failure handling.  "abort": raise on a non-finite loss/gradient (the
+    # reference's assert behaviour, train_stereo.py:49-52); "skip": drop the
+    # bad update on-device, keep params/optimizer unchanged, advance the
+    # schedule (the GradScaler-skip behaviour of torch AMP).
+    nan_policy: str = "abort"
+    # Auto-restart-from-checkpoint budget for the train loop (elastic
+    # recovery; the reference's only recovery is a manual --restore_ckpt).
+    max_restarts: int = 0
+
     def __post_init__(self):
+        assert self.nan_policy in ("abort", "skip"), self.nan_policy
         for f in ("train_datasets", "image_size", "spatial_scale"):
             v = getattr(self, f)
             if isinstance(v, list):

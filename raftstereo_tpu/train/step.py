@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Callable, Dict, Tuple
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from ..config import TrainConfig
@@ -39,9 +40,35 @@ def make_train_step(model, tx, cfg: TrainConfig,
         img1, img2, disp_gt, valid = batch
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, img1, img2, disp_gt, valid)
+        grad_norm = optax.global_norm(grads)
+        # Failure detection (reference asserts on this, train_stereo.py:49-52).
+        # A finite global norm implies every gradient entry is finite.
+        finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        metrics = dict(metrics, loss=loss, grad_norm=optax.global_norm(grads))
+        if cfg.nan_policy == "skip":
+            # Drop the bad update on-device: params and optimizer moments keep
+            # their old values, but the LR-schedule count still advances —
+            # torch semantics, where GradScaler skips optimizer.step() while
+            # the loop's scheduler.step() runs unconditionally
+            # (reference: train_stereo.py:175-180).
+            keep = lambda new, old: jnp.where(finite, new, old)
+
+            def merge(new, old):
+                if isinstance(new, optax.ScaleByScheduleState):
+                    return new                      # schedule count advances
+                if hasattr(new, "_fields"):         # optax NamedTuple states
+                    return type(new)(*(merge(a, b) for a, b in zip(new, old)))
+                if isinstance(new, (tuple, list)):
+                    return type(new)(merge(a, b) for a, b in zip(new, old))
+                if isinstance(new, dict):
+                    return {k: merge(new[k], old[k]) for k in new}
+                return keep(new, old)
+
+            params = jax.tree.map(keep, params, state.params)
+            opt_state = merge(opt_state, state.opt_state)
+        metrics = dict(metrics, loss=loss, grad_norm=grad_norm,
+                       nonfinite=1.0 - finite.astype(jnp.float32))
         if lr_schedule is not None:
             metrics["lr"] = lr_schedule(state.step)
         new_state = state.replace(step=state.step + 1, params=params,

@@ -214,3 +214,194 @@ def test_checkpoint_roundtrip(tmp_path, rng):
                     jax.tree.leaves(restored.opt_state)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     mngr.close()
+
+
+# ---------------------------------------------------------------------------
+# failure detection: nan_policy skip/abort + elastic restart
+# ---------------------------------------------------------------------------
+
+def test_nan_policy_skip_drops_update(rng):
+    cfg = TrainConfig(lr=1e-3, num_steps=50, train_iters=2, batch_size=8,
+                      nan_policy="skip")
+    model = RAFTStereo(TINY)
+    tx, sched = make_optimizer(cfg)
+    state = create_train_state(model, jax.random.key(0), tx, (48, 64))
+    step = make_train_step(model, tx, cfg, lr_schedule=sched)
+    mesh = make_mesh(data=8)
+    jstep = jit_train_step(step, mesh)
+
+    bad = list(_tiny_batch(rng))
+    bad[0] = bad[0].copy()
+    bad[0][0, 0, 0, 0] = np.nan          # one NaN pixel poisons the loss
+    p_before = jax.tree.map(np.asarray, state.params)
+    state2, metrics = jstep(state, shard_batch(mesh, tuple(bad)))
+    assert float(metrics["nonfinite"]) == 1.0
+    assert int(state2.step) == 1          # schedule still advances
+    for a, b in zip(jax.tree.leaves(p_before),
+                    jax.tree.leaves(state2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # A good batch afterwards trains normally from the unpoisoned state.
+    state3, metrics = jstep(state2, shard_batch(mesh, _tiny_batch(rng)))
+    assert float(metrics["nonfinite"]) == 0.0
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_nan_policy_abort_reports_nonfinite(rng):
+    cfg = TrainConfig(lr=1e-3, num_steps=50, train_iters=2, batch_size=8,
+                      nan_policy="abort")
+    model = RAFTStereo(TINY)
+    tx, sched = make_optimizer(cfg)
+    state = create_train_state(model, jax.random.key(0), tx, (48, 64))
+    step = make_train_step(model, tx, cfg, lr_schedule=sched)
+    mesh = make_mesh(data=8)
+    jstep = jit_train_step(step, mesh)
+    bad = list(_tiny_batch(rng))
+    bad[0] = bad[0].copy()
+    bad[0][:, :, :, :] = np.nan
+    _, metrics = jstep(state, shard_batch(mesh, tuple(bad)))
+    assert float(metrics["nonfinite"]) == 1.0   # loop raises on this flag
+
+
+class _FlakyDataset:
+    """Fails the first __getitem__ with an IOError, then behaves."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.tripped = False
+
+    def __len__(self):
+        return len(self.inner)
+
+    def __getitem__(self, i):
+        if not self.tripped:
+            self.tripped = True
+            raise IOError("injected transient failure")
+        return self.inner[i]
+
+    def __getattr__(self, name):   # reseed() etc. pass through
+        return getattr(self.inner, name)
+
+
+def test_train_loop_auto_restart(tmp_path, rng, monkeypatch):
+    from raftstereo_tpu.cli.train import train
+    from raftstereo_tpu.data import datasets as ds
+    from tests.test_data import make_synthetic_kitti
+
+    make_synthetic_kitti(tmp_path / "kitti", n=4, rng=rng)
+    dataset = _FlakyDataset(ds.KITTI(aug_params={"crop_size": (48, 64)},
+                                     root=str(tmp_path / "kitti")))
+    monkeypatch.chdir(tmp_path)
+    mcfg = RAFTStereoConfig(corr_levels=2, corr_radius=2, n_gru_layers=2,
+                            hidden_dims=(32, 32))
+    tcfg = TrainConfig(name="r", batch_size=2, num_steps=2, train_iters=2,
+                      image_size=(48, 64), validation_frequency=100, seed=3,
+                      checkpoint_dir=str(tmp_path / "ckpt"),
+                      data_parallel=2, max_restarts=1)
+    state = train(mcfg, tcfg, dataset=dataset, num_workers=0,
+                  no_validation=True)
+    # The injected failure consumed one restart; training then completed.
+    assert int(state.step) == 3
+    assert (tmp_path / "ckpt" / "r" / "r-final").exists()
+
+
+def test_skip_advances_schedule_but_not_adam(rng):
+    """On a skipped step the LR-schedule count advances (torch: unconditional
+    scheduler.step) while Adam moments/count stay put (torch: optimizer.step
+    skipped by GradScaler)."""
+    import optax as _optax
+
+    cfg = TrainConfig(lr=1e-3, num_steps=50, train_iters=2, batch_size=8,
+                      nan_policy="skip")
+    model = RAFTStereo(TINY)
+    tx, sched = make_optimizer(cfg)
+    state = create_train_state(model, jax.random.key(0), tx, (48, 64))
+    step = make_train_step(model, tx, cfg, lr_schedule=sched)
+    mesh = make_mesh(data=8)
+    jstep = jit_train_step(step, mesh)
+
+    bad = list(_tiny_batch(rng))
+    bad[0] = bad[0].copy()
+    bad[0][0, 0, 0, 0] = np.nan
+
+    def counts(s):
+        sched_c = adam_c = None
+        for leaf in jax.tree.leaves(
+                s.opt_state,
+                is_leaf=lambda x: isinstance(
+                    x, (_optax.ScaleByScheduleState, _optax.ScaleByAdamState))):
+            if isinstance(leaf, _optax.ScaleByScheduleState):
+                sched_c = int(leaf.count)
+            elif isinstance(leaf, _optax.ScaleByAdamState):
+                adam_c = int(leaf.count)
+        return sched_c, adam_c
+
+    state2, metrics = jstep(state, shard_batch(mesh, tuple(bad)))
+    assert float(metrics["nonfinite"]) == 1.0
+    sched_c, adam_c = counts(state2)
+    assert sched_c == 1, sched_c     # schedule advanced
+    assert adam_c == 0, adam_c       # optimizer skipped
+
+
+def test_restart_reapplies_restore_ckpt(tmp_path, rng, monkeypatch):
+    """A crash before the first checkpoint save must recover from
+    --restore_ckpt weights, not a fresh random init."""
+    from raftstereo_tpu.cli.train import train
+    from raftstereo_tpu.data import datasets as ds
+    from raftstereo_tpu.train.checkpoint import save_weights
+    from tests.test_data import make_synthetic_kitti
+
+    mcfg = RAFTStereoConfig(corr_levels=2, corr_radius=2, n_gru_layers=2,
+                            hidden_dims=(32, 32))
+    model = RAFTStereo(mcfg)
+    pretrained = model.init(jax.random.key(99))
+    ckpt = tmp_path / "pretrained"
+    save_weights(str(ckpt), pretrained)
+
+    make_synthetic_kitti(tmp_path / "kitti", n=4, rng=rng)
+    dataset = _FlakyDataset(ds.KITTI(aug_params={"crop_size": (48, 64)},
+                                     root=str(tmp_path / "kitti")))
+    monkeypatch.chdir(tmp_path)
+    tcfg = TrainConfig(name="rr", batch_size=2, num_steps=1, train_iters=2,
+                      image_size=(48, 64), validation_frequency=100, seed=5,
+                      checkpoint_dir=str(tmp_path / "ckpt"),
+                      restore_ckpt=str(ckpt), data_parallel=2, max_restarts=1)
+    state = train(mcfg, tcfg, dataset=dataset, num_workers=0,
+                  no_validation=True)
+    assert int(state.step) == 2
+
+
+def test_nan_abort_not_retried(tmp_path, rng, monkeypatch):
+    """nan_policy=abort failures are deterministic; max_restarts must not
+    burn its budget replaying them."""
+    from raftstereo_tpu.cli.train import train
+    from raftstereo_tpu.data import datasets as ds
+    from tests.test_data import make_synthetic_kitti
+
+    make_synthetic_kitti(tmp_path / "kitti", n=4, rng=rng)
+    inner = ds.KITTI(aug_params={"crop_size": (48, 64)},
+                     root=str(tmp_path / "kitti"))
+
+    class _NaNDataset:
+        def __len__(self):
+            return len(inner)
+
+        def __getitem__(self, i):
+            meta, img1, img2, disp, valid = inner[i]
+            img1 = np.asarray(img1).copy()
+            img1[...] = np.nan
+            return meta, img1, img2, disp, valid
+
+        def __getattr__(self, name):
+            return getattr(inner, name)
+
+    monkeypatch.chdir(tmp_path)
+    mcfg = RAFTStereoConfig(corr_levels=2, corr_radius=2, n_gru_layers=2,
+                            hidden_dims=(32, 32))
+    tcfg = TrainConfig(name="na", batch_size=2, num_steps=4, train_iters=2,
+                      image_size=(48, 64), validation_frequency=100, seed=5,
+                      checkpoint_dir=str(tmp_path / "ckpt"),
+                      data_parallel=2, nan_policy="abort", max_restarts=5)
+    with pytest.raises(FloatingPointError):
+        train(mcfg, tcfg, dataset=_NaNDataset(), num_workers=0,
+              no_validation=True)
