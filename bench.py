@@ -28,6 +28,16 @@ BASELINE_CACHE = os.path.join(REPO, "BENCH_BASELINE.json")
 METRIC = "stereo-pairs/sec/chip @960x540, 32 GRU iters"
 
 
+def resolve_corr(corr: str) -> str:
+    """'auto' -> the fastest backend for the active platform: the Pallas
+    lookup kernel on TPU, the XLA gather path elsewhere."""
+    import jax
+
+    if corr == "auto":
+        return "reg" if jax.default_backend() == "cpu" else "pallas"
+    return corr
+
+
 def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
               reps: int, compute_dtype: str,
               corr_dtype: str = "float32", realtime: bool = False) -> float:
@@ -39,8 +49,7 @@ def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
     from raftstereo_tpu.models.raft_stereo import RAFTStereo
     from raftstereo_tpu.ops.image import InputPadder
 
-    if corr == "auto":
-        corr = "reg" if jax.default_backend() == "cpu" else "pallas"
+    corr = resolve_corr(corr)
     model_kw = {}
     if realtime:
         # The reference's realtime configuration (reference: README.md:82-84):
@@ -82,6 +91,64 @@ def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
     float(fn(variables, img1, img2, reps))
     dt = time.perf_counter() - t0
     return batch * reps / dt
+
+
+def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
+                reps: int, compute_dtype: str,
+                corr_dtype: str = "float32") -> float:
+    """Training throughput: full fwd+loss+bwd+clip+update steps/sec, the
+    whole repeat loop compiled on-device (same dispatch rationale as
+    bench_jax).  The reference recipe trains on 320x720 crops
+    (train_stereo.py:245), so pass --height 320 --width 720 for that config.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raftstereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.train import (create_train_state, make_optimizer,
+                                      make_train_step)
+
+    corr = resolve_corr(corr)
+    # remat: the recipe (batch 8, 320x720, 16 iters) needs ~29 GB of stored
+    # activations without it — far past one chip's HBM.
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype,
+                           corr_dtype=corr_dtype, remat=True)
+    tcfg = TrainConfig(batch_size=batch, train_iters=iters,
+                       image_size=(height, width))
+    model = RAFTStereo(cfg)
+    tx, sched = make_optimizer(tcfg)
+    state = create_train_state(model, jax.random.key(0), tx, (height, width))
+    step = make_train_step(model, tx, tcfg, lr_schedule=sched)
+
+    rng = np.random.default_rng(0)
+    batch_data = (
+        jnp.asarray(rng.integers(0, 255, (batch, height, width, 3))
+                    .astype(np.float32)),
+        jnp.asarray(rng.integers(0, 255, (batch, height, width, 3))
+                    .astype(np.float32)),
+        jnp.asarray(-np.abs(rng.normal(size=(batch, height, width, 1)))
+                    .astype(np.float32) * 8),
+        jnp.ones((batch, height, width), jnp.float32),
+    )
+
+    def run_reps(st, data, n):
+        def body(i, s):
+            s, _ = step(s, data)
+            return s
+        return jax.lax.fori_loop(0, n, body, st)
+
+    fn = jax.jit(run_reps, static_argnums=(2,), donate_argnums=(0,))
+    state = fn(state, batch_data, reps)
+    jax.block_until_ready(state.params)
+    _ = float(jax.tree.leaves(state.params)[0].sum())  # fence (tunnel)
+    t0 = time.perf_counter()
+    state = fn(state, batch_data, reps)
+    _ = float(jax.tree.leaves(state.params)[0].sum())
+    dt = time.perf_counter() - t0
+    return reps / dt
 
 
 def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
@@ -136,6 +203,10 @@ def main() -> None:
                         "7 iters — BASELINE.json config #2)")
     p.add_argument("--measure-baseline", action="store_true",
                    help="re-measure the torch reference baseline (slow)")
+    p.add_argument("--train", action="store_true",
+                   help="measure training steps/sec (full fwd+bwd+update) "
+                        "instead of inference; use with --height 320 "
+                        "--width 720 --batch 8 for the reference recipe")
     args = p.parse_args()
 
     if args.quick:
@@ -149,6 +220,22 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         import jax
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    if args.train:
+        if args.realtime:
+            p.error("--train does not support --realtime (no realtime "
+                    "training recipe exists in the reference)")
+        value = bench_train(args.height, args.width, args.batch, args.iters,
+                            args.corr, args.reps, args.compute_dtype,
+                            args.corr_dtype)
+        print(json.dumps({
+            "metric": f"train-steps/sec/chip @{args.width}x{args.height}, "
+                      f"batch {args.batch}, {args.iters} GRU iters",
+            "value": round(value, 4),
+            "unit": "steps/sec",
+            "vs_baseline": 0.0,
+        }))
+        return
 
     value = bench_jax(args.height, args.width, args.batch, args.iters,
                       args.corr, args.reps, args.compute_dtype,

@@ -189,13 +189,18 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                             f"non-finite loss/gradient at step {total_steps}")
                     logger.warning("step %d: non-finite loss/gradient — "
                                    "update skipped", total_steps)
-                metrics_logger.write_scalar("live_loss",
-                                            metrics.get("loss", 0.0),
-                                            total_steps)
-                if "lr" in metrics:
-                    metrics_logger.write_scalar("lr", metrics["lr"],
+                    # Don't push the NaN metrics: one skipped step would turn
+                    # the whole running-mean window NaN.  Record the skip.
+                    metrics_logger.push({"skipped": 1.0})
+                else:
+                    metrics["skipped"] = 0.0
+                    metrics_logger.write_scalar("live_loss",
+                                                metrics.get("loss", 0.0),
                                                 total_steps)
-                metrics_logger.push(metrics)
+                    if "lr" in metrics:
+                        metrics_logger.write_scalar("lr", metrics["lr"],
+                                                    total_steps)
+                    metrics_logger.push(metrics)
 
                 if total_steps % cfg.validation_frequency == 0:
                     manager.save(total_steps, state)

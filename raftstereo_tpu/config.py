@@ -51,6 +51,13 @@ class RAFTStereoConfig:
     compute_dtype: str = "float32"
     corr_dtype: str = "float32"
 
+    # Rematerialize each GRU iteration in the backward pass (jax.checkpoint
+    # on the scan body): activation memory drops from O(iters) to O(1) at the
+    # cost of one extra forward per iteration.  Required to fit the reference
+    # training recipe (batch 8, 320x720, 16+ iters) in one chip's HBM; free
+    # for inference (no backward pass to rematerialize for).
+    remat: bool = False
+
     def __post_init__(self):
         if isinstance(self.hidden_dims, list):
             object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
@@ -144,6 +151,10 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--mixed_precision", action="store_true",
                    help="bfloat16 compute for encoders and GRUs")
     g.add_argument("--corr_dtype", choices=["float32", "bfloat16"], default="float32")
+    g.add_argument("--remat", action="store_true",
+                   help="rematerialize each GRU iteration in backward: "
+                        "O(1) activation memory instead of O(iters); "
+                        "needed to fit the full training recipe on one chip")
 
 
 def model_config_from_args(args: argparse.Namespace) -> RAFTStereoConfig:
@@ -159,4 +170,5 @@ def model_config_from_args(args: argparse.Namespace) -> RAFTStereoConfig:
         context_norm=args.context_norm,
         compute_dtype="bfloat16" if args.mixed_precision else "float32",
         corr_dtype=args.corr_dtype,
+        remat=args.remat,
     )

@@ -236,8 +236,9 @@ class RAFTStereo:
             up = convex_upsample(d, mask, cfg.factor)
             return (tuple(nets), d, mask), up
 
+        body = jax.checkpoint(step) if cfg.remat else step
         (nets, disp, last_mask), ys = jax.lax.scan(
-            step, (tuple(net_list), disp, mask0), None, length=iters)
+            body, (tuple(net_list), disp, mask0), None, length=iters)
         if test_mode:
             disp_up = convex_upsample(disp, last_mask, cfg.factor)
             return disp, disp_up

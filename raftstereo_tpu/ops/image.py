@@ -63,8 +63,11 @@ def avg_pool2x(x: jax.Array) -> jax.Array:
     (count_include_pad=True), used to pass fine GRU state down one level
     (reference: core/update.py:87-88).
     """
+    # Plain-python 0.0 init (weak-typed): a concrete bf16 zero constant here
+    # breaks linearization when the surrounding computation is differentiated
+    # inside a lax.fori_loop body (bench --train hits this).
     s = jax.lax.reduce_window(
-        x, 0.0 if x.dtype != jnp.bfloat16 else jnp.bfloat16(0), jax.lax.add,
+        x, 0.0, jax.lax.add,
         window_dimensions=(1, 3, 3, 1), window_strides=(1, 2, 2, 1),
         padding=((0, 0), (1, 1), (1, 1), (0, 0)))
     return s / jnp.asarray(9.0, dtype=x.dtype)
@@ -73,7 +76,7 @@ def avg_pool2x(x: jax.Array) -> jax.Array:
 def avg_pool4x(x: jax.Array) -> jax.Array:
     """5x5/stride-4/pad-1 average pool (reference: core/update.py:90-91)."""
     s = jax.lax.reduce_window(
-        x, 0.0 if x.dtype != jnp.bfloat16 else jnp.bfloat16(0), jax.lax.add,
+        x, 0.0, jax.lax.add,
         window_dimensions=(1, 5, 5, 1), window_strides=(1, 4, 4, 1),
         padding=((0, 0), (1, 1), (1, 1), (0, 0)))
     return s / jnp.asarray(25.0, dtype=x.dtype)

@@ -1,0 +1,58 @@
+"""Numerical sanitizer (utils/debug.py) — checkify instrumentation."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from raftstereo_tpu.utils.debug import check_fn, checked_forward
+
+
+class TestCheckFn:
+    def test_clean_fn_reports_none(self):
+        msg, out = check_fn(lambda x: (x * 2).sum())(jnp.ones((4,)))
+        assert msg is None
+        assert float(out) == 8.0
+
+    def test_nan_located(self):
+        def f(x):
+            y = x - x.max()         # fine
+            return y / y.sum()      # 0/0 -> nan here
+
+        msg, _ = check_fn(f)(jnp.zeros((3,)))
+        assert msg is not None           # reported as 'division by zero'
+
+    def test_div_by_zero_inf(self):
+        msg, _ = check_fn(lambda x: 1.0 / x)(jnp.zeros((2,)))
+        assert msg is not None
+
+
+class TestCheckedForward:
+    def test_clean_model_passes(self, tiny_model, rng):
+        model, variables = tiny_model
+        i1 = jnp.asarray(rng.uniform(0, 255, (1, 32, 64, 3)).astype(np.float32))
+        i2 = jnp.asarray(rng.uniform(0, 255, (1, 32, 64, 3)).astype(np.float32))
+        assert checked_forward(model, variables, i1, i2, iters=2) is None
+
+    def test_remat_model_supported(self, rng):
+        """checkify cannot rewrite a checkpointed scan body; checked_forward
+        must transparently drop remat (numerically identical forward)."""
+        import dataclasses
+
+        from raftstereo_tpu import RAFTStereoConfig
+        from raftstereo_tpu.models import RAFTStereo
+
+        cfg = RAFTStereoConfig(corr_levels=2, corr_radius=2, n_gru_layers=2,
+                               hidden_dims=(32, 32), remat=True)
+        model = RAFTStereo(cfg)
+        variables = model.init(__import__("jax").random.key(0))
+        i1 = rng.uniform(0, 255, (1, 32, 48, 3)).astype(np.float32)
+        i2 = rng.uniform(0, 255, (1, 32, 48, 3)).astype(np.float32)
+        assert checked_forward(model, variables, jnp.asarray(i1),
+                               jnp.asarray(i2), iters=2) is None
+
+    def test_nan_input_located(self, tiny_model, rng):
+        model, variables = tiny_model
+        i1 = rng.uniform(0, 255, (1, 32, 64, 3)).astype(np.float32)
+        i1[0, 0, 0, 0] = np.nan
+        i2 = jnp.asarray(rng.uniform(0, 255, (1, 32, 64, 3)).astype(np.float32))
+        msg = checked_forward(model, variables, jnp.asarray(i1), i2, iters=2)
+        assert msg is not None and "nan" in msg.lower()

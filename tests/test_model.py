@@ -1,5 +1,7 @@
 """Model-level tests: shapes, jit, scan semantics, config variants."""
 
+import dataclasses
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -158,3 +160,38 @@ def test_parameter_count_close_to_reference_scale(default_model):
     _, variables = default_model
     n = count_parameters(variables)
     assert 8e6 < n < 15e6, n
+
+
+class TestRemat:
+    """jax.checkpoint on the scan body: same math, O(1) activation memory."""
+
+    def test_forward_identical(self, rng):
+        cfg = RAFTStereoConfig(corr_levels=2, corr_radius=2, n_gru_layers=2,
+                               hidden_dims=(32, 32))
+        m0 = RAFTStereo(cfg)
+        m1 = RAFTStereo(dataclasses.replace(cfg, remat=True))
+        variables = m0.init(jax.random.key(0))
+        i1, i2 = make_images(rng, h=48, w=64)
+        p0 = m0.forward(variables, i1, i2, iters=3)
+        p1 = m1.forward(variables, i1, i2, iters=3)
+        np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+
+    def test_grad_matches(self, rng):
+        cfg = RAFTStereoConfig(corr_levels=2, corr_radius=2, n_gru_layers=2,
+                               hidden_dims=(32, 32))
+        m0 = RAFTStereo(cfg)
+        m1 = RAFTStereo(dataclasses.replace(cfg, remat=True))
+        variables = m0.init(jax.random.key(0))
+        i1, i2 = make_images(rng, h=32, w=48)
+
+        def loss(model, v):
+            vv = dict(variables, params=v)
+            return jnp.mean(jnp.abs(model.forward(vv, i1, i2, iters=2)))
+
+        g0 = jax.grad(lambda v: loss(m0, v))(variables["params"])
+        g1 = jax.grad(lambda v: loss(m1, v))(variables["params"])
+        # Recompute reorders float reductions; differences are at rounding
+        # scale (observed max ~4e-6 absolute), not structural.
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=2e-5)
