@@ -225,6 +225,9 @@ def main() -> None:
         if args.realtime:
             p.error("--train does not support --realtime (no realtime "
                     "training recipe exists in the reference)")
+        if args.measure_baseline:
+            p.error("--train does not support --measure-baseline (the torch "
+                    "baseline covers the inference path only)")
         value = bench_train(args.height, args.width, args.batch, args.iters,
                             args.corr, args.reps, args.compute_dtype,
                             args.corr_dtype)

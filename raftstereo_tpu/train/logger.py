@@ -34,6 +34,7 @@ class Logger:
         self.total_steps = total_steps
         self._window = 0
         self.running: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
         self.log_dir = log_dir
         self.writer = _make_tb_writer(log_dir)
         self._jsonl = None
@@ -52,10 +53,13 @@ class Logger:
         self._window += 1
         for k, v in metrics.items():
             self.running[k] = self.running.get(k, 0.0) + float(v)
+            self._counts[k] = self._counts.get(k, 0) + 1
         if self.total_steps % SUM_FREQ == 0:
-            # Divide by the actual window size: after a resume, the first
-            # window to a SUM_FREQ boundary is partial.
-            means = {k: v / self._window for k, v in self.running.items()}
+            # Per-key divisor: not every step pushes every key (a resume
+            # starts mid-window; nan_policy=skip steps push only 'skipped'),
+            # and dividing a key by pushes it did not appear in would dilute
+            # its mean exactly when it matters.
+            means = {k: v / self._counts[k] for k, v in self.running.items()}
             rate = self._window / max(time.time() - self._t0, 1e-9)
             self._window = 0
             self._t0 = time.time()
@@ -69,6 +73,7 @@ class Logger:
                 for k, v in means.items():
                     self.writer.add_scalar(k, v, self.total_steps)
             self.running = {}
+            self._counts = {}
 
     def write_scalar(self, name: str, value: float,
                      step: Optional[int] = None) -> None:

@@ -405,3 +405,22 @@ def test_nan_abort_not_retried(tmp_path, rng, monkeypatch):
     with pytest.raises(FloatingPointError):
         train(mcfg, tcfg, dataset=_NaNDataset(), num_workers=0,
               no_validation=True)
+
+
+def test_logger_per_key_window_means(tmp_path, capsys):
+    """Keys pushed on a subset of steps (skip steps push only 'skipped') are
+    averaged over their own pushes, not the whole window."""
+    import json
+
+    from raftstereo_tpu.train.logger import SUM_FREQ, Logger
+
+    log = Logger(log_dir=str(tmp_path), jsonl_path=str(tmp_path / "m.jsonl"))
+    for i in range(SUM_FREQ):
+        if i % 5 == 0:                      # 20% skipped steps
+            log.push({"skipped": 1.0})
+        else:
+            log.push({"skipped": 0.0, "loss": 2.0})
+    log.close()
+    rec = json.loads((tmp_path / "m.jsonl").read_text().splitlines()[-1])
+    np.testing.assert_allclose(rec["loss"], 2.0)       # undiluted
+    np.testing.assert_allclose(rec["skipped"], 0.2)    # true skip rate
