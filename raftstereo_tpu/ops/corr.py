@@ -2,7 +2,7 @@
 
 The reference ships four interchangeable backends (reference: core/corr.py;
 selected at core/raft_stereo.py:90-100).  Here the same capability surface is
-three backends behind one functional API, designed TPU-first:
+four backends behind one functional API, designed TPU-first:
 
 * ``reg``    — precompute the full (B, H, W1, W2) volume as one batched matmul
                over B*H rows (MXU), build a W2 pyramid by average pooling,
@@ -14,6 +14,10 @@ three backends behind one functional API, designed TPU-first:
 * ``pallas`` — same precomputed pyramid as ``reg`` but the lookup runs in a
                Pallas TPU kernel (gather-free masked reduction), the analogue
                of the reference's CUDA ``corr_sampler`` (sampler/sampler_kernel.cu).
+* ``pallas_alt`` — on-demand Pallas kernel: each W1-block's correlation rows
+               are recomputed in VMEM (MXU matmul + hat reduction) and thrown
+               away.  O(H*W) memory at Pallas-kernel speed; the working form
+               of the reference's dead ``alt_cuda`` (core/corr.py:159-188).
 
 All backends share exact semantics: 1/sqrt(C) scaling, align_corners linear
 interpolation in x, zero outside [0, W2-1], floor-halving pyramid.  The
@@ -74,8 +78,7 @@ def _tap_offsets(radius: int) -> jax.Array:
 
 
 def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
-                     radius: int, dtype=jnp.float32,
-                     lookup=linear_sample_1d) -> CorrFn:
+                     radius: int, dtype=jnp.float32) -> CorrFn:
     """Precomputed-volume backend (reference: CorrBlock1D, core/corr.py:110-156)."""
     volume = build_corr_volume(fmap1.astype(jnp.float32),
                                fmap2.astype(jnp.float32), dtype=dtype)
@@ -87,7 +90,7 @@ def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
         out = []
         for i, vol in enumerate(pyramid):
             taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
-            out.append(lookup(vol, taps))
+            out.append(linear_sample_1d(vol, taps))
         return jnp.concatenate(out, axis=-1)
 
     return corr_fn
@@ -151,24 +154,55 @@ def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
     return corr_fn
 
 
+def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
+                        radius: int, dtype=jnp.float32) -> CorrFn:
+    """Precomputed-pyramid backend with the Pallas TPU lookup kernel.
+
+    Each pyramid level is flattened + W1-padded to the kernel's layout ONCE
+    here; per-iteration calls reshape only the taps (the volume pad is an HBM
+    copy of the whole volume — done once structurally rather than relying on
+    XLA's loop-invariant code motion)."""
+    from .pallas_corr import pallas_lookup_flat, preflatten_volume
+
+    volume = build_corr_volume(fmap1.astype(jnp.float32),
+                               fmap2.astype(jnp.float32), dtype=dtype)
+    pyramid = [preflatten_volume(v)
+               for v in build_corr_pyramid(volume, num_levels)]
+    offsets = _tap_offsets(radius)
+
+    def corr_fn(coords: jax.Array) -> jax.Array:
+        x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
+        out = []
+        for i, vflat in enumerate(pyramid):
+            taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
+            out.append(pallas_lookup_flat(vflat, taps))
+        return jnp.concatenate(out, axis=-1)
+
+    return corr_fn
+
+
 def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
                             num_levels: int, radius: int) -> CorrFn:
     """On-demand Pallas backend: O(H*W) HBM like ``alt``, but each W1-block's
     correlation rows are recomputed inside a TPU kernel (MXU matmul + hat
     reduction in VMEM).  Working form of the reference's dead ``alt_cuda``
     backend (reference: core/corr.py:159-188 raises NotImplementedError)."""
-    from .pallas_alt import pallas_alt_lookup
+    from .pallas_alt import (pallas_alt_lookup_flat, preflatten_fmap1,
+                             preflatten_fmap2)
 
-    fmap1 = fmap1.astype(jnp.float32)
-    f2_pyramid = build_fmap2_pyramid(fmap2.astype(jnp.float32), num_levels)
+    # Flatten/pad ONCE so each corr_fn call touches only the taps (the f1
+    # pad is a full-fmap HBM copy; one copy guaranteed structurally).
+    f1flat = preflatten_fmap1(fmap1.astype(jnp.float32))
+    f2_pyramid = [preflatten_fmap2(f2) for f2 in
+                  build_fmap2_pyramid(fmap2.astype(jnp.float32), num_levels)]
     offsets = _tap_offsets(radius)
 
     def corr_fn(coords: jax.Array) -> jax.Array:
         x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
         out = []
-        for i, f2 in enumerate(f2_pyramid):
+        for i, f2f in enumerate(f2_pyramid):
             taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
-            out.append(pallas_alt_lookup(fmap1, f2, taps))
+            out.append(pallas_alt_lookup_flat(f1flat, f2f, taps))
         return jnp.concatenate(out, axis=-1)
 
     return corr_fn
@@ -182,9 +216,8 @@ def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
     if implementation == "alt":
         return make_alt_corr_fn(fmap1, fmap2, num_levels, radius)
     if implementation == "pallas":
-        from .pallas_corr import pallas_lookup
-        return make_reg_corr_fn(fmap1, fmap2, num_levels, radius, dtype=dtype,
-                                lookup=pallas_lookup)
+        return make_pallas_corr_fn(fmap1, fmap2, num_levels, radius,
+                                   dtype=dtype)
     if implementation == "pallas_alt":
         return make_pallas_alt_corr_fn(fmap1, fmap2, num_levels, radius)
     raise ValueError(f"unknown corr implementation: {implementation}")

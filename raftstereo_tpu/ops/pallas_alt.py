@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_corr import _block_w1, _interpret, _pad_w1
+from .pallas_corr import _block_w1, _interpret, _pad_taps, _pad_w1
 
 
 def _alt_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale):
@@ -90,6 +90,30 @@ def _alt_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
         precision=jax.lax.Precision.HIGHEST).astype(df2_ref.dtype)
 
 
+def preflatten_fmap1(fmap1: jax.Array) -> jax.Array:
+    """(B, H, W1, C) -> (B*H, W1p, C) flattened + W1-padded for the kernel.
+    Do once outside any loop — the pad is an HBM copy; hoisting here makes
+    the single copy structural (same rationale as
+    pallas_corr.preflatten_volume)."""
+    f1, _ = _pad_w1(
+        fmap1.reshape(fmap1.shape[0] * fmap1.shape[1], *fmap1.shape[2:]),
+        _block_w1(fmap1.shape[2]))
+    return f1
+
+
+def preflatten_fmap2(fmap2: jax.Array) -> jax.Array:
+    """(B, H, W2, C) -> (B*H, W2, C); no padding (W2 rides whole in VMEM)."""
+    return fmap2.reshape(fmap2.shape[0] * fmap2.shape[1], *fmap2.shape[2:])
+
+
+def pallas_alt_lookup_flat(f1flat: jax.Array, f2flat: jax.Array,
+                           taps: jax.Array) -> jax.Array:
+    """Lookup against preflattened feature maps; taps stay in model layout
+    (B, H, W1, K) and are the only tensor reshaped per call."""
+    return _make_alt(f1flat.shape, f2flat.shape, f1flat.dtype.name,
+                     f2flat.dtype.name)(f1flat, f2flat, taps)
+
+
 def pallas_alt_lookup(fmap1: jax.Array, fmap2: jax.Array,
                       taps: jax.Array) -> jax.Array:
     """On-demand correlation at the given taps.
@@ -99,23 +123,24 @@ def pallas_alt_lookup(fmap1: jax.Array, fmap2: jax.Array,
     Returns (B, H, W1, K) float32, scaled by 1/sqrt(C), zero outside
     [0, W2-1], align-corners linear interpolation — the exact semantics of
     the ``reg``/``alt`` backends (cross-checked in tests/test_pallas_alt.py).
+    Loop callers should preflatten once and use the ``_flat`` variant.
     """
-    return _make_alt(fmap1.shape, fmap2.shape, fmap1.dtype.name,
-                     fmap2.dtype.name)(fmap1, fmap2, taps)
+    return pallas_alt_lookup_flat(preflatten_fmap1(fmap1),
+                                  preflatten_fmap2(fmap2), taps)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_alt(f1_shape, f2_shape, f1_dtype, f2_dtype):
+def _make_alt(f1flat_shape, f2flat_shape, f1_dtype, f2_dtype):
     @jax.custom_vjp
-    def f(fmap1, fmap2, taps):
-        return _alt_fwd_impl(fmap1, fmap2, taps)
+    def f(f1flat, f2flat, taps):
+        return _alt_fwd_impl(f1flat, f2flat, taps)
 
-    def fwd(fmap1, fmap2, taps):
-        return _alt_fwd_impl(fmap1, fmap2, taps), (fmap1, fmap2, taps)
+    def fwd(f1flat, f2flat, taps):
+        return _alt_fwd_impl(f1flat, f2flat, taps), (f1flat, f2flat, taps)
 
     def bwd(res, g):
-        fmap1, fmap2, taps = res
-        df1, df2 = _alt_bwd_impl(fmap1, fmap2, taps, g)
+        f1flat, f2flat, taps = res
+        df1, df2 = _alt_bwd_impl(f1flat, f2flat, taps, g)
         return (df1.astype(f1_dtype), df2.astype(f2_dtype),
                 jnp.zeros_like(taps))
 
@@ -123,22 +148,11 @@ def _make_alt(f1_shape, f2_shape, f1_dtype, f2_dtype):
     return f
 
 
-def _prep(fmap1, fmap2, taps):
-    b, h, w1, c = fmap1.shape
-    w2 = fmap2.shape[2]
-    kk = taps.shape[-1]
-    blk = _block_w1(w1)
-    f1 = fmap1.reshape(b * h, w1, c)
-    f2 = fmap2.reshape(b * h, w2, c)
-    t = taps.reshape(b * h, w1, kk)
-    f1, _ = _pad_w1(f1, blk)
-    t, _ = _pad_w1(t, blk)
-    return f1, f2, t, blk, (b, h, w1, w2, c, kk)
-
-
-def _alt_fwd_impl(fmap1, fmap2, taps):
-    f1, f2, t, blk, (b, h, w1, w2, c, kk) = _prep(fmap1, fmap2, taps)
-    n, w1p = f1.shape[0], f1.shape[1]
+def _alt_fwd_impl(f1flat, f2flat, taps):
+    n, w1p, c = f1flat.shape
+    w2 = f2flat.shape[1]
+    b, h, w1, kk = taps.shape
+    t, blk = _pad_taps(taps)
     scale = 1.0 / float(c) ** 0.5
     out = pl.pallas_call(
         functools.partial(_alt_fwd_kernel, scale=scale),
@@ -155,15 +169,19 @@ def _alt_fwd_impl(fmap1, fmap2, taps):
         out_specs=pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
-    )(f1, f2, t)
+    )(f1flat, f2flat, t)
     return out[:, :w1].reshape(b, h, w1, kk)
 
 
-def _alt_bwd_impl(fmap1, fmap2, taps, g):
-    f1, f2, t, blk, (b, h, w1, w2, c, kk) = _prep(fmap1, fmap2, taps)
-    gg = g.reshape(b * h, w1, kk)
-    gg, _ = _pad_w1(gg, blk)      # zero-padded: padded rows contribute nothing
-    n, w1p = f1.shape[0], f1.shape[1]
+def _alt_bwd_impl(f1flat, f2flat, taps, g):
+    n, w1p, c = f1flat.shape
+    w2 = f2flat.shape[1]
+    b, h, w1, kk = taps.shape
+    t, blk = _pad_taps(taps)
+    gg, _ = _pad_w1(g.reshape(b * h, w1, kk), blk)
+    # Padded g rows are zero, so padded rows contribute nothing to df2 and
+    # their df1 rows are themselves zero — the flat grads map back through
+    # the one-time preflatten reshapes by ordinary autodiff.
     scale = 1.0 / float(c) ** 0.5
     df1, df2 = pl.pallas_call(
         functools.partial(_alt_bwd_kernel, scale=scale),
@@ -187,6 +205,5 @@ def _alt_bwd_impl(fmap1, fmap2, taps, g):
                          memory_space=pltpu.VMEM),
         ),
         interpret=_interpret(),
-    )(f1, f2, t, gg)
-    return (df1[:, :w1].reshape(b, h, w1, c),
-            df2.reshape(b, h, w2, c))
+    )(f1flat, f2flat, t, gg)
+    return df1, df2

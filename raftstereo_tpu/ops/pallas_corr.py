@@ -85,18 +85,35 @@ def _lookup_bwd_kernel(taps_ref, g_ref, dvol_ref):
     dvol_ref[0] = acc.astype(dvol_ref.dtype)
 
 
-def _flatten(vol, taps):
-    b, h, w1, w2 = vol.shape
-    kk = taps.shape[-1]
-    return (vol.reshape(b * h, w1, w2), taps.reshape(b * h, w1, kk))
-
-
 def _pad_w1(x, block):
     w1 = x.shape[1]
     pad = (-w1) % block
     if pad:
         x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
     return x, w1
+
+
+def preflatten_volume(vol: jax.Array) -> jax.Array:
+    """(B, H, W1, W2) -> (B*H, W1p, W2) flattened + W1-padded for the kernel.
+
+    Do this ONCE per volume, outside any iteration loop: the pad is a real
+    HBM copy of the whole volume.  Hoisting it here guarantees a single copy
+    structurally instead of relying on XLA's loop-invariant code motion to
+    lift it out of the GRU scan (measured: XLA does hoist it on TPU today,
+    so this is neutral there — but interpret-mode/CPU callers and future
+    compiler versions get the guarantee).
+    """
+    blk = _block_w1(vol.shape[2])
+    v, _ = _pad_w1(vol.reshape(vol.shape[0] * vol.shape[1], *vol.shape[2:]),
+                   blk)
+    return v
+
+
+def pallas_lookup_flat(vflat: jax.Array, taps: jax.Array) -> jax.Array:
+    """Lookup against a :func:`preflatten_volume` result.  taps stays in
+    model layout (B, H, W1, K); only the (small) taps tensor is reshaped and
+    padded per call."""
+    return _make_lookup(vflat.shape, vflat.dtype.name)(vflat, taps)
 
 
 def pallas_lookup(vol: jax.Array, taps: jax.Array) -> jax.Array:
@@ -107,47 +124,50 @@ def pallas_lookup(vol: jax.Array, taps: jax.Array) -> jax.Array:
     are hard zeros (the model detaches disparity every iteration, and the
     reference CUDA op likewise returns no coords grad: core/corr.py:29), and
     forward-mode AD is unsupported (custom_vjp).  Use ``linear_sample_1d`` if
-    you need either.
+    you need either.  Loop callers should :func:`preflatten_volume` once and
+    use :func:`pallas_lookup_flat` per iteration.
     """
-    return _make_lookup(vol.shape, vol.dtype.name)(vol, taps)
+    return pallas_lookup_flat(preflatten_volume(vol), taps)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_lookup(vol_shape, vol_dtype_name):
-    """custom_vjp instance per static (shape, dtype) — residuals carry only
-    the taps; the volume's shape/dtype ride in the closure."""
+def _make_lookup(vflat_shape, vol_dtype_name):
+    """custom_vjp instance per static (flat shape, dtype) — residuals carry
+    only the taps; the volume's shape/dtype ride in the closure."""
 
     @jax.custom_vjp
-    def f(vol, taps):
-        return _lookup_fwd_impl(vol, taps)
+    def f(vflat, taps):
+        return _lookup_fwd_impl(vflat, taps)
 
-    def fwd(vol, taps):
-        return _lookup_fwd_impl(vol, taps), taps
+    def fwd(vflat, taps):
+        return _lookup_fwd_impl(vflat, taps), taps
 
     def bwd(taps, g):
-        dvol = _lookup_bwd_impl(taps, g, vol_shape, vol_dtype_name)
+        dvflat = _lookup_bwd_impl(taps, g, vflat_shape, vol_dtype_name)
         # No coordinate gradient by design (disparity is detached per
         # iteration; the reference kernel likewise returns None:
         # core/corr.py:29).
-        return dvol, jnp.zeros_like(taps)
+        return dvflat, jnp.zeros_like(taps)
 
     f.defvjp(fwd, bwd)
     return f
 
 
-def _lookup_fwd_impl(vol, taps):
-    b, h, w1, w2 = vol.shape
-    kk = taps.shape[-1]
+def _pad_taps(taps):
+    b, h, w1, kk = taps.shape
     blk = _block_w1(w1)
-    v, t = _flatten(vol, taps)
-    v, _ = _pad_w1(v, blk)
-    t, _ = _pad_w1(t, blk)
-    n, w1p = v.shape[0], v.shape[1]
-    grid = (n, w1p // blk)
+    t, _ = _pad_w1(taps.reshape(b * h, w1, kk), blk)
+    return t, blk
+
+
+def _lookup_fwd_impl(vflat, taps):
+    n, w1p, w2 = vflat.shape
+    b, h, w1, kk = taps.shape
+    t, blk = _pad_taps(taps)
     out = pl.pallas_call(
         _lookup_kernel,
         out_shape=jax.ShapeDtypeStruct((n, w1p, kk), jnp.float32),
-        grid=grid,
+        grid=(n, w1p // blk),
         in_specs=[
             pl.BlockSpec((1, blk, w2), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -157,24 +177,19 @@ def _lookup_fwd_impl(vol, taps):
         out_specs=pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
-    )(v, t)
+    )(vflat, t)
     return out[:, :w1].reshape(b, h, w1, kk)
 
 
-def _lookup_bwd_impl(taps, g, vol_shape, vol_dtype_name):
-    b, h, w1, w2 = vol_shape
-    kk = taps.shape[-1]
-    blk = _block_w1(w1)
-    t = taps.reshape(b * h, w1, kk)
-    gg = g.reshape(b * h, w1, kk)
-    t, _ = _pad_w1(t, blk)
-    gg, _ = _pad_w1(gg, blk)
-    n, w1p = t.shape[0], t.shape[1]
-    grid = (n, w1p // blk)
+def _lookup_bwd_impl(taps, g, vflat_shape, vol_dtype_name):
+    n, w1p, w2 = vflat_shape
+    b, h, w1, kk = taps.shape
+    t, blk = _pad_taps(taps)
+    gg, _ = _pad_w1(g.reshape(b * h, w1, kk), blk)
     dvol = pl.pallas_call(
         _lookup_bwd_kernel,
         out_shape=jax.ShapeDtypeStruct((n, w1p, w2), jnp.float32),
-        grid=grid,
+        grid=(n, w1p // blk),
         in_specs=[
             pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -185,4 +200,4 @@ def _lookup_bwd_impl(taps, g, vol_shape, vol_dtype_name):
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
     )(t, gg)
-    return dvol[:, :w1].reshape(b, h, w1, w2).astype(vol_dtype_name)
+    return dvol.astype(vol_dtype_name)
