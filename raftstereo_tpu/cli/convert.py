@@ -1,0 +1,44 @@
+"""Checkpoint converter: reference ``.pth`` -> native Orbax weights.
+
+    python -m raftstereo_tpu.cli.convert models/raftstereo-eth3d.pth \
+        converted/raftstereo-eth3d [--corr_implementation reg ...]
+
+``evaluate``/``demo``/``train --restore_ckpt`` already convert ``.pth``
+on the fly (cli/common.py); this CLI persists the conversion so repeated
+runs skip the torch load, and prints a parameter-count summary as a sanity
+check (the reference prints the same count at eval time,
+reference: evaluate_stereo.py:15-16,225).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from ..config import add_model_args, model_config_from_args
+from ..models.raft_stereo import count_parameters
+from .common import load_variables, setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("src", help="reference .pth checkpoint (or Orbax dir)")
+    p.add_argument("dst", help="output Orbax weights directory")
+    add_model_args(p)
+    args = p.parse_args(argv)
+    config = model_config_from_args(args)
+
+    variables = load_variables(args.src, config)
+    from ..train.checkpoint import save_weights
+    save_weights(args.dst, variables)
+    logger.info("Converted %s -> %s (%.2fM parameters)", args.src, args.dst,
+                count_parameters(variables) / 1e6)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

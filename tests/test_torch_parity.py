@@ -27,9 +27,9 @@ if not os.path.isdir(REF):
     pytest.skip("reference tree not mounted", allow_module_level=True)
 
 
-@pytest.fixture(scope="module")
-def ref_modules():
-    """Import the reference model code (read-only, torch CPU)."""
+def import_ref_raftstereo():
+    """Import the reference model code (read-only, torch CPU).  Shared by
+    every reference-dependent test module (also tests/test_cli.py)."""
     for p in (REF,):
         if p not in sys.path:
             sys.path.insert(0, p)
@@ -44,6 +44,11 @@ def ref_modules():
         sys.modules.setdefault("scipy.interpolate", fake.interpolate)
     from core.raft_stereo import RAFTStereo as TorchRAFTStereo  # noqa: E501
     return TorchRAFTStereo
+
+
+@pytest.fixture(scope="module")
+def ref_modules():
+    return import_ref_raftstereo()
 
 
 def make_ref_args(**over):
