@@ -40,13 +40,29 @@ class Evaluator:
     """
 
     def __init__(self, model, variables, iters: int = 32,
-                 divis_by: int = 32, bucket_multiple: Optional[int] = None):
+                 divis_by: int = 32, bucket_multiple: Optional[int] = None,
+                 mesh=None):
         self.model = model
         self.variables = variables
         self.iters = iters
         self.divis_by = divis_by
         self.bucket_multiple = bucket_multiple
         self._fn = model.jitted_infer(iters=iters)
+        # Optional multi-chip spatial parallelism: shard image height over
+        # the mesh's 'space' axis so ONE pair uses several chips' HBM/FLOPs
+        # (XLA inserts the conv halo exchanges; the 1-D correlation is along
+        # W, so each height shard's epipolar lines are self-contained —
+        # numerically transparent, tests/test_parallel.py).
+        self._in_sharding = None
+        if mesh is not None:
+            import jax
+
+            from ..parallel import replicated, spatial_sharded
+            self._in_sharding = spatial_sharded(mesh)
+            # Weights restored from a checkpoint arrive committed to one
+            # device; jit refuses mixed device sets, so replicate them onto
+            # the mesh explicitly.
+            self.variables = jax.device_put(self.variables, replicated(mesh))
         self.compiled_shapes: Set[Tuple[int, int]] = set()
         self.last_runtime: float = float("nan")
         self.last_included_compile: bool = True
@@ -66,6 +82,11 @@ class Evaluator:
             if extra_h or extra_w:
                 i1 = replicate_pad(i1, (0, extra_w, 0, extra_h))
                 i2 = replicate_pad(i2, (0, extra_w, 0, extra_h))
+        if self._in_sharding is not None:
+            import jax
+
+            i1 = jax.device_put(i1, self._in_sharding)
+            i2 = jax.device_put(i2, self._in_sharding)
         shape = tuple(i1.shape[1:3])
         self.last_included_compile = shape not in self.compiled_shapes
         start = time.perf_counter()
