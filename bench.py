@@ -218,9 +218,8 @@ def main() -> None:
     # The image's site hook imports jax at interpreter startup, freezing the
     # platform before JAX_PLATFORMS from the shell can apply — push it
     # through jax.config so `JAX_PLATFORMS=cpu python bench.py` works.
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from raftstereo_tpu.utils import apply_env_platform
+    apply_env_platform()
 
     if args.train:
         if args.realtime:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import time
 from typing import Optional, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -55,9 +56,18 @@ class Evaluator:
         # numerically transparent, tests/test_parallel.py).
         self._in_sharding = None
         if mesh is not None:
-            import jax
-
-            from ..parallel import replicated, spatial_sharded
+            from ..parallel import SPACE_AXIS, replicated, spatial_sharded
+            space = mesh.shape.get(SPACE_AXIS, 1)
+            # The final padded height is a multiple of bucket_multiple when
+            # set, else of divis_by; sharding H over 'space' needs that to be
+            # divisible, so fail fast with the fix.
+            governing = ("bucket_multiple", self.bucket_multiple) \
+                if self.bucket_multiple else ("divis_by", self.divis_by)
+            if governing[1] % space:
+                raise ValueError(
+                    f"mesh '{SPACE_AXIS}' extent {space} must divide "
+                    f"{governing[0]}={governing[1]}; pass {governing[0]}="
+                    f"{governing[1] * space} (or a multiple of {space})")
             self._in_sharding = spatial_sharded(mesh)
             # Weights restored from a checkpoint arrive committed to one
             # device; jit refuses mixed device sets, so replicate them onto
@@ -83,8 +93,6 @@ class Evaluator:
                 i1 = replicate_pad(i1, (0, extra_w, 0, extra_h))
                 i2 = replicate_pad(i2, (0, extra_w, 0, extra_h))
         if self._in_sharding is not None:
-            import jax
-
             i1 = jax.device_put(i1, self._in_sharding)
             i2 = jax.device_put(i2, self._in_sharding)
         shape = tuple(i1.shape[1:3])

@@ -1,5 +1,7 @@
 """Utilities: checkpoint conversion, logging, misc."""
 
 from .convert import convert_checkpoint, load_state_dict, torch_to_variables
+from .platform import apply_env_platform
 
-__all__ = ["convert_checkpoint", "load_state_dict", "torch_to_variables"]
+__all__ = ["apply_env_platform", "convert_checkpoint", "load_state_dict",
+           "torch_to_variables"]

@@ -1,0 +1,32 @@
+"""JAX platform selection that works under eager-importing site hooks.
+
+This image's site hook imports jax at interpreter startup, freezing the
+``JAX_PLATFORMS`` env var before a shell-provided value (or one set by a
+driver) can take effect.  ``jax.config`` still works until the first backend
+initialization, so route the request through it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def apply_env_platform(override: Optional[str] = None) -> Optional[str]:
+    """Re-apply the requested JAX platform through ``jax.config``.
+
+    ``override`` wins over the ``JAX_PLATFORMS`` env var.  Returns the
+    platform applied (or None if nothing was requested).  A no-op when the
+    backend is already initialized on some platform — callers get whatever
+    that first initialization picked.
+    """
+    plat = override or os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return None
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except RuntimeError:
+        return None  # backend already initialized; keep its choice
+    return plat

@@ -17,9 +17,13 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax
+from raftstereo_tpu.utils.platform import apply_env_platform
 
-jax.config.update("jax_platforms", "cpu")
+assert apply_env_platform("cpu") == "cpu", (
+    "JAX backend initialized before conftest could force CPU; the suite "
+    "would run on the wrong platform")
+
+import jax
 
 import numpy as np
 import pytest
