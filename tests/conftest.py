@@ -19,9 +19,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 from raftstereo_tpu.utils.platform import apply_env_platform
 
-assert apply_env_platform("cpu") == "cpu", (
-    "JAX backend initialized before conftest could force CPU; the suite "
-    "would run on the wrong platform")
+if apply_env_platform("cpu") != "cpu":  # not an assert: python -O strips those
+    raise RuntimeError(
+        "JAX backend initialized before conftest could force CPU; the suite "
+        "would run on the wrong platform")
 
 import jax
 
