@@ -182,28 +182,38 @@ def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
 
 
 def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
-                            num_levels: int, radius: int) -> CorrFn:
+                            num_levels: int, radius: int,
+                            dtype=jnp.float32) -> CorrFn:
     """On-demand Pallas backend: O(H*W) HBM like ``alt``, but each W1-block's
     correlation rows are recomputed inside a TPU kernel (MXU matmul + hat
     reduction in VMEM).  Working form of the reference's dead ``alt_cuda``
     backend (reference: core/corr.py:159-188 raises NotImplementedError)."""
-    from .pallas_alt import (pallas_alt_lookup_flat, preflatten_fmap1,
-                             preflatten_fmap2)
+    from .pallas_alt import (pad_w2_lane, pallas_alt_pyramid_flat,
+                             preflatten_fmap1, preflatten_fmap2)
 
     # Flatten/pad ONCE so each corr_fn call touches only the taps (the f1
-    # pad is a full-fmap HBM copy; one copy guaranteed structurally).
-    f1flat = preflatten_fmap1(fmap1.astype(jnp.float32))
-    f2_pyramid = [preflatten_fmap2(f2) for f2 in
+    # pad is a full-fmap HBM copy; one copy guaranteed structurally). The
+    # fmap2 pyramid is concatenated along W2 so every per-iteration lookup
+    # is ONE kernel launch covering all levels — the per-level variant is
+    # launch-overhead-bound (~4x slower at 1/4-res flagship shapes).
+    # ``dtype`` selects the stored/matmul precision (the CUDA kernel's
+    # fp32+fp16 dispatch, sampler_kernel.cu:126): bf16 halves the kernel's
+    # DMA and takes the MXU's native bf16 path (fp32 accumulation). The
+    # pyramid is always POOLED in fp32 first; only the kernel inputs are
+    # rounded.
+    f1flat = preflatten_fmap1(fmap1.astype(jnp.float32)).astype(dtype)
+    f2_pyramid = [pad_w2_lane(preflatten_fmap2(f2)).astype(dtype) for f2 in
                   build_fmap2_pyramid(fmap2.astype(jnp.float32), num_levels)]
+    w2s = tuple(f2.shape[1] for f2 in f2_pyramid)
+    f2cat = jnp.concatenate(f2_pyramid, axis=1)
     offsets = _tap_offsets(radius)
 
     def corr_fn(coords: jax.Array) -> jax.Array:
         x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
-        out = []
-        for i, f2f in enumerate(f2_pyramid):
-            taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
-            out.append(pallas_alt_lookup_flat(f1flat, f2f, taps))
-        return jnp.concatenate(out, axis=-1)
+        taps = jnp.concatenate(
+            [x[..., None] / (2.0 ** i) + offsets        # (B, H, W1, K)
+             for i in range(len(w2s))], axis=-1)
+        return pallas_alt_pyramid_flat(f1flat, f2cat, taps, w2s)
 
     return corr_fn
 
@@ -219,5 +229,6 @@ def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
         return make_pallas_corr_fn(fmap1, fmap2, num_levels, radius,
                                    dtype=dtype)
     if implementation == "pallas_alt":
-        return make_pallas_alt_corr_fn(fmap1, fmap2, num_levels, radius)
+        return make_pallas_alt_corr_fn(fmap1, fmap2, num_levels, radius,
+                                       dtype=dtype)
     raise ValueError(f"unknown corr implementation: {implementation}")

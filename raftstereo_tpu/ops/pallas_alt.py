@@ -43,43 +43,74 @@ from jax.experimental.pallas import tpu as pltpu
 from .pallas_corr import _block_w1, _interpret, _pad_taps, _pad_w1
 
 
-def _alt_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale):
-    """One (n, w1-block): out[x1, k] = sum_j M[x1, j] * hat(j - taps[x1, k])."""
-    f1 = f1_ref[0].astype(jnp.float32)            # (blk, C)
-    f2 = f2_ref[0].astype(jnp.float32)            # (W2, C)
-    taps = taps_ref[0].astype(jnp.float32)        # (blk, K)
+def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds):
+    """Fused all-levels lookup: the fmap2 pyramid is concatenated along W2
+    and every level's taps are resolved against one (blk x W2cat) matmul:
+    out[x1, l*K + k] = sum_j M_l[x1, j] * hat(j - taps[x1, l*K + k]).
+
+    One kernel launch per (row, w1-block) instead of one per level.
+    ``bounds`` is a static tuple of (offset, width) per level; static
+    lane-aligned slices of the matmul result keep each tap's hat reduction
+    inside its own level (see the body comment), so zero-outside semantics
+    at level edges are preserved exactly. The single-level
+    ``pallas_alt_lookup`` path is this same kernel with bounds=((0, w2),).
+    """
+    # Feed the MXU the stored dtype directly: bf16 inputs take the native
+    # bf16 path with fp32 accumulation (HIGHEST would force a multi-pass
+    # fp32 emulation ~8x slower); fp32 inputs keep exact fp32.
+    f1 = f1_ref[0]                                # (blk, C)
+    f2 = f2_ref[0]                                # (W2cat, C)
+    taps = taps_ref[0].astype(jnp.float32)        # (blk, L*K)
+    prec = (jax.lax.Precision.HIGHEST if f1.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
     m = jax.lax.dot_general(f1, f2, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32,
-                            precision=jax.lax.Precision.HIGHEST) * scale
-    w2 = f2.shape[0]
-    j = jax.lax.broadcasted_iota(jnp.int32, (1, w2), 1).astype(jnp.float32)
+                            precision=prec) * scale
+    kk = taps.shape[-1] // len(bounds)
     cols = []
-    for ki in range(taps.shape[-1]):              # K is small (9): unrolled
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
-        cols.append(jnp.sum(m * w, axis=-1))
+    for li, (off, w2p) in enumerate(bounds):
+        # Static lane-aligned slice: each tap's hat reduction sweeps only
+        # its own level's columns (masking the full concat row costs L x
+        # the VPU work; unaligned slices cost lane-realignment copies —
+        # both measured slower than per-level kernel launches). Levels are
+        # zero-padded to lane multiples, and a padded column's m is exactly
+        # zero, so no mask is needed for correct zero-outside semantics.
+        ml = m[:, off:off + w2p]
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, w2p), 1).astype(jnp.float32)
+        for ki in range(kk):                      # L*K is small: unrolled
+            t = taps[:, li * kk + ki][:, None]
+            w = jnp.maximum(0.0, 1.0 - jnp.abs(j - t))
+            cols.append(jnp.sum(ml * w, axis=-1))
     out_ref[0] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
 
 
-def _alt_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
-                    scale):
-    f1 = f1_ref[0].astype(jnp.float32)            # (blk, C)
-    f2 = f2_ref[0].astype(jnp.float32)            # (W2, C)
-    taps = taps_ref[0].astype(jnp.float32)        # (blk, K)
-    g = g_ref[0].astype(jnp.float32)              # (blk, K)
-    w2 = f2.shape[0]
-    j = jax.lax.broadcasted_iota(jnp.int32, (1, w2), 1).astype(jnp.float32)
-    dm = jnp.zeros((taps.shape[0], w2), jnp.float32)
-    for ki in range(taps.shape[-1]):
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
-        dm = dm + g[:, ki][:, None] * w
-    dm = dm * scale
+def _alt_pyr_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
+                        scale, bounds):
+    f1 = f1_ref[0]                                # (blk, C)
+    f2 = f2_ref[0]                                # (W2cat, C)
+    prec = (jax.lax.Precision.HIGHEST if f1.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    taps = taps_ref[0].astype(jnp.float32)        # (blk, L*K)
+    g = g_ref[0].astype(jnp.float32)              # (blk, L*K)
+    kk = taps.shape[-1] // len(bounds)
+    parts = []
+    for li, (off, w2p) in enumerate(bounds):
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, w2p), 1).astype(jnp.float32)
+        dml = jnp.zeros((taps.shape[0], w2p), jnp.float32)
+        for ki in range(kk):
+            t = taps[:, li * kk + ki][:, None]
+            w = jnp.maximum(0.0, 1.0 - jnp.abs(j - t))
+            dml = dml + g[:, li * kk + ki][:, None] * w
+        parts.append(dml)
+    # Gradient mass landing on a level's zero-padded columns (a tap within 1
+    # of the level edge) flows into df2 rows that the caller's concat-pad
+    # autodiff discards — matching the per-level kernels exactly.
+    dm = (jnp.concatenate(parts, axis=-1) * scale).astype(f1.dtype)
     df1_ref[0] = jax.lax.dot_general(
         dm, f2, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST).astype(df1_ref.dtype)
+        precision=prec).astype(df1_ref.dtype)
 
-    # dfmap2 accumulates over all W1 blocks of this row; the W1-block index is
-    # the innermost grid dimension, so iterations land here sequentially.
     @pl.when(pl.program_id(1) == 0)
     def _init():
         df2_ref[0] = jnp.zeros_like(df2_ref[0])
@@ -87,7 +118,7 @@ def _alt_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
     df2_ref[0] += jax.lax.dot_general(
         dm, f1, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST).astype(df2_ref.dtype)
+        precision=prec).astype(df2_ref.dtype)
 
 
 def preflatten_fmap1(fmap1: jax.Array) -> jax.Array:
@@ -109,9 +140,11 @@ def preflatten_fmap2(fmap2: jax.Array) -> jax.Array:
 def pallas_alt_lookup_flat(f1flat: jax.Array, f2flat: jax.Array,
                            taps: jax.Array) -> jax.Array:
     """Lookup against preflattened feature maps; taps stay in model layout
-    (B, H, W1, K) and are the only tensor reshaped per call."""
-    return _make_alt(f1flat.shape, f2flat.shape, f1flat.dtype.name,
-                     f2flat.dtype.name)(f1flat, f2flat, taps)
+    (B, H, W1, K) and are the only tensor reshaped per call. Single-level
+    special case of the fused pyramid kernel."""
+    return _make_alt_pyr(f1flat.shape, f2flat.shape, (f2flat.shape[1],),
+                         f1flat.dtype.name, f2flat.dtype.name)(
+                             f1flat, f2flat, taps)
 
 
 def pallas_alt_lookup(fmap1: jax.Array, fmap2: jax.Array,
@@ -129,18 +162,57 @@ def pallas_alt_lookup(fmap1: jax.Array, fmap2: jax.Array,
                                   preflatten_fmap2(fmap2), taps)
 
 
-@functools.lru_cache(maxsize=None)
-def _make_alt(f1flat_shape, f2flat_shape, f1_dtype, f2_dtype):
-    @jax.custom_vjp
-    def f(f1flat, f2flat, taps):
-        return _alt_fwd_impl(f1flat, f2flat, taps)
+_LANE = 128
 
-    def fwd(f1flat, f2flat, taps):
-        return _alt_fwd_impl(f1flat, f2flat, taps), (f1flat, f2flat, taps)
+
+def pad_w2_lane(f2flat: jax.Array) -> jax.Array:
+    """Zero-pad a preflattened (B*H, W2, C) level to a lane-multiple W2 so
+    its slice inside the fused kernel is lane-aligned. Zero rows correlate
+    to exactly zero, so the padding never changes a lookup result."""
+    w2 = f2flat.shape[1]
+    pad = (-w2) % _LANE
+    if not pad:
+        return f2flat
+    return jnp.pad(f2flat, ((0, 0), (0, pad), (0, 0)))
+
+
+def pallas_alt_pyramid_flat(f1flat: jax.Array, f2cat: jax.Array,
+                            taps: jax.Array, w2s: tuple) -> jax.Array:
+    """All pyramid levels in ONE kernel call.
+
+    f1flat: (B*H, W1p, C) from preflatten_fmap1; f2cat: (B*H, sum(w2s), C) —
+    the per-level preflattened, ``pad_w2_lane``-padded fmap2 pyramid
+    concatenated along W2; taps: (B, H, W1, L*K) per-level LOCAL tap
+    coordinates, level-major; w2s: static per-level PADDED widths (each a
+    lane multiple). Returns (B, H, W1, L*K) float32 with the exact
+    per-level ``pallas_alt_lookup`` semantics (equivalence pinned in
+    tests/test_pallas_alt.py).
+    """
+    return _make_alt_pyr(f1flat.shape, f2cat.shape, tuple(w2s),
+                         f1flat.dtype.name, f2cat.dtype.name)(
+                             f1flat, f2cat, taps)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_alt_pyr(f1flat_shape, f2cat_shape, w2s, f1_dtype, f2_dtype):
+    bounds = []
+    off = 0
+    for w2 in w2s:
+        bounds.append((off, w2))
+        off += w2
+    bounds = tuple(bounds)
+
+    @jax.custom_vjp
+    def f(f1flat, f2cat, taps):
+        return _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds)
+
+    def fwd(f1flat, f2cat, taps):
+        return _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds), (
+            f1flat, f2cat, taps)
 
     def bwd(res, g):
-        f1flat, f2flat, taps = res
-        df1, df2 = _alt_bwd_impl(f1flat, f2flat, taps, g)
+        f1flat, f2cat, taps = res
+        df1, df2 = _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds)
         return (df1.astype(f1_dtype), df2.astype(f2_dtype),
                 jnp.zeros_like(taps))
 
@@ -148,62 +220,59 @@ def _make_alt(f1flat_shape, f2flat_shape, f1_dtype, f2_dtype):
     return f
 
 
-def _alt_fwd_impl(f1flat, f2flat, taps):
+def _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds):
     n, w1p, c = f1flat.shape
-    w2 = f2flat.shape[1]
-    b, h, w1, kk = taps.shape
+    b, h, w1, lk = taps.shape
     t, blk = _pad_taps(taps)
     scale = 1.0 / float(c) ** 0.5
+    w2cat = f2cat.shape[1]
     out = pl.pallas_call(
-        functools.partial(_alt_fwd_kernel, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((n, w1p, kk), jnp.float32),
+        functools.partial(_alt_pyr_fwd_kernel, scale=scale, bounds=bounds),
+        out_shape=jax.ShapeDtypeStruct((n, w1p, lk), jnp.float32),
         grid=(n, w1p // blk),
         in_specs=[
             pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, w2, c), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, w2cat, c), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, blk, lk), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+        out_specs=pl.BlockSpec((1, blk, lk), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
-    )(f1flat, f2flat, t)
-    return out[:, :w1].reshape(b, h, w1, kk)
+    )(f1flat, f2cat, t)
+    return out[:, :w1].reshape(b, h, w1, lk)
 
 
-def _alt_bwd_impl(f1flat, f2flat, taps, g):
+def _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds):
     n, w1p, c = f1flat.shape
-    w2 = f2flat.shape[1]
-    b, h, w1, kk = taps.shape
+    b, h, w1, lk = taps.shape
     t, blk = _pad_taps(taps)
-    gg, _ = _pad_w1(g.reshape(b * h, w1, kk), blk)
-    # Padded g rows are zero, so padded rows contribute nothing to df2 and
-    # their df1 rows are themselves zero — the flat grads map back through
-    # the one-time preflatten reshapes by ordinary autodiff.
+    gg, _ = _pad_w1(g.reshape(b * h, w1, lk), blk)
     scale = 1.0 / float(c) ** 0.5
+    w2cat = f2cat.shape[1]
     df1, df2 = pl.pallas_call(
-        functools.partial(_alt_bwd_kernel, scale=scale),
+        functools.partial(_alt_pyr_bwd_kernel, scale=scale, bounds=bounds),
         out_shape=(jax.ShapeDtypeStruct((n, w1p, c), jnp.float32),
-                   jax.ShapeDtypeStruct((n, w2, c), jnp.float32)),
+                   jax.ShapeDtypeStruct((n, w2cat, c), jnp.float32)),
         grid=(n, w1p // blk),
         in_specs=[
             pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, w2, c), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, w2cat, c), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, blk, lk), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk, kk), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, blk, lk), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
             pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, w2, c), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, w2cat, c), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ),
         interpret=_interpret(),
-    )(f1flat, f2flat, t, gg)
+    )(f1flat, f2cat, t, gg)
     return df1, df2

@@ -63,6 +63,31 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-2, atol=1e-2)
 
+    def test_bf16_dtype_option(self, fmaps, coords):
+        """make_corr_fn(dtype=bf16) stores the pyramid in bf16 (the CUDA
+        kernel's fp16 dispatch analogue); results match fp32 at bf16
+        input-quantization tolerance."""
+        f1, f2 = fmaps
+        got = make_corr_fn("pallas_alt", f1, f2, 3, 3,
+                           dtype=jnp.bfloat16)(coords)
+        want = make_corr_fn("pallas_alt", f1, f2, 3, 3)(coords)
+        assert got.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_level_edge_taps(self, fmaps):
+        """Taps within 1 of a level's right edge: the hat support crosses
+        into the fused kernel's zero-padded columns, which must contribute
+        exactly zero (same zero-outside semantics as the reg oracle)."""
+        f1, f2 = fmaps
+        b, h, w1, _ = 2, 3, 40, None
+        # Per-level widths 40,20,10: park every tap at w2_l - 0.5.
+        x = jnp.full((b, h, w1, 1), 39.0, jnp.float32)
+        got = make_corr_fn("pallas_alt", f1, f2, 3, 0)(x)   # radius 0: 1 tap/level
+        want = make_corr_fn("reg", f1, f2, 3, 0)(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
     def test_non_block_aligned_w1(self, rng):
         f1 = jnp.asarray(rng.standard_normal((1, 2, 10, 16)).astype(np.float32))
         f2 = jnp.asarray(rng.standard_normal((1, 2, 13, 16)).astype(np.float32))
@@ -111,15 +136,42 @@ class TestBackward:
 
         try:
             pc._BLOCK_W1 = 8   # force 5 blocks over W1=40
-            from raftstereo_tpu.ops.pallas_alt import _make_alt
-            _make_alt.cache_clear()
+            from raftstereo_tpu.ops.pallas_alt import _make_alt_pyr
+            _make_alt_pyr.cache_clear()
             got = jax.grad(loss)(f2)
         finally:
             pc._BLOCK_W1 = old
-            _make_alt.cache_clear()
+            _make_alt_pyr.cache_clear()
         want = jax.grad(loss)(f2)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-4, atol=1e-4)
+
+    def test_multi_block_multi_level_grads(self, rng):
+        """The fused pyramid path with W1 spanning several blocks AND several
+        levels: df2 accumulation and per-level slicing together, checked
+        against the XLA alt backend."""
+        from raftstereo_tpu.ops import pallas_corr as pc
+        from raftstereo_tpu.ops.pallas_alt import _make_alt_pyr
+        f1 = jnp.asarray(rng.standard_normal((1, 2, 40, 16)).astype(np.float32))
+        f2 = jnp.asarray(rng.standard_normal((1, 2, 40, 16)).astype(np.float32))
+        x = coords_grid_x(1, 2, 40) - 5.0
+
+        def loss(impl, a, b):
+            return jnp.sum(make_corr_fn(impl, a, b, 3, 2)(x) ** 2)
+
+        old = pc._BLOCK_W1
+        try:
+            pc._BLOCK_W1 = 16  # 3 blocks over W1=40
+            _make_alt_pyr.cache_clear()
+            got = jax.grad(lambda a, b: loss("pallas_alt", a, b),
+                           argnums=(0, 1))(f1, f2)
+        finally:
+            pc._BLOCK_W1 = old
+            _make_alt_pyr.cache_clear()
+        want = jax.grad(lambda a, b: loss("alt", a, b), argnums=(0, 1))(f1, f2)
+        for gp, ga in zip(got, want):
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(ga),
+                                       rtol=1e-4, atol=1e-4)
 
 
 class TestModelIntegration:
