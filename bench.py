@@ -152,6 +152,51 @@ def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
     return reps / dt
 
 
+def bench_data(batch: int, num_workers: int) -> float:
+    """Host data-pipeline throughput: KITTI-size decode + full dense
+    augmentation to the training crop, multiprocess workers, samples/sec.
+
+    The number to beat is the train step's consumption rate (steps/sec x
+    batch); the pipeline feeds the TPU (SURVEY.md §7 hard part 6 — the
+    reference leans on torch DataLoader workers, core/stereo_datasets.py:311).
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from PIL import Image
+
+    from raftstereo_tpu.data.codecs import write_disp_kitti
+    from raftstereo_tpu.data.datasets import KITTI
+    from raftstereo_tpu.data.loader import DataLoader
+
+    rng = np.random.default_rng(0)
+    root = tempfile.mkdtemp(prefix="bench_data_")
+    try:
+        for sub in ("image_2", "image_3", "disp_occ_0"):
+            os.makedirs(os.path.join(root, "training", sub))
+        for i in range(32):  # KITTI native resolution
+            for cam in ("image_2", "image_3"):
+                img = rng.integers(0, 255, (375, 1242, 3), dtype=np.uint8)
+                Image.fromarray(img).save(os.path.join(
+                    root, "training", cam, f"{i:06d}_10.png"))
+            disp = (rng.uniform(1, 60, (375, 1242)) * 256).astype(np.uint16)
+            write_disp_kitti(os.path.join(
+                root, "training", "disp_occ_0", f"{i:06d}_10.png"), disp)
+        ds = KITTI(aug_params={"crop_size": (320, 720)}, root=root) * 8
+        loader = DataLoader(ds, batch_size=batch, num_workers=num_workers)
+        n = 0
+        it = iter(loader)
+        next(it)  # warm the worker pool before timing
+        t0 = time.perf_counter()
+        for b in it:
+            n += b[0].shape[0]
+        dt = time.perf_counter() - t0
+        return n / dt
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
                            reps: int) -> float:
     """Run the reference PyTorch model (random weights) on CPU at the same
@@ -208,7 +253,24 @@ def main() -> None:
                    help="measure training steps/sec (full fwd+bwd+update) "
                         "instead of inference; use with --height 320 "
                         "--width 720 --batch 8 for the reference recipe")
+    p.add_argument("--data", action="store_true",
+                   help="measure host data-pipeline throughput (KITTI-size "
+                        "decode + dense augmentation, multiprocess workers) "
+                        "in samples/sec")
+    p.add_argument("--num_workers", type=int, default=None,
+                   help="worker processes for --data (default: SLURM-aware)")
     args = p.parse_args()
+
+    if args.data:
+        value = bench_data(args.batch, args.num_workers)
+        print(json.dumps({
+            "metric": f"data-pipeline samples/sec, KITTI decode + dense "
+                      f"aug to 320x720, batch {args.batch}",
+            "value": round(value, 2),
+            "unit": "samples/sec",
+            "vs_baseline": 0.0,
+        }))
+        return
 
     if args.quick:
         args.height, args.width, args.iters, args.reps = 256, 320, 8, 3

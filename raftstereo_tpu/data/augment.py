@@ -39,10 +39,21 @@ def resize_bilinear(arr: np.ndarray, fx: float, fy: float) -> np.ndarray:
     y0, y1, wy = axis_idx(h, oh)
     x0, x1, wx = axis_idx(w, ow)
     a = arr.astype(np.float32)
-    a = a[y0] * (1 - wy)[:, None, *([None] * (arr.ndim - 2))] + \
-        a[y1] * wy[:, None, *([None] * (arr.ndim - 2))]
-    a = a[:, x0] * (1 - wx)[None, :, *([None] * (arr.ndim - 2))] + \
-        a[:, x1] * wx[None, :, *([None] * (arr.ndim - 2))]
+    # In-place accumulation on the fancy-index copies: same arithmetic as
+    # t0*(1-w) + t1*w with half the full-size temporaries (this runs per
+    # sample on the host; the loader is CPU-bound, SURVEY.md §7 part 6).
+    trail = [None] * (arr.ndim - 2)
+    wy_b, wx_b = wy[:, None, *trail], wx[None, :, *trail]
+    t = a[y1]
+    t -= a[y0]
+    t *= wy_b
+    t += a[y0]
+    a = t
+    t = a[:, x1]
+    t -= a[:, x0]
+    t *= wx_b
+    t += a[:, x0]
+    a = t
     if np.issubdtype(arr.dtype, np.integer):
         info = np.iinfo(arr.dtype)
         return np.clip(np.round(a), info.min, info.max).astype(arr.dtype)
@@ -264,18 +275,15 @@ class SparseFlowAugmentor:
         """Rescale sparse flow by scattering valid samples into the new grid
         (reference: core/utils/augmentor.py:223-255)."""
         ht, wd = flow.shape[:2]
-        xx, yy = np.meshgrid(np.arange(wd), np.arange(ht))
-        coords = np.stack([xx, yy], axis=-1).reshape(-1, 2).astype(np.float32)
-        flow_f = flow.reshape(-1, 2).astype(np.float32)
-        valid_f = valid.reshape(-1).astype(np.float32)
-
-        coords0 = coords[valid_f >= 1]
-        flow0 = flow_f[valid_f >= 1]
+        # Index only the valid pixels instead of materializing a full
+        # (H*W, 2) coordinate grid per call — the scatter itself touches a
+        # few thousand points, the grid was ~10x the whole function's work.
+        ys, xs = np.nonzero(valid >= 1)
+        flow0 = flow[ys, xs].astype(np.float32)
         ht1, wd1 = int(round(ht * fy)), int(round(wd * fx))
-        coords1 = coords0 * [fx, fy]
-        flow1 = flow0 * [fx, fy]
-        xi = np.round(coords1[:, 0]).astype(np.int32)
-        yi = np.round(coords1[:, 1]).astype(np.int32)
+        flow1 = flow0 * np.asarray([fx, fy])          # f64, as before
+        xi = np.round(xs * fx).astype(np.int32)
+        yi = np.round(ys * fy).astype(np.int32)
         keep = (xi > 0) & (xi < wd1) & (yi > 0) & (yi < ht1)
         flow_img = np.zeros((ht1, wd1, 2), np.float32)
         valid_img = np.zeros((ht1, wd1), np.int32)
