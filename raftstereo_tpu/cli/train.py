@@ -24,7 +24,8 @@ import sys
 import numpy as np
 
 from ..config import TrainConfig, add_model_args, model_config_from_args
-from ..data.datasets import build_aug_params, fetch_dataset
+from ..data.datasets import (build_aug_params, fetch_dataset,
+                             take_photometric_params)
 from ..data.loader import DataLoader
 from ..eval import validate_things
 from ..models import RAFTStereo
@@ -73,11 +74,18 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="auto-restart the loop from the latest checkpoint "
                         "this many times after a crash (elastic recovery)")
     a = p.add_argument_group("augmentation (reference: train_stereo.py:244-248)")
-    a.add_argument("--img_gamma", type=float, nargs=2, default=None)
+    a.add_argument("--img_gamma", type=float, nargs="+", default=None,
+                   help="gamma range: GMIN GMAX [GAIN_MIN GAIN_MAX] "
+                        "(reference: train_stereo.py:244)")
     a.add_argument("--saturation_range", type=float, nargs=2, default=None)
     a.add_argument("--do_flip", choices=["h", "v"], default=None)
     a.add_argument("--spatial_scale", type=float, nargs=2, default=[0.0, 0.0])
     a.add_argument("--noyjitter", action="store_true")
+    a.add_argument("--device_photometric", action="store_true",
+                   help="run the photometric chain (jitter + eraser) "
+                        "on-device inside the jitted train step instead of "
+                        "in host workers — for CPU-starved hosts "
+                        "(data/device_aug.py)")
 
 
 def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
@@ -92,7 +100,8 @@ def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
         img_gamma=args.img_gamma, saturation_range=args.saturation_range,
         do_flip=args.do_flip, spatial_scale=tuple(args.spatial_scale),
         noyjitter=args.noyjitter, data_parallel=args.data_parallel,
-        nan_policy=args.nan_policy, max_restarts=args.max_restarts)
+        nan_policy=args.nan_policy, max_restarts=args.max_restarts,
+        device_photometric=args.device_photometric)
 
 
 def train(model_cfg, cfg: TrainConfig, dataset=None,
@@ -143,6 +152,14 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                   ("sceneflow", "kitti", "middlebury", "sintel",
                    "falling_things", "tartanair")} if dataset_root else None)
         dataset = fetch_dataset(cfg.train_datasets, aug, roots)
+    photometric_params = None
+    if cfg.device_photometric:
+        # Disables host jitter+eraser on EVERY leaf (including
+        # caller-supplied datasets — otherwise they'd be augmented twice)
+        # and mirrors the host augmentors' exact parameter set on-device.
+        photometric_params = take_photometric_params(dataset)
+        logger.info("Photometric augmentation on-device "
+                    "(--device_photometric): %s", photometric_params)
     loader = DataLoader(dataset, cfg.batch_size, shuffle=True, drop_last=True,
                         num_workers=num_workers, seed=cfg.seed)
     logger.info("Train loader: %d samples, %d batches/epoch",
@@ -152,7 +169,9 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
             f"empty train loader: {len(dataset)} samples < batch_size "
             f"{cfg.batch_size} (check --train_datasets/--dataset_root)")
 
-    step_fn = jit_train_step(make_train_step(model, tx, cfg, schedule), mesh)
+    step_fn = jit_train_step(
+        make_train_step(model, tx, cfg, schedule,
+                        photometric_params=photometric_params), mesh)
     metrics_logger = Logger(log_dir=os.path.join("runs", cfg.name),
                             total_steps=int(state.step))
     from ..utils.profiling import StepProfiler

@@ -99,11 +99,16 @@ class TrainConfig:
     keep_checkpoints: int = 5
 
     # Data augmentation (reference: train_stereo.py:244-248).
-    img_gamma: Optional[Tuple[float, float]] = None
+    # img_gamma: (GMIN, GMAX) or (GMIN, GMAX, GAIN_MIN, GAIN_MAX).
+    img_gamma: Optional[Tuple[float, ...]] = None
     saturation_range: Optional[Tuple[float, float]] = None
     do_flip: Optional[str] = None  # None | "h" | "v"
     spatial_scale: Tuple[float, float] = (0.0, 0.0)
     noyjitter: bool = False
+    # Run the photometric chain (jitter + eraser) on-device inside the
+    # jitted train step instead of in host workers (data/device_aug.py) —
+    # for hosts whose CPUs can't feed the chip.
+    device_photometric: bool = False
 
     # Parallelism: number of data-parallel shards (devices along the "data"
     # mesh axis); None = all visible devices.

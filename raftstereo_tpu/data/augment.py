@@ -141,7 +141,7 @@ class FlowAugmentor:
 
     def __init__(self, crop_size: Tuple[int, int], min_scale=-0.2, max_scale=0.5,
                  do_flip=False, yjitter=False, saturation_range=(0.6, 1.4),
-                 gamma=(1, 1, 1, 1)):
+                 gamma=(1, 1, 1, 1), photometric=True):
         self.crop_size = tuple(crop_size)
         self.min_scale = min_scale
         self.max_scale = max_scale
@@ -152,6 +152,9 @@ class FlowAugmentor:
         self.do_flip = do_flip
         self.h_flip_prob = 0.5
         self.v_flip_prob = 0.1
+        # photometric=False skips jitter+eraser on the host — they run
+        # on-device instead (data/device_aug.py, --device_photometric).
+        self.photometric = photometric
         self.photo = ColorJitter(brightness=0.4, contrast=0.4,
                                  saturation=saturation_range, hue=0.5 / 3.14,
                                  gamma=gamma)
@@ -225,8 +228,9 @@ class FlowAugmentor:
         return img1, img2, flow
 
     def __call__(self, img1, img2, flow, rng: np.random.Generator):
-        img1, img2 = self.color_transform(img1, img2, rng)
-        img1, img2 = self.eraser_transform(img1, img2, rng)
+        if self.photometric:
+            img1, img2 = self.color_transform(img1, img2, rng)
+            img1, img2 = self.eraser_transform(img1, img2, rng)
         img1, img2, flow = self.spatial_transform(img1, img2, flow, rng)
         return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
                 np.ascontiguousarray(flow))
@@ -240,7 +244,7 @@ class SparseFlowAugmentor:
 
     def __init__(self, crop_size: Tuple[int, int], min_scale=-0.2, max_scale=0.5,
                  do_flip=False, yjitter=False, saturation_range=(0.7, 1.3),
-                 gamma=(1, 1, 1, 1)):
+                 gamma=(1, 1, 1, 1), photometric=True):
         self.crop_size = tuple(crop_size)
         self.min_scale = min_scale
         self.max_scale = max_scale
@@ -248,6 +252,7 @@ class SparseFlowAugmentor:
         self.do_flip = do_flip
         self.h_flip_prob = 0.5
         self.v_flip_prob = 0.1
+        self.photometric = photometric
         self.photo = ColorJitter(brightness=0.3, contrast=0.3,
                                  saturation=saturation_range, hue=0.3 / 3.14,
                                  gamma=gamma)
@@ -331,8 +336,9 @@ class SparseFlowAugmentor:
         return img1, img2, flow, valid
 
     def __call__(self, img1, img2, flow, valid, rng: np.random.Generator):
-        img1, img2 = self.color_transform(img1, img2, rng)
-        img1, img2 = self.eraser_transform(img1, img2, rng)
+        if self.photometric:
+            img1, img2 = self.color_transform(img1, img2, rng)
+            img1, img2 = self.eraser_transform(img1, img2, rng)
         img1, img2, flow, valid = self.spatial_transform(img1, img2, flow,
                                                          valid, rng)
         return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),

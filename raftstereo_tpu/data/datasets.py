@@ -320,6 +320,55 @@ class Middlebury(StereoDataset):
 
 # ----------------------------------------------------------------- mixing
 
+def expand_img_gamma(img_gamma):
+    """(GMIN, GMAX) shorthand -> (GMIN, GMAX, GAIN_MIN, GAIN_MAX)."""
+    g = tuple(img_gamma)
+    if len(g) == 2:
+        g = g + (1.0, 1.0)
+    if len(g) != 4:
+        raise ValueError(f"img_gamma needs 2 or 4 values, got {g}")
+    return g
+
+
+def take_photometric_params(dataset):
+    """Disable host photometric augmentation on every leaf of ``dataset``
+    and return the parameters the host WOULD have used, as kwargs for
+    ``device_aug.DevicePhotometric`` — so --device_photometric mirrors the
+    exact per-dataset distribution (sparse augmentors use smaller ranges
+    and are always symmetric; reference: core/utils/augmentor.py:78,200).
+
+    Raises if the mix combines dense and sparse augmentors: one device
+    parameter set cannot reproduce two host distributions.
+    """
+    from .augment import FlowAugmentor
+
+    leaves = dataset.parts if isinstance(dataset, ConcatDataset) else [dataset]
+    params = None
+    kinds = set()
+    for leaf in leaves:
+        aug = getattr(leaf, "augmentor", None)
+        if aug is None:
+            continue
+        aug.photometric = False
+        kinds.add("dense" if isinstance(aug, FlowAugmentor) else "sparse")
+        params = dict(
+            brightness=aug.photo.brightness, contrast=aug.photo.contrast,
+            saturation=aug.photo.saturation, hue=aug.photo.hue,
+            gamma=aug.photo.gamma,
+            asymmetric_prob=getattr(aug, "asymmetric_color_aug_prob", 0.0),
+            eraser_prob=aug.eraser_aug_prob)
+    if len(kinds) > 1:
+        raise ValueError(
+            "--device_photometric cannot mix dense- and sparse-augmented "
+            "datasets (their photometric distributions differ); train them "
+            "with host augmentation or in separate runs")
+    if params is None:
+        raise ValueError(
+            "--device_photometric needs an augmented training dataset "
+            "(crop_size in aug_params)")
+    return params
+
+
 def build_aug_params(image_size, spatial_scale=(0.0, 0.0), noyjitter=False,
                      saturation_range=None, img_gamma=None, do_flip=None):
     """Flag translation (reference: core/stereo_datasets.py:280-286)."""
@@ -329,7 +378,7 @@ def build_aug_params(image_size, spatial_scale=(0.0, 0.0), noyjitter=False,
     if saturation_range is not None:
         aug_params["saturation_range"] = tuple(saturation_range)
     if img_gamma is not None:
-        aug_params["gamma"] = tuple(img_gamma)
+        aug_params["gamma"] = expand_img_gamma(img_gamma)
     if do_flip is not None:
         aug_params["do_flip"] = do_flip
     return aug_params

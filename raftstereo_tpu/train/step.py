@@ -23,10 +23,17 @@ from .state import TrainState
 Batch = Tuple[jax.Array, jax.Array, jax.Array, jax.Array]  # img1,img2,disp,valid
 
 
-def make_train_step(model, tx, cfg: TrainConfig,
-                    lr_schedule=None) -> Callable[[TrainState, Batch],
-                                                  Tuple[TrainState, Dict]]:
-    """Build the un-jitted (state, batch) -> (state, metrics) step."""
+def make_train_step(model, tx, cfg: TrainConfig, lr_schedule=None,
+                    photometric_params: Dict = None
+                    ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict]]:
+    """Build the un-jitted (state, batch) -> (state, metrics) step.
+
+    ``photometric_params``: kwargs for ``DevicePhotometric`` when
+    ``cfg.device_photometric`` — pass the output of
+    ``datasets.take_photometric_params(dataset)`` so the on-device chain
+    mirrors the exact host distribution (the CLI does). When None, dense
+    FlowAugmentor defaults modulated by cfg's saturation/gamma flags apply.
+    """
 
     def loss_fn(params, batch_stats, img1, img2, disp_gt, valid):
         variables = {"params": params}
@@ -36,8 +43,28 @@ def make_train_step(model, tx, cfg: TrainConfig,
         return sequence_loss(preds, disp_gt, valid,
                              loss_gamma=cfg.loss_gamma, max_flow=cfg.max_flow)
 
+    if cfg.device_photometric:
+        from ..data.device_aug import DevicePhotometric
+        photo_kw = photometric_params
+        if photo_kw is None:
+            from ..data.datasets import expand_img_gamma
+            photo_kw = {}
+            if cfg.saturation_range is not None:
+                photo_kw["saturation"] = cfg.saturation_range
+            if cfg.img_gamma is not None:
+                photo_kw["gamma"] = expand_img_gamma(cfg.img_gamma)
+        device_photo = DevicePhotometric(**photo_kw)
+        photo_key = jax.random.key(cfg.seed)
+    else:
+        device_photo = None
+
     def step(state: TrainState, batch: Batch):
         img1, img2, disp_gt, valid = batch
+        if device_photo is not None:
+            # Deterministic per-step randomness: fold the step counter into
+            # the seed key, split per sample inside (device_aug.py).
+            img1, img2 = device_photo(
+                jax.random.fold_in(photo_key, state.step), img1, img2)
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, img1, img2, disp_gt, valid)
         grad_norm = optax.global_norm(grads)

@@ -1,0 +1,165 @@
+"""On-device photometric augmentation (data/device_aug.py) vs the host ops
+(data/augment.py) and its train-step integration."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raftstereo_tpu.data import augment
+from raftstereo_tpu.data.device_aug import (DevicePhotometric, hsv_to_rgb,
+                                            rgb_to_hsv)
+
+
+@pytest.fixture
+def imgs(rng):
+    i1 = rng.uniform(0, 255, (2, 32, 48, 3)).astype(np.float32)
+    i2 = rng.uniform(0, 255, (2, 32, 48, 3)).astype(np.float32)
+    return jnp.asarray(i1), jnp.asarray(i2)
+
+
+class TestColorSpace:
+    def test_hsv_roundtrip(self, rng):
+        rgb = jnp.asarray(rng.uniform(0, 1, (3, 100)).astype(np.float32))
+        back = hsv_to_rgb(rgb_to_hsv(rgb))
+        np.testing.assert_allclose(np.asarray(back), np.asarray(rgb),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_full_hue_turn_is_identity(self, rng):
+        rgb = jnp.asarray(rng.uniform(0, 1, (3, 50)).astype(np.float32))
+        hsv = rgb_to_hsv(rgb)
+        rot = jnp.stack([(hsv[0] + 1.0) % 1.0, hsv[1], hsv[2]])
+        np.testing.assert_allclose(np.asarray(hsv_to_rgb(rot)),
+                                   np.asarray(rgb), rtol=1e-5, atol=1e-5)
+
+
+class TestDevicePhotometric:
+    def test_identity_params_no_eraser(self, imgs):
+        aug = DevicePhotometric(brightness=0.0, contrast=0.0,
+                                saturation=(1.0, 1.0), hue=0.0,
+                                eraser_prob=0.0)
+        o1, o2 = aug(jax.random.key(0), *imgs)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(imgs[0]),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(o2), np.asarray(imgs[1]),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_deterministic_per_key(self, imgs):
+        aug = DevicePhotometric()
+        a = aug(jax.random.key(7), *imgs)
+        b = aug(jax.random.key(7), *imgs)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        c = aug(jax.random.key(8), *imgs)
+        assert not np.allclose(np.asarray(a[0]), np.asarray(c[0]))
+
+    def test_symmetric_same_transform(self, imgs):
+        """asymmetric_prob=0: identical inputs get identical outputs."""
+        aug = DevicePhotometric(asymmetric_prob=0.0, eraser_prob=0.0)
+        o1, o2 = aug(jax.random.key(3), imgs[0], imgs[0])
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_output_range(self, imgs):
+        aug = DevicePhotometric()
+        o1, o2 = aug(jax.random.key(1), *imgs)
+        for o in (o1, o2):
+            o = np.asarray(o)
+            assert np.isfinite(o).all()
+            assert o.min() >= 0.0 and o.max() <= 255.0
+
+    def test_eraser_hits_only_img2(self, imgs):
+        aug = DevicePhotometric(brightness=0.0, contrast=0.0,
+                                saturation=(1.0, 1.0), hue=0.0,
+                                eraser_prob=1.0)
+        o1, o2 = aug(jax.random.key(5), *imgs)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(imgs[0]),
+                                   rtol=1e-4, atol=1e-3)
+        # Erased pixels equal the pre-eraser per-image mean color.
+        d = np.abs(np.asarray(o2) - np.asarray(imgs[1])).sum(-1)
+        assert (d > 1e-3).any(), "eraser_prob=1 must erase something"
+        mean = np.asarray(imgs[1]).reshape(2, -1, 3).mean(axis=1)
+        for b in range(2):
+            hit = d[b] > 1e-3
+            if hit.any():
+                np.testing.assert_allclose(
+                    np.asarray(o2)[b][hit],
+                    np.broadcast_to(mean[b], (hit.sum(), 3)), rtol=1e-3,
+                    atol=1e-2)
+
+    def test_brightness_matches_host(self, imgs):
+        """Brightness-only device op == host adjust_brightness for the same
+        factor (host path quantizes to uint8 at the end; compare pre-quant)."""
+        img = np.asarray(imgs[0][0])
+        f = 1.23
+        want = augment.adjust_brightness(img, f)
+        from raftstereo_tpu.data.device_aug import _brightness
+        got = np.asarray(_brightness(jnp.asarray(img), f))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-3)
+
+    def test_contrast_and_saturation_match_host(self, imgs):
+        img = np.asarray(imgs[0][0])
+        cf = jnp.asarray(img).transpose(2, 0, 1)      # ops are channel-first
+        from raftstereo_tpu.data.device_aug import _contrast, _gray, _saturation
+        m = jnp.mean(_gray(cf))
+        np.testing.assert_allclose(
+            np.asarray(_contrast(cf, 0.7, m)).transpose(1, 2, 0),
+            augment.adjust_contrast(img, 0.7), rtol=1e-4, atol=1e-2)
+        np.testing.assert_allclose(
+            np.asarray(_saturation(cf, 1.3)).transpose(1, 2, 0),
+            augment.adjust_saturation(img, 1.3), rtol=1e-4, atol=1e-2)
+
+
+class TestTakePhotometricParams:
+    def test_sparse_mirrors_host_and_disables(self, tmp_path, rng):
+        from test_data import make_synthetic_kitti
+        from raftstereo_tpu.data.datasets import (KITTI,
+                                                  take_photometric_params)
+        make_synthetic_kitti(tmp_path, rng=rng)
+        ds = KITTI(aug_params={"crop_size": (64, 96)}, root=str(tmp_path)) * 2
+        p = take_photometric_params(ds)
+        # Sparse augmentor values (augment.py SparseFlowAugmentor): smaller
+        # ranges, never asymmetric.
+        assert p["brightness"] == 0.3 and p["contrast"] == 0.3
+        assert p["saturation"] == (0.7, 1.3)
+        assert p["asymmetric_prob"] == 0.0
+        assert ds.augmentor.photometric is False  # host chain disabled
+
+    def test_mixed_kinds_rejected(self, tmp_path, rng):
+        from test_data import make_synthetic_kitti
+        from raftstereo_tpu.data.datasets import (KITTI,
+                                                  take_photometric_params)
+        from raftstereo_tpu.data.augment import FlowAugmentor
+        make_synthetic_kitti(tmp_path, rng=rng)
+        sparse = KITTI(aug_params={"crop_size": (64, 96)}, root=str(tmp_path))
+        dense = KITTI(aug_params=None, root=str(tmp_path))
+        dense.augmentor = FlowAugmentor(crop_size=(64, 96))
+        with pytest.raises(ValueError, match="mix"):
+            take_photometric_params(sparse + dense)
+
+
+class TestTrainStepIntegration:
+    def test_device_photometric_step(self, rng):
+        from raftstereo_tpu.config import RAFTStereoConfig, TrainConfig
+        from raftstereo_tpu.models import RAFTStereo
+        from raftstereo_tpu.train import (create_train_state, make_optimizer,
+                                          make_train_step)
+
+        mcfg = RAFTStereoConfig(corr_implementation="reg", n_gru_layers=2,
+                                hidden_dims=(32, 32), corr_levels=2,
+                                corr_radius=2)
+        tcfg = TrainConfig(batch_size=2, train_iters=2, image_size=(32, 48),
+                           device_photometric=True)
+        model = RAFTStereo(mcfg)
+        tx, sched = make_optimizer(tcfg)
+        state = create_train_state(model, jax.random.key(0), tx, (32, 48))
+        step = jax.jit(make_train_step(model, tx, tcfg, sched))
+        batch = (
+            jnp.asarray(rng.uniform(0, 255, (2, 32, 48, 3)).astype(np.float32)),
+            jnp.asarray(rng.uniform(0, 255, (2, 32, 48, 3)).astype(np.float32)),
+            jnp.asarray(-np.abs(rng.normal(size=(2, 32, 48, 1))).astype(np.float32)),
+            jnp.ones((2, 32, 48), jnp.float32),
+        )
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(state.step) == 1
