@@ -130,6 +130,14 @@ def test_shared_backbone_parity(ref_modules, rng):
     assert_close(up_t, up_j, 5e-3, "full-res disparity (shared backbone)")
 
 
+def test_group_context_norm_parity(ref_modules, rng):
+    """context_norm='group' pins make_norm's GroupNorm path (reference:
+    core/extractor.py:16-22, num_groups=8 stem / planes//8 blocks)."""
+    low_t, low_j, up_t, up_j = run_pair(ref_modules, rng,
+                                        context_norm="group")
+    assert_close(up_t, up_j, 5e-3, "full-res disparity (group context norm)")
+
+
 def test_realtime_config_parity(ref_modules, rng):
     # Wider image: at 1/8 res the reference's reg backend builds a
     # num_levels+1 pyramid (core/corr.py:122-125) and crashes if the widest
