@@ -162,21 +162,25 @@ def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
     here; per-iteration calls reshape only the taps (the volume pad is an HBM
     copy of the whole volume — done once structurally rather than relying on
     XLA's loop-invariant code motion)."""
-    from .pallas_corr import pallas_lookup_flat, preflatten_volume
+    from .pallas_corr import (pad_vol_lane, pallas_lookup_pyramid_flat,
+                              preflatten_volume)
 
     volume = build_corr_volume(fmap1.astype(jnp.float32),
                                fmap2.astype(jnp.float32), dtype=dtype)
-    pyramid = [preflatten_volume(v)
+    # Lane-padded level concat along W2: every per-iteration lookup is ONE
+    # kernel launch covering all levels (same construction as pallas_alt).
+    pyramid = [pad_vol_lane(preflatten_volume(v))
                for v in build_corr_pyramid(volume, num_levels)]
+    w2s = tuple(v.shape[2] for v in pyramid)
+    vcat = jnp.concatenate(pyramid, axis=2)
     offsets = _tap_offsets(radius)
 
     def corr_fn(coords: jax.Array) -> jax.Array:
         x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
-        out = []
-        for i, vflat in enumerate(pyramid):
-            taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
-            out.append(pallas_lookup_flat(vflat, taps))
-        return jnp.concatenate(out, axis=-1)
+        taps = jnp.concatenate(
+            [x[..., None] / (2.0 ** i) + offsets        # (B, H, W1, K)
+             for i in range(len(w2s))], axis=-1)
+        return pallas_lookup_pyramid_flat(vcat, taps, w2s)
 
     return corr_fn
 

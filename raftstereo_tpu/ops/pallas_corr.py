@@ -56,33 +56,48 @@ def _block_w1(w1: int) -> int:
     return min(_BLOCK_W1, -(-w1 // 8) * 8)
 
 
-def _lookup_kernel(vol_ref, taps_ref, out_ref):
-    """One (n, w1-block): out[w1, k] = sum_j vol[w1, j] * hat(j - taps[w1, k])."""
-    vol = vol_ref[0].astype(jnp.float32)          # (W1_t, W2)
-    taps = taps_ref[0].astype(jnp.float32)        # (W1_t, K)
-    w2 = vol.shape[-1]
-    k = taps.shape[-1]
-    # Mosaic requires integer iota; cast to f32 for the hat weights.
-    j = jax.lax.broadcasted_iota(jnp.int32, (1, w2), 1).astype(jnp.float32)
+def _lookup_kernel(vol_ref, taps_ref, out_ref, *, bounds):
+    """One (n, w1-block), ALL pyramid levels against a W2-concatenated
+    volume: out[w1, l*K + k] = sum_j vol_l[w1, j] * hat(j - taps[w1, l*K+k]).
+
+    ``bounds`` is a static (offset, padded-width) per level; levels are
+    zero-padded to lane multiples so each slice is lane-aligned and a
+    padded column contributes exactly zero (zero-outside semantics without
+    masks — same construction as pallas_alt). Single-level callers use
+    bounds=((0, w2),).
+    """
+    vol = vol_ref[0].astype(jnp.float32)          # (W1_t, W2cat)
+    taps = taps_ref[0].astype(jnp.float32)        # (W1_t, L*K)
+    kk = taps.shape[-1] // len(bounds)
     cols = []
-    for ki in range(k):                            # K is small (9): unrolled
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
-        cols.append(jnp.sum(vol * w, axis=-1))
+    for li, (off, w2p) in enumerate(bounds):
+        vl = vol[:, off:off + w2p]
+        # Mosaic requires integer iota; cast to f32 for the hat weights.
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, w2p), 1).astype(jnp.float32)
+        for ki in range(kk):                       # L*K is small: unrolled
+            t = taps[:, li * kk + ki][:, None]
+            w = jnp.maximum(0.0, 1.0 - jnp.abs(j - t))
+            cols.append(jnp.sum(vl * w, axis=-1))
     out_ref[0] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
 
 
-def _lookup_bwd_kernel(taps_ref, g_ref, dvol_ref):
-    """dvol[w1, j] = sum_k g[w1, k] * hat(j - taps[w1, k])."""
-    taps = taps_ref[0].astype(jnp.float32)        # (W1_t, K)
-    g = g_ref[0].astype(jnp.float32)              # (W1_t, K)
-    w2 = dvol_ref.shape[-1]
-    k = taps.shape[-1]
-    j = jax.lax.broadcasted_iota(jnp.int32, (1, w2), 1).astype(jnp.float32)
-    acc = jnp.zeros((taps.shape[0], w2), jnp.float32)
-    for ki in range(k):
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(j - taps[:, ki][:, None]))
-        acc = acc + g[:, ki][:, None] * w
-    dvol_ref[0] = acc.astype(dvol_ref.dtype)
+def _lookup_bwd_kernel(taps_ref, g_ref, dvol_ref, *, bounds):
+    """dvol_l[w1, j] = sum_k g[w1, l*K + k] * hat(j - taps[w1, l*K + k])."""
+    taps = taps_ref[0].astype(jnp.float32)        # (W1_t, L*K)
+    g = g_ref[0].astype(jnp.float32)              # (W1_t, L*K)
+    kk = taps.shape[-1] // len(bounds)
+    parts = []
+    for li, (off, w2p) in enumerate(bounds):
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, w2p), 1).astype(jnp.float32)
+        acc = jnp.zeros((taps.shape[0], w2p), jnp.float32)
+        for ki in range(kk):
+            t = taps[:, li * kk + ki][:, None]
+            w = jnp.maximum(0.0, 1.0 - jnp.abs(j - t))
+            acc = acc + g[:, li * kk + ki][:, None] * w
+        parts.append(acc)
+    # Grad mass on padded columns lands in rows the caller's concat-pad
+    # autodiff discards.
+    dvol_ref[0] = jnp.concatenate(parts, axis=-1).astype(dvol_ref.dtype)
 
 
 def _pad_w1(x, block):
@@ -109,11 +124,36 @@ def preflatten_volume(vol: jax.Array) -> jax.Array:
     return v
 
 
+_LANE = 128
+
+
+def pad_vol_lane(vflat: jax.Array) -> jax.Array:
+    """Zero-pad a preflattened (B*H, W1p, W2) volume level to a lane-multiple
+    W2 so its slice inside the fused kernel is lane-aligned; zero columns
+    contribute exactly zero to every lookup."""
+    pad = (-vflat.shape[2]) % _LANE
+    if not pad:
+        return vflat
+    return jnp.pad(vflat, ((0, 0), (0, 0), (0, pad)))
+
+
 def pallas_lookup_flat(vflat: jax.Array, taps: jax.Array) -> jax.Array:
     """Lookup against a :func:`preflatten_volume` result.  taps stays in
     model layout (B, H, W1, K); only the (small) taps tensor is reshaped and
-    padded per call."""
-    return _make_lookup(vflat.shape, vflat.dtype.name)(vflat, taps)
+    padded per call.  Single-level special case of the fused pyramid path."""
+    return _make_lookup(vflat.shape, (vflat.shape[2],),
+                        vflat.dtype.name)(vflat, taps)
+
+
+def pallas_lookup_pyramid_flat(vcat: jax.Array, taps: jax.Array,
+                               w2s: tuple) -> jax.Array:
+    """All pyramid levels in ONE kernel call.
+
+    vcat: per-level ``preflatten_volume`` + ``pad_vol_lane`` results
+    concatenated along W2; taps: (B, H, W1, L*K) per-level LOCAL taps,
+    level-major; w2s: static per-level PADDED widths.
+    """
+    return _make_lookup(vcat.shape, tuple(w2s), vcat.dtype.name)(vcat, taps)
 
 
 def pallas_lookup(vol: jax.Array, taps: jax.Array) -> jax.Array:
@@ -131,19 +171,27 @@ def pallas_lookup(vol: jax.Array, taps: jax.Array) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=None)
-def _make_lookup(vflat_shape, vol_dtype_name):
-    """custom_vjp instance per static (flat shape, dtype) — residuals carry
-    only the taps; the volume's shape/dtype ride in the closure."""
+def _make_lookup(vflat_shape, w2s, vol_dtype_name):
+    """custom_vjp instance per static (flat shape, level widths, dtype) —
+    residuals carry only the taps; the volume's shape/dtype ride in the
+    closure."""
+    bounds = []
+    off = 0
+    for w2 in w2s:
+        bounds.append((off, w2))
+        off += w2
+    bounds = tuple(bounds)
 
     @jax.custom_vjp
     def f(vflat, taps):
-        return _lookup_fwd_impl(vflat, taps)
+        return _lookup_fwd_impl(vflat, taps, bounds)
 
     def fwd(vflat, taps):
-        return _lookup_fwd_impl(vflat, taps), taps
+        return _lookup_fwd_impl(vflat, taps, bounds), taps
 
     def bwd(taps, g):
-        dvflat = _lookup_bwd_impl(taps, g, vflat_shape, vol_dtype_name)
+        dvflat = _lookup_bwd_impl(taps, g, vflat_shape, vol_dtype_name,
+                                  bounds)
         # No coordinate gradient by design (disparity is detached per
         # iteration; the reference kernel likewise returns None:
         # core/corr.py:29).
@@ -160,12 +208,12 @@ def _pad_taps(taps):
     return t, blk
 
 
-def _lookup_fwd_impl(vflat, taps):
+def _lookup_fwd_impl(vflat, taps, bounds):
     n, w1p, w2 = vflat.shape
     b, h, w1, kk = taps.shape
     t, blk = _pad_taps(taps)
     out = pl.pallas_call(
-        _lookup_kernel,
+        functools.partial(_lookup_kernel, bounds=bounds),
         out_shape=jax.ShapeDtypeStruct((n, w1p, kk), jnp.float32),
         grid=(n, w1p // blk),
         in_specs=[
@@ -181,13 +229,13 @@ def _lookup_fwd_impl(vflat, taps):
     return out[:, :w1].reshape(b, h, w1, kk)
 
 
-def _lookup_bwd_impl(taps, g, vflat_shape, vol_dtype_name):
+def _lookup_bwd_impl(taps, g, vflat_shape, vol_dtype_name, bounds):
     n, w1p, w2 = vflat_shape
     b, h, w1, kk = taps.shape
     t, blk = _pad_taps(taps)
     gg, _ = _pad_w1(g.reshape(b * h, w1, kk), blk)
     dvol = pl.pallas_call(
-        _lookup_bwd_kernel,
+        functools.partial(_lookup_bwd_kernel, bounds=bounds),
         out_shape=jax.ShapeDtypeStruct((n, w1p, w2), jnp.float32),
         grid=(n, w1p // blk),
         in_specs=[
