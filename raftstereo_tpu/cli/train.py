@@ -26,11 +26,11 @@ import numpy as np
 from ..config import TrainConfig, add_model_args, model_config_from_args
 from ..data.datasets import (build_aug_params, fetch_dataset,
                              take_photometric_params)
-from ..data.loader import DataLoader
+from ..data.loader import DataLoader, prefetch_to_device
 from ..eval import validate_things
 from ..models import RAFTStereo
 from ..models.raft_stereo import count_parameters
-from ..parallel import make_mesh, shard_batch
+from ..parallel import batch_sharded, make_mesh
 from ..train.checkpoint import CheckpointManager, save_weights
 from ..train.logger import Logger
 from ..train.optim import make_optimizer
@@ -195,8 +195,11 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
         total_steps = int(state.step)
         should_keep_training = total_steps <= cfg.num_steps
         while should_keep_training:
-            for batch in loader:
-                batch = shard_batch(mesh, batch)
+            # Prefetch: the host->HBM copy (and mesh sharding) of the next
+            # batch overlaps the current step's compute — the TPU analogue
+            # of the reference's pin_memory loader (core/stereo_datasets.py:311).
+            for batch in prefetch_to_device(loader, size=2,
+                                            devices=batch_sharded(mesh)):
                 with prof.step(total_steps):
                     state, metrics = step_fn(state, batch)
                 total_steps += 1
