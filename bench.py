@@ -153,8 +153,9 @@ def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
 
 
 def bench_data(batch: int, num_workers: int) -> float:
-    """Host data-pipeline throughput: KITTI-size decode + full dense
+    """Host data-pipeline throughput: KITTI-size decode + full sparse
     augmentation to the training crop, multiprocess workers, samples/sec.
+    (KITTI is a sparse-GT dataset, so this exercises SparseFlowAugmentor.)
 
     The number to beat is the train step's consumption rate (steps/sec x
     batch); the pipeline feeds the TPU (SURVEY.md §7 hard part 6 — the
@@ -255,7 +256,7 @@ def main() -> None:
                         "--width 720 --batch 8 for the reference recipe")
     p.add_argument("--data", action="store_true",
                    help="measure host data-pipeline throughput (KITTI-size "
-                        "decode + dense augmentation, multiprocess workers) "
+                        "decode + sparse augmentation, multiprocess workers) "
                         "in samples/sec")
     p.add_argument("--num_workers", type=int, default=None,
                    help="worker processes for --data (default: SLURM-aware)")
@@ -264,7 +265,7 @@ def main() -> None:
     if args.data:
         value = bench_data(args.batch, args.num_workers)
         print(json.dumps({
-            "metric": f"data-pipeline samples/sec, KITTI decode + dense "
+            "metric": f"data-pipeline samples/sec, KITTI decode + sparse "
                       f"aug to 320x720, batch {args.batch}",
             "value": round(value, 2),
             "unit": "samples/sec",

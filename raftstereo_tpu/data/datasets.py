@@ -337,31 +337,36 @@ def take_photometric_params(dataset):
     exact per-dataset distribution (sparse augmentors use smaller ranges
     and are always symmetric; reference: core/utils/augmentor.py:78,200).
 
-    Raises if the mix combines dense and sparse augmentors: one device
-    parameter set cannot reproduce two host distributions.
+    Raises if leaves disagree on any photometric parameter (including the
+    dense/sparse default split): one device parameter set cannot reproduce
+    two host distributions.
     """
-    from .augment import FlowAugmentor
-
     leaves = dataset.parts if isinstance(dataset, ConcatDataset) else [dataset]
     params = None
-    kinds = set()
     for leaf in leaves:
         aug = getattr(leaf, "augmentor", None)
         if aug is None:
             continue
         aug.photometric = False
-        kinds.add("dense" if isinstance(aug, FlowAugmentor) else "sparse")
-        params = dict(
+        leaf_params = dict(
             brightness=aug.photo.brightness, contrast=aug.photo.contrast,
             saturation=aug.photo.saturation, hue=aug.photo.hue,
             gamma=aug.photo.gamma,
             asymmetric_prob=getattr(aug, "asymmetric_color_aug_prob", 0.0),
-            eraser_prob=aug.eraser_aug_prob)
-    if len(kinds) > 1:
-        raise ValueError(
-            "--device_photometric cannot mix dense- and sparse-augmented "
-            "datasets (their photometric distributions differ); train them "
-            "with host augmentation or in separate runs")
+            eraser_prob=aug.eraser_aug_prob,
+            # Host erases pre-flip img2; a stereo eye-swap flip makes that
+            # the left eye with the flip's probability (device_aug.__init__).
+            erase_left_prob=(aug.h_flip_prob
+                             if getattr(aug, "do_flip", False) == "h"
+                             else 0.0))
+        if params is not None and leaf_params != params:
+            raise ValueError(
+                "--device_photometric cannot mix datasets whose host "
+                "augmentors use different photometric parameters "
+                f"({params} vs {leaf_params}); one device parameter set "
+                "cannot reproduce two host distributions — train with host "
+                "augmentation or in separate runs")
+        params = leaf_params
     if params is None:
         raise ValueError(
             "--device_photometric needs an augmented training dataset "

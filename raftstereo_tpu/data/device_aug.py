@@ -3,16 +3,17 @@
 The reference runs every augmentation op on the host inside torch DataLoader
 workers (reference: core/utils/augmentor.py:78-111 via core/stereo_datasets.py:311).
 That scales with host cores — and starves the chip when cores are scarce:
-the photometric chain (jitter + eraser) is ~40% of the per-sample host cost
-measured by ``bench.py --data``. This module moves exactly that chain into
+the photometric chain (jitter + eraser) is roughly half the per-sample host
+cost measured on the KITTI (sparse-augmentor) pipeline of ``bench.py --data``. This module moves exactly that chain into
 the jitted training step, where it fuses with the input normalization and
 costs microseconds of TPU time; shape-changing work (decode, scale/stretch,
 flip, crop, sparse scatter) stays on the host, which is the natural split —
 everything on-device is fixed-shape.
 
 Semantics mirror the host ``ColorJitter``/eraser (same factor ranges, same
-random op order, same asymmetric/eraser probabilities, per-op [0,255]
-clipping) with two documented differences:
+random op order, same asymmetric/eraser probabilities and eye-swap-flip
+eraser-target distribution, per-op [0,255] clipping) with two documented
+differences:
 
 * hue rotates in continuous fp32 HSV rather than PIL's 8-bit quantized HSV;
 * ops apply after the spatial crop rather than before the resize, and
@@ -122,7 +123,8 @@ class DevicePhotometric:
                  saturation: Sequence[float] = (0.6, 1.4), hue=0.5 / 3.14,
                  gamma: Sequence[float] = (1, 1, 1, 1),
                  asymmetric_prob=0.2, eraser_prob=0.5,
-                 eraser_bounds: Tuple[int, int] = (50, 100)):
+                 eraser_bounds: Tuple[int, int] = (50, 100),
+                 erase_left_prob=0.0):
         self.brightness = brightness
         self.contrast = contrast
         self.saturation = tuple(saturation)
@@ -131,6 +133,12 @@ class DevicePhotometric:
         self.asymmetric_prob = asymmetric_prob
         self.eraser_prob = eraser_prob
         self.eraser_bounds = eraser_bounds
+        # The host erases PRE-flip img2; a stereo eye-swap flip (do_flip='h',
+        # augment.py spatial_transform) then turns the erased eye into the
+        # LEFT input with the flip's probability. The host flip draw is
+        # independent of the eraser, so an independent target-eye draw here
+        # reproduces the distribution exactly.
+        self.erase_left_prob = erase_left_prob
 
     # ---- per-sample pieces ------------------------------------------------
 
@@ -185,26 +193,37 @@ class DevicePhotometric:
                          * (x / 255.0) ** fmap(gamma2), 0, 255)
         return jnp.clip(x, 0, 255)
 
-    def _eraser_one(self, key, img2):
-        """img2: (3, H, W) channel-first."""
-        h, w = img2.shape[1:]
-        ka, kn, kr = jax.random.split(key, 3)
+    def _eraser_one(self, key, stacked):
+        """stacked: (3, 2H, W) channel-first pair; erases ONE eye — the
+        right one, or the left with ``erase_left_prob`` (the post-flip image
+        of the host's pre-flip img2; see __init__)."""
+        h2, w = stacked.shape[1:]
+        h = h2 // 2
+        ka, kn, kr, ke = jax.random.split(key, 4)
         apply = jax.random.uniform(ka, ()) < self.eraser_prob
         n = jax.random.randint(kn, (), 1, 3)       # 1 or 2 rectangles
-        mean_color = jnp.mean(img2, axis=(1, 2))   # (3,)
-        yy = jnp.arange(h)[:, None]
+        left = jax.random.uniform(ke, ()) < self.erase_left_prob
+        row0 = jnp.where(left, 0, h)               # target eye's first row
+        m_top = jnp.mean(stacked[:, :h], axis=(1, 2))
+        m_bot = jnp.mean(stacked[:, h:], axis=(1, 2))
+        mean_color = jnp.where(left, m_top, m_bot)  # (3,)
+        yy = jnp.arange(h2)[:, None]
         xx = jnp.arange(w)[None, :]
         lo, hi = self.eraser_bounds
         for r, krr in enumerate(jax.random.split(kr, 2)):
             kx, ky, kdx, kdy = jax.random.split(krr, 4)
             x0 = jax.random.randint(kx, (), 0, w)
-            y0 = jax.random.randint(ky, (), 0, h)
+            y0 = jax.random.randint(ky, (), 0, h) + row0
             dx = jax.random.randint(kdx, (), lo, hi)
             dy = jax.random.randint(kdy, (), lo, hi)
+            # The rectangle clips at the target eye's bottom edge, exactly
+            # like the host slice assignment clips at the image edge.
             mask = (apply & (r < n) & (yy >= y0) & (yy < y0 + dy)
+                    & (yy < row0 + h)
                     & (xx >= x0) & (xx < x0 + dx))
-            img2 = jnp.where(mask[None], mean_color[:, None, None], img2)
-        return img2
+            stacked = jnp.where(mask[None], mean_color[:, None, None],
+                                stacked)
+        return stacked
 
     def _sample(self, key, img1, img2):
         k_asym, k_p1, k_p2, k_ord1, k_ord2, kg1, kg2, k_er = \
@@ -236,10 +255,10 @@ class DevicePhotometric:
             stacked,
             jnp.stack([f1, f2]), jnp.stack([o1, o2]),
             jnp.stack([gamma1, gamma2]), jnp.stack([gain1, gain2]), asym)
+        out = self._eraser_one(k_er, out)
         h = img1.shape[0]
-        img2cf = self._eraser_one(k_er, out[:, h:])
         return (out[:, :h].transpose(1, 2, 0),
-                img2cf.transpose(1, 2, 0))
+                out[:, h:].transpose(1, 2, 0))
 
     def __call__(self, key: jax.Array, img1: jax.Array, img2: jax.Array):
         keys = jax.random.split(key, img1.shape[0])

@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_corr import _block_w1, _interpret, _pad_taps, _pad_w1
+from .pallas_corr import (_block_w1, _interpret, _pad_taps, _pad_w1,
+                          bounds_from_widths, pad_lane)
 
 
 def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds):
@@ -162,18 +163,10 @@ def pallas_alt_lookup(fmap1: jax.Array, fmap2: jax.Array,
                                   preflatten_fmap2(fmap2), taps)
 
 
-_LANE = 128
-
-
 def pad_w2_lane(f2flat: jax.Array) -> jax.Array:
-    """Zero-pad a preflattened (B*H, W2, C) level to a lane-multiple W2 so
-    its slice inside the fused kernel is lane-aligned. Zero rows correlate
-    to exactly zero, so the padding never changes a lookup result."""
-    w2 = f2flat.shape[1]
-    pad = (-w2) % _LANE
-    if not pad:
-        return f2flat
-    return jnp.pad(f2flat, ((0, 0), (0, pad), (0, 0)))
+    """(B*H, W2, C) level -> lane-multiple W2 (pallas_corr.pad_lane); zero
+    rows correlate to exactly zero, so padding never changes a lookup."""
+    return pad_lane(f2flat, 1)
 
 
 def pallas_alt_pyramid_flat(f1flat: jax.Array, f2cat: jax.Array,
@@ -195,12 +188,7 @@ def pallas_alt_pyramid_flat(f1flat: jax.Array, f2cat: jax.Array,
 
 @functools.lru_cache(maxsize=None)
 def _make_alt_pyr(f1flat_shape, f2cat_shape, w2s, f1_dtype, f2_dtype):
-    bounds = []
-    off = 0
-    for w2 in w2s:
-        bounds.append((off, w2))
-        off += w2
-    bounds = tuple(bounds)
+    bounds = bounds_from_widths(w2s)
 
     @jax.custom_vjp
     def f(f1flat, f2cat, taps):

@@ -124,17 +124,35 @@ def preflatten_volume(vol: jax.Array) -> jax.Array:
     return v
 
 
-_LANE = 128
+LANE = 128
+
+
+def pad_lane(x: jax.Array, axis: int) -> jax.Array:
+    """Zero-pad ``axis`` to a lane-width multiple so static slices of a
+    level concat are lane-aligned inside the fused kernels; zero columns
+    contribute exactly zero to every lookup. Shared by both fused pyramid
+    paths (this module's volume lookup and pallas_alt's on-demand one)."""
+    pad = (-x.shape[axis]) % LANE
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def bounds_from_widths(w2s) -> tuple:
+    """Per-level (offset, width) pairs for a W2-concatenated pyramid."""
+    bounds = []
+    off = 0
+    for w2 in w2s:
+        bounds.append((off, w2))
+        off += w2
+    return tuple(bounds)
 
 
 def pad_vol_lane(vflat: jax.Array) -> jax.Array:
-    """Zero-pad a preflattened (B*H, W1p, W2) volume level to a lane-multiple
-    W2 so its slice inside the fused kernel is lane-aligned; zero columns
-    contribute exactly zero to every lookup."""
-    pad = (-vflat.shape[2]) % _LANE
-    if not pad:
-        return vflat
-    return jnp.pad(vflat, ((0, 0), (0, 0), (0, pad)))
+    """(B*H, W1p, W2) volume level -> lane-multiple W2 (see pad_lane)."""
+    return pad_lane(vflat, 2)
 
 
 def pallas_lookup_flat(vflat: jax.Array, taps: jax.Array) -> jax.Array:
@@ -175,12 +193,7 @@ def _make_lookup(vflat_shape, w2s, vol_dtype_name):
     """custom_vjp instance per static (flat shape, level widths, dtype) —
     residuals carry only the taps; the volume's shape/dtype ride in the
     closure."""
-    bounds = []
-    off = 0
-    for w2 in w2s:
-        bounds.append((off, w2))
-        off += w2
-    bounds = tuple(bounds)
+    bounds = bounds_from_widths(w2s)
 
     @jax.custom_vjp
     def f(vflat, taps):

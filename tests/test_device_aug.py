@@ -87,6 +87,26 @@ class TestDevicePhotometric:
                     np.broadcast_to(mean[b], (hit.sum(), 3)), rtol=1e-3,
                     atol=1e-2)
 
+    def test_erase_left_prob(self, imgs):
+        """erase_left_prob=1: the LEFT eye is erased (the post-flip image of
+        the host's pre-flip img2 under a stereo eye-swap flip), img2 kept."""
+        aug = DevicePhotometric(brightness=0.0, contrast=0.0,
+                                saturation=(1.0, 1.0), hue=0.0,
+                                eraser_prob=1.0, erase_left_prob=1.0)
+        o1, o2 = aug(jax.random.key(5), *imgs)
+        np.testing.assert_allclose(np.asarray(o2), np.asarray(imgs[1]),
+                                   rtol=1e-4, atol=1e-3)
+        d = np.abs(np.asarray(o1) - np.asarray(imgs[0])).sum(-1)
+        assert (d > 1e-3).any(), "left eye must be erased"
+        mean = np.asarray(imgs[0]).reshape(2, -1, 3).mean(axis=1)
+        for b in range(2):
+            hit = d[b] > 1e-3
+            if hit.any():
+                np.testing.assert_allclose(
+                    np.asarray(o1)[b][hit],
+                    np.broadcast_to(mean[b], (hit.sum(), 3)), rtol=1e-3,
+                    atol=1e-2)
+
     def test_brightness_matches_host(self, imgs):
         """Brightness-only device op == host adjust_brightness for the same
         factor (host path quantizes to uint8 at the end; compare pre-quant)."""
