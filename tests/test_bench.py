@@ -1,0 +1,31 @@
+"""bench.py is the driver's benchmark entry point — guard its contract:
+one JSON line with metric/value/unit/vs_baseline, on any backend."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), *args],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout[-2000:]
+    return json.loads(lines[0])
+
+
+def test_quick_inference_contract():
+    r = _run(["--quick", "--reps", "1"])
+    assert set(r) == {"metric", "value", "unit", "vs_baseline"}
+    assert r["unit"] == "pairs/sec" and r["value"] > 0
+
+
+def test_data_mode_contract():
+    r = _run(["--data", "--num_workers", "0", "--batch", "4"])
+    assert r["unit"] == "samples/sec" and r["value"] > 0
