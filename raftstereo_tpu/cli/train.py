@@ -150,7 +150,8 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                                cfg.img_gamma, cfg.do_flip)
         roots = ({k: dataset_root for k in
                   ("sceneflow", "kitti", "middlebury", "sintel",
-                   "falling_things", "tartanair")} if dataset_root else None)
+                   "falling_things", "tartanair", "sl")}
+                 if dataset_root else None)
         dataset = fetch_dataset(cfg.train_datasets, aug, roots)
     photometric_params = None
     if cfg.device_photometric:

@@ -417,6 +417,15 @@ def fetch_dataset(train_datasets: Sequence[str], aug_params: dict,
         elif name.startswith("tartan_air"):
             kw = {"root": roots["tartanair"]} if "tartanair" in roots else {}
             new = TartanAir(aug_params, keywords=name.split("_")[2:], **kw)
+        elif name == "sl":
+            # Structured-light captures (the fork's WIP pipeline, working
+            # form): random fixed-size crops only — photometric jitter would
+            # destroy the projected-pattern modulation.
+            from .sl import SLStereoView, fetch_sl_dataset
+            new = SLStereoView(
+                fetch_sl_dataset(roots.get("sl", "datasets/SL"),
+                                 with_depth=True, split="training"),
+                crop_size=(aug_params or {}).get("crop_size"))
         else:
             raise ValueError(f"unknown dataset: {name}")
         logger.info("Adding %d samples from %s", len(new), name)

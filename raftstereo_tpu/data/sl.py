@@ -182,11 +182,19 @@ class SLStereoView:
     framework's negative-x-flow convention (core/stereo_datasets.py:77).
     """
 
-    def __init__(self, dataset: "StructuredLightDataset"):
+    def __init__(self, dataset: "StructuredLightDataset",
+                 crop_size: Optional[Tuple[int, int]] = None):
         assert dataset.with_depth, "stereo view needs with_depth=True"
         self._ds = dataset
+        # Fixed-size random crop so batches have static shapes for the
+        # jitted train step. SL captures must NOT be photometrically
+        # jittered (it would destroy the projected-pattern modulation the
+        # masks encode), so cropping is the only augmentation here.
+        self.crop_size = tuple(crop_size) if crop_size else None
+        self.rng = np.random.default_rng(0)
 
     def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
         self._ds.reseed(seed)
 
     def __len__(self) -> int:
@@ -198,6 +206,17 @@ class SLStereoView:
         flow = (-disparity[..., 1:2] * w).astype(np.float32)  # px, negative
         valid = depth_mask[..., 1].astype(np.float32)
         meta = list(self._ds.samples[index])
+        if self.crop_size is not None:
+            ch, cw = self.crop_size
+            h, w_ = img_l.shape[:2]
+            if h < ch or w_ < cw:
+                raise ValueError(f"SL frame {h}x{w_} smaller than crop "
+                                 f"{ch}x{cw}; lower crop_size or raise scale")
+            y0 = int(self.rng.integers(0, h - ch + 1))
+            x0 = int(self.rng.integers(0, w_ - cw + 1))
+            sl = np.s_[y0:y0 + ch, x0:x0 + cw]
+            img_l, img_r = img_l[sl], img_r[sl]
+            flow, valid = flow[sl], valid[sl]
         return meta, img_l, img_r, flow, valid
 
 
