@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -20,12 +22,22 @@ def _run(args):
     return json.loads(lines[0])
 
 
+@pytest.mark.slow
 def test_quick_inference_contract():
     r = _run(["--quick", "--reps", "1"])
     assert set(r) == {"metric", "value", "unit", "vs_baseline"}
     assert r["unit"] == "pairs/sec" and r["value"] > 0
 
 
+@pytest.mark.slow
+def test_quick_mfu_extras():
+    r = _run(["--quick", "--reps", "1", "--mfu"])
+    assert {"flops_per_pair", "model_tflops", "measured_peak_tflops",
+            "mfu_vs_measured_peak"} <= set(r)
+    assert r["flops_per_pair"] > 1e9  # the flagship forward is TFLOP-scale
+
+
+@pytest.mark.slow
 def test_data_mode_contract():
     r = _run(["--data", "--num_workers", "0", "--batch", "4"])
     assert r["unit"] == "samples/sec" and r["value"] > 0

@@ -39,6 +39,7 @@ class TestViz:
 
 
 class TestTrainCLI:
+    @pytest.mark.slow
     def test_train_and_resume(self, tmp_path, rng, monkeypatch):
         from raftstereo_tpu.cli.train import train
 
@@ -68,6 +69,26 @@ class TestTrainCLI:
         p1 = jax.tree.leaves(state.params)[0]
         p2 = jax.tree.leaves(state2.params)[0]
         np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+    def test_absent_validation_data_fails_at_startup(self, tmp_path, rng,
+                                                     monkeypatch):
+        """The 10k-step regression check (reference: train_stereo.py:184-191)
+        must not degrade into a silent skip: without FlyingThings data and
+        without --no_validation, training refuses to start."""
+        from raftstereo_tpu.cli.train import train
+
+        make_synthetic_kitti(tmp_path / "kitti", n=2, rng=rng)
+        dataset = ds.KITTI(aug_params={"crop_size": (48, 64)},
+                           root=str(tmp_path / "kitti"))
+        monkeypatch.chdir(tmp_path)  # no datasets/FlyingThings3D here
+        mcfg = RAFTStereoConfig(**TINY)
+        tcfg = TrainConfig(name="v", batch_size=2, num_steps=1,
+                           train_iters=2, image_size=(48, 64), seed=7,
+                           checkpoint_dir=str(tmp_path / "ckpt"),
+                           data_parallel=2)
+        with pytest.raises(ValueError, match="no_validation"):
+            train(mcfg, tcfg, dataset=dataset, num_workers=0,
+                  no_validation=False)
 
     def test_empty_loader_fails_fast(self, tmp_path, rng):
         from raftstereo_tpu.cli.train import train
@@ -100,6 +121,7 @@ class TestTrainCLI:
         assert cfg.spatial_scale == (-0.2, 0.4)
 
 
+@pytest.mark.slow
 class TestDemoCLI:
     def test_demo_outputs(self, tmp_path, rng):
         from raftstereo_tpu.cli.demo import main
@@ -207,6 +229,7 @@ class TestDemoCLI:
         assert rc == 1
 
 
+@pytest.mark.slow
 class TestEvaluateCLI:
     def test_evaluate_kitti_random_weights(self, tmp_path, rng, capsys):
         from raftstereo_tpu.cli.evaluate import main
@@ -234,6 +257,7 @@ class TestSLSmokeCLI:
         assert main(["--root", str(empty)]) == 1
 
 
+@pytest.mark.slow
 class TestConvertCLI:
     @pytest.mark.torch_parity
     def test_pth_to_orbax_roundtrip(self, tmp_path, rng):

@@ -158,6 +158,7 @@ class TestTakePhotometricParams:
             take_photometric_params(sparse + dense)
 
 
+@pytest.mark.slow
 class TestTrainStepIntegration:
     def test_device_photometric_step(self, rng):
         from raftstereo_tpu.config import RAFTStereoConfig, TrainConfig

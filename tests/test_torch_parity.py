@@ -21,7 +21,7 @@ import pytest
 REF = "/root/reference"
 
 torch = pytest.importorskip("torch")
-pytestmark = pytest.mark.torch_parity
+pytestmark = [pytest.mark.torch_parity, pytest.mark.slow]
 
 if not os.path.isdir(REF):
     pytest.skip("reference tree not mounted", allow_module_level=True)

@@ -136,6 +136,7 @@ def _make_all(num_steps=50, train_iters=2):
     return model, tx, state, step
 
 
+@pytest.mark.slow
 def test_train_step_descends(rng):
     _, _, state, step = _make_all()
     mesh = make_mesh(data=8)
@@ -150,6 +151,7 @@ def test_train_step_descends(rng):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_sharded_matches_single_device(rng):
     batch = _tiny_batch(rng)
     results = []
@@ -176,6 +178,7 @@ def test_sharded_matches_single_device(rng):
                                np.asarray(results[1][2]), rtol=5e-2, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_lr_metric_follows_schedule(rng):
     _, _, state, step = _make_all(num_steps=50)
     mesh = make_mesh(data=1)
@@ -220,6 +223,7 @@ def test_checkpoint_roundtrip(tmp_path, rng):
 # failure detection: nan_policy skip/abort + elastic restart
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_nan_policy_skip_drops_update(rng):
     cfg = TrainConfig(lr=1e-3, num_steps=50, train_iters=2, batch_size=8,
                       nan_policy="skip")
@@ -247,6 +251,7 @@ def test_nan_policy_skip_drops_update(rng):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_nan_policy_abort_reports_nonfinite(rng):
     cfg = TrainConfig(lr=1e-3, num_steps=50, train_iters=2, batch_size=8,
                       nan_policy="abort")
@@ -283,6 +288,7 @@ class _FlakyDataset:
         return getattr(self.inner, name)
 
 
+@pytest.mark.slow
 def test_train_loop_auto_restart(tmp_path, rng, monkeypatch):
     from raftstereo_tpu.cli.train import train
     from raftstereo_tpu.data import datasets as ds
@@ -305,6 +311,7 @@ def test_train_loop_auto_restart(tmp_path, rng, monkeypatch):
     assert (tmp_path / "ckpt" / "r" / "r-final").exists()
 
 
+@pytest.mark.slow
 def test_skip_advances_schedule_but_not_adam(rng):
     """On a skipped step the LR-schedule count advances (torch: unconditional
     scheduler.step) while Adam moments/count stay put (torch: optimizer.step
@@ -343,6 +350,7 @@ def test_skip_advances_schedule_but_not_adam(rng):
     assert adam_c == 0, adam_c       # optimizer skipped
 
 
+@pytest.mark.slow
 def test_restart_reapplies_restore_ckpt(tmp_path, rng, monkeypatch):
     """A crash before the first checkpoint save must recover from
     --restore_ckpt weights, not a fresh random init."""
@@ -371,6 +379,7 @@ def test_restart_reapplies_restore_ckpt(tmp_path, rng, monkeypatch):
     assert int(state.step) == 2
 
 
+@pytest.mark.slow
 def test_nan_abort_not_retried(tmp_path, rng, monkeypatch):
     """nan_policy=abort failures are deterministic; max_restarts must not
     burn its budget replaying them."""
