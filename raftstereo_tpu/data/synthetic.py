@@ -1,0 +1,189 @@
+"""Synthetic dataset trees in the on-disk layouts the adapters expect.
+
+Each builder writes a tiny but *layout-faithful* tree for one benchmark
+(reference directory conventions: core/stereo_datasets.py:123-274), so that
+dataset readers, evaluators, the CLI-to-CLI parity harness
+(scripts/parity_cli.py) and the convergence demo can all run on hosts with
+no real data.  The trees are intentionally readable by BOTH this framework's
+adapters and the reference's ``stereo_datasets.py`` — that equivalence is
+what the parity harness relies on.
+"""
+
+from __future__ import annotations
+
+import os
+from os.path import join
+
+import numpy as np
+from PIL import Image
+
+from .codecs import write_pfm
+from .png16 import write_png16
+
+__all__ = [
+    "make_synthetic_kitti", "make_synthetic_eth3d",
+    "make_synthetic_middlebury", "make_synthetic_things_test",
+    "make_synthetic_sl", "ShiftStereoDataset",
+]
+
+
+class ShiftStereoDataset:
+    """In-memory, *learnable* stereo pairs: a smooth random texture and its
+    horizontally shifted copy, ground-truth disparity = the shift.
+
+    Matched texture makes the correlation volume genuinely informative, so a
+    model can drive EPE toward zero by learning — unlike the independent
+    random images in the tree builders above, which have no learnable
+    structure.  Used by the convergence demonstration
+    (scripts/overfit_demo.py, tests/test_convergence.py): overfitting this
+    set proves the whole training pipeline (loss, optimizer, schedule,
+    gradients) *learns*, not just runs.
+
+    Items use the data-layer protocol: (meta, img1, img2, flow(H,W,1), valid).
+    """
+
+    def __init__(self, n=16, hw=(64, 96), max_disp=8.0, seed=0):
+        h, w = hw
+        rng = np.random.default_rng(seed)
+        self._items = []
+        for i in range(n):
+            d = float(rng.uniform(2.0, max_disp))
+            di = int(round(d))
+            # Smooth texture (random low-res upsampled) so matching is
+            # locally unambiguous at integer-pixel precision.
+            low = rng.uniform(0, 255, (h // 4 + 1, (w + di) // 4 + 2, 3))
+            tex = np.kron(low, np.ones((4, 4, 1)))[:h, :w + di]
+            # left(x) matches right(x - d): right(y) = left(y + d).
+            img1 = tex[:, :w].astype(np.float32)          # left
+            img2 = tex[:, di:di + w].astype(np.float32)   # right
+            flow = np.full((h, w, 1), -float(di), np.float32)
+            valid = np.ones((h, w), np.float32)
+            self._items.append((["synthetic", i], img1, img2, flow, valid))
+
+    def reseed(self, seed):  # loader protocol; the set is static
+        pass
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i % len(self._items)]
+
+
+def make_synthetic_kitti(root, n=6, hw=(120, 160), rng=None):
+    """KITTI-2015 training split: image_2/image_3 pairs + 16-bit disp_occ_0
+    (reference: core/stereo_datasets.py:246-257)."""
+    rng = rng or np.random.default_rng(0)
+    root = str(root)
+    h, w = hw
+    os.makedirs(join(root, "training", "image_2"))
+    os.makedirs(join(root, "training", "image_3"))
+    os.makedirs(join(root, "training", "disp_occ_0"))
+    for i in range(n):
+        for cam in ("image_2", "image_3"):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(
+                join(root, "training", cam, f"{i:06d}_10.png"))
+        disp = (rng.uniform(1, 60, (h, w)) * 256).astype(np.uint16)
+        write_png16(join(root, "training", "disp_occ_0", f"{i:06d}_10.png"),
+                    disp)
+
+
+def make_synthetic_eth3d(root, n=3, hw=(96, 128), rng=None):
+    """ETH3D two-view training split with PFM ground truth
+    (reference: core/stereo_datasets.py:187-197)."""
+    rng = rng or np.random.default_rng(0)
+    root = str(root)
+    h, w = hw
+    for i in range(n):
+        scene = join(root, "two_view_training", f"scene{i}")
+        gt = join(root, "two_view_training_gt", f"scene{i}")
+        os.makedirs(scene), os.makedirs(gt)
+        for name in ("im0.png", "im1.png"):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(join(scene, name))
+        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
+        write_pfm(join(gt, "disp0GT.pfm"), disp)
+
+
+def make_synthetic_middlebury(root, scenes=("Adirondack", "Jadeplant"),
+                              hw=(96, 128), rng=None):
+    """MiddEval3 trainingF scenes with official_train.txt filter and nocc
+    masks (reference: core/stereo_datasets.py:260-274)."""
+    rng = rng or np.random.default_rng(0)
+    root = str(root)
+    h, w = hw
+    base = join(root, "MiddEval3")
+    os.makedirs(base)
+    with open(join(base, "official_train.txt"), "w") as f:
+        f.write("\n".join(scenes) + "\n")
+    for scene in scenes:
+        d = join(base, "trainingF", scene)
+        os.makedirs(d)
+        for name in ("im0.png", "im1.png"):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(join(d, name))
+        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
+        disp[:4] = np.inf  # occluded/unknown rows -> flow -inf, filtered
+        write_pfm(join(d, "disp0GT.pfm"), disp)
+        mask = np.full((h, w), 255, np.uint8)
+        mask[:8] = 128  # occluded band
+        Image.fromarray(mask).save(join(d, "mask0nocc.png"))
+
+
+def make_synthetic_things_test(root, n=2, hw=(96, 128), rng=None):
+    """FlyingThings3D finalpass TEST split
+    (reference: core/stereo_datasets.py:137-155)."""
+    rng = rng or np.random.default_rng(0)
+    root = str(root)
+    h, w = hw
+    # 400-image seeded val subset selects indices from the TEST file list
+    # (reference: core/stereo_datasets.py:146-149); with n<=400 all survive.
+    for i in range(n):
+        img_dir = join(root, "FlyingThings3D", "frames_finalpass", "TEST",
+                       "A", f"{i:04d}", "left")
+        rdir = join(root, "FlyingThings3D", "frames_finalpass", "TEST",
+                    "A", f"{i:04d}", "right")
+        ddir = join(root, "FlyingThings3D", "disparity", "TEST",
+                    "A", f"{i:04d}", "left")
+        os.makedirs(img_dir), os.makedirs(rdir), os.makedirs(ddir)
+        for d in (img_dir, rdir):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(join(d, "0006.png"))
+        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
+        disp[0, :] = 300.0  # beyond the |gt|<192 filter
+        write_pfm(join(ddir, "0006.pfm"), disp)
+
+
+def make_synthetic_sl(root, scenes=("sceneA",), poses=("0001",), hw=(32, 40),
+                      rng=None):
+    """Structured-light capture tree: ambient pair + 9 pattern pairs +
+    three-phase images + depth maps (reference: core/sl_datasets.py:100-141)."""
+    rng = rng or np.random.default_rng(0)
+    root = str(root)
+    h, w = hw
+    for scene in scenes:
+        amb = join(root, scene, "ambient_light")
+        os.makedirs(amb)
+        for pose in poses:
+            for side in ("L", "R"):
+                img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+                Image.fromarray(img).save(join(amb, f"{pose}_{side}.png"))
+            tp = join(root, scene, "three_phase")
+            os.makedirs(tp, exist_ok=True)
+            base = rng.integers(60, 190, (h, w), dtype=np.uint8)
+            for i, phase in enumerate((0, 40, 80)):
+                for side in ("l", "r"):
+                    Image.fromarray((base + phase) % 255).save(
+                        join(tp, f"{pose}_tp{i+1}_{side}.png"))
+            for k in range(9):
+                pd = join(root, scene, f"pattern_{k}")
+                os.makedirs(pd, exist_ok=True)
+                for side in ("l", "r"):
+                    pat = (rng.random((h, w)) > 0.5).astype(np.uint8) * 255
+                    Image.fromarray(pat).save(join(pd, f"{pose}_B_{side}.png"))
+            dp = join(root, scene, "depth")
+            os.makedirs(dp, exist_ok=True)
+            for side in ("L", "R"):
+                np.save(join(dp, f"{pose}_depth_{side}.npy"),
+                        rng.uniform(50, 200, (h, w)).astype(np.float32))

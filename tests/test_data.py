@@ -213,17 +213,8 @@ class TestAugment:
 
 # ------------------------------------------------------------------ dataset
 
-def make_synthetic_kitti(root, n=6, rng=None):
-    rng = rng or np.random.default_rng(0)
-    os.makedirs(root / "training" / "image_2")
-    os.makedirs(root / "training" / "image_3")
-    os.makedirs(root / "training" / "disp_occ_0")
-    for i in range(n):
-        for cam in ("image_2", "image_3"):
-            img = rng.integers(0, 255, (120, 160, 3), dtype=np.uint8)
-            Image.fromarray(img).save(root / "training" / cam / f"{i:06d}_10.png")
-        disp = (rng.uniform(1, 60, (120, 160)) * 256).astype(np.uint16)
-        write_png16(str(root / "training" / "disp_occ_0" / f"{i:06d}_10.png"), disp)
+# Shared layout-faithful tree builders (also used by scripts/parity_cli.py).
+from raftstereo_tpu.data.synthetic import make_synthetic_kitti  # noqa: E402,F401
 
 
 class TestDatasets:
@@ -293,35 +284,7 @@ class TestLoader:
 
 # ------------------------------------------------------------------ SL
 
-def make_synthetic_sl(root, scenes=("sceneA",), poses=("0001",), hw=(32, 40),
-                      rng=None):
-    rng = rng or np.random.default_rng(0)
-    h, w = hw
-    for scene in scenes:
-        amb = root / scene / "ambient_light"
-        os.makedirs(amb)
-        for pose in poses:
-            for side in ("L", "R"):
-                img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
-                Image.fromarray(img).save(amb / f"{pose}_{side}.png")
-            tp = root / scene / "three_phase"
-            os.makedirs(tp, exist_ok=True)
-            base = rng.integers(60, 190, (h, w), dtype=np.uint8)
-            for i, phase in enumerate((0, 40, 80)):
-                for side in ("l", "r"):
-                    Image.fromarray((base + phase) % 255).save(
-                        tp / f"{pose}_tp{i+1}_{side}.png")
-            for k in range(9):
-                pd = root / scene / f"pattern_{k}"
-                os.makedirs(pd, exist_ok=True)
-                for side in ("l", "r"):
-                    pat = (rng.random((h, w)) > 0.5).astype(np.uint8) * 255
-                    Image.fromarray(pat).save(pd / f"{pose}_B_{side}.png")
-            dp = root / scene / "depth"
-            os.makedirs(dp, exist_ok=True)
-            for side in ("L", "R"):
-                np.save(dp / f"{pose}_depth_{side}.npy",
-                        rng.uniform(50, 200, (h, w)).astype(np.float32))
+from raftstereo_tpu.data.synthetic import make_synthetic_sl  # noqa: E402,F401
 
 
 class TestStructuredLight:

@@ -11,12 +11,9 @@ import os
 
 import numpy as np
 import pytest
-from PIL import Image
 
 from raftstereo_tpu.config import RAFTStereoConfig
 from raftstereo_tpu.data import datasets as ds
-from raftstereo_tpu.data.codecs import write_pfm
-from raftstereo_tpu.data.png16 import write_png16
 from raftstereo_tpu.eval import (Evaluator, validate, validate_eth3d,
                                  validate_kitti, validate_middlebury,
                                  validate_things)
@@ -43,57 +40,9 @@ class OracleEvaluator:
 
 # ------------------------------------------------------------- synthetic data
 
-def make_synthetic_eth3d(root, n=3, hw=(96, 128), rng=None):
-    rng = rng or np.random.default_rng(0)
-    h, w = hw
-    for i in range(n):
-        scene = root / "two_view_training" / f"scene{i}"
-        gt = root / "two_view_training_gt" / f"scene{i}"
-        os.makedirs(scene), os.makedirs(gt)
-        for name in ("im0.png", "im1.png"):
-            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
-            Image.fromarray(img).save(scene / name)
-        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
-        write_pfm(str(gt / "disp0GT.pfm"), disp)
-
-
-def make_synthetic_middlebury(root, scenes=("Adirondack", "Jadeplant"),
-                              hw=(96, 128), rng=None):
-    rng = rng or np.random.default_rng(0)
-    h, w = hw
-    base = root / "MiddEval3"
-    os.makedirs(base)
-    (base / "official_train.txt").write_text("\n".join(scenes) + "\n")
-    for scene in scenes:
-        d = base / "trainingF" / scene
-        os.makedirs(d)
-        for name in ("im0.png", "im1.png"):
-            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
-            Image.fromarray(img).save(d / name)
-        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
-        disp[:4] = np.inf  # occluded/unknown rows -> flow -inf, filtered
-        write_pfm(str(d / "disp0GT.pfm"), disp)
-        mask = np.full((h, w), 255, np.uint8)
-        mask[:8] = 128  # occluded band
-        Image.fromarray(mask).save(d / "mask0nocc.png")
-
-
-def make_synthetic_things_test(root, n=2, hw=(96, 128), rng=None):
-    rng = rng or np.random.default_rng(0)
-    h, w = hw
-    # 400-image seeded val subset selects indices from the TEST file list
-    # (reference: core/stereo_datasets.py:146-149); with n<=400 all survive.
-    for i in range(n):
-        img_dir = root / "FlyingThings3D" / "frames_finalpass" / "TEST" / "A" / f"{i:04d}" / "left"
-        rdir = root / "FlyingThings3D" / "frames_finalpass" / "TEST" / "A" / f"{i:04d}" / "right"
-        ddir = root / "FlyingThings3D" / "disparity" / "TEST" / "A" / f"{i:04d}" / "left"
-        os.makedirs(img_dir), os.makedirs(rdir), os.makedirs(ddir)
-        for d in (img_dir, rdir):
-            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
-            Image.fromarray(img).save(d / "0006.png")
-        disp = rng.uniform(1, 40, (h, w)).astype(np.float32)
-        disp[0, :] = 300.0  # beyond the |gt|<192 filter
-        write_pfm(str(ddir / "0006.pfm"), disp)
+from raftstereo_tpu.data.synthetic import (  # noqa: E402,F401
+    make_synthetic_eth3d, make_synthetic_middlebury,
+    make_synthetic_things_test)
 
 
 # ------------------------------------------------------------------ protocol
