@@ -18,6 +18,17 @@ from ..config import RAFTStereoConfig
 
 
 def setup_logging(level=logging.INFO) -> None:
+    """Logging + platform bring-up shared by every CLI entry point.
+
+    The platform re-apply is load-bearing: this image's site hook imports
+    jax at interpreter startup and freezes the platform choice before a
+    shell-provided ``JAX_PLATFORMS`` can act, and its accelerator fallback
+    depends on tunnel availability — without the re-apply,
+    ``JAX_PLATFORMS=cpu python -m raftstereo_tpu.cli.evaluate`` silently
+    ran on the TPU whenever the tunnel was free (utils/platform.py).
+    """
+    from ..utils.platform import apply_env_platform
+    apply_env_platform()
     logging.basicConfig(
         level=level,
         format="%(asctime)s %(levelname)-8s [%(filename)s:%(lineno)d] %(message)s")

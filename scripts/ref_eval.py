@@ -1,0 +1,152 @@
+"""Run the REFERENCE evaluation pipeline (torch CPU) for the parity harness.
+
+This imports the reference's own ``evaluate_stereo.py`` validators from
+/root/reference (read-only) and runs them end-to-end — its dataset readers,
+its InputPadder, its model forward — on whatever ``datasets/`` tree exists
+under the working directory.  Used by scripts/parity_cli.py to produce the
+torch half of the CLI-to-CLI metrics table; our half comes from
+``raftstereo_tpu.cli.evaluate`` on the same tree.
+
+Only environment adaptation happens here, never behavioral change:
+
+* ``torchvision``/``skimage`` are stubbed — the eval path never constructs
+  an augmentor (``aug_params={}`` has no crop_size, stereo_datasets.py:26-30)
+  or the LAB style-transfer helpers, but the modules import them at top level
+* ``.cuda()`` is made a no-op so the pipeline runs on the CPU torch build
+* the model is built exactly as the reference CLI does (DataParallel wrap,
+  evaluate_stereo.py:210) from a state dict saved by the harness
+
+Usage:
+    python scripts/ref_eval.py --workspace WS --ckpt model.pth \
+        --datasets eth3d kitti things middlebury_F --iters 8 --out ref.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import types
+
+REF = "/root/reference"
+
+
+def _stub_modules():
+    """Torchvision/skimage top-level imports in the reference's augmentor
+    (core/utils/augmentor.py:7,15) — not installed here, never used on the
+    eval path."""
+    if "torchvision" not in sys.modules:
+        tv = types.ModuleType("torchvision")
+        tr = types.ModuleType("torchvision.transforms")
+        tr.ColorJitter = object
+        tr.Compose = object
+        tr.functional = types.ModuleType("torchvision.transforms.functional")
+        tv.transforms = tr
+        sys.modules["torchvision"] = tv
+        sys.modules["torchvision.transforms"] = tr
+        sys.modules["torchvision.transforms.functional"] = tr.functional
+    if "skimage" not in sys.modules:
+        try:
+            import skimage  # noqa: F401
+        except ImportError:
+            sk = types.ModuleType("skimage")
+            sk.color = types.ModuleType("skimage.color")
+            sk.io = types.ModuleType("skimage.io")
+            sys.modules["skimage"] = sk
+            sys.modules["skimage.color"] = sk.color
+            sys.modules["skimage.io"] = sk.io
+
+
+def _patch_cuda_noop():
+    import torch
+    torch.Tensor.cuda = lambda self, *a, **k: self
+    torch.nn.Module.cuda = lambda self, *a, **k: self
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--workspace", required=True,
+                   help="directory containing the datasets/ tree")
+    p.add_argument("--ckpt", required=True, help=".pth state dict to load")
+    p.add_argument("--save_init", action="store_true",
+                   help="seed torch, build the reference model, save its "
+                        "random-init state dict to --ckpt, then evaluate it")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--datasets", nargs="+", required=True,
+                   choices=["eth3d", "kitti", "things",
+                            "middlebury_F", "middlebury_H", "middlebury_Q"])
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--out", required=True, help="output JSON path")
+    p.add_argument("--corr_implementation", default="reg",
+                   choices=["reg", "alt"])
+    p.add_argument("--n_gru_layers", type=int, default=3)
+    p.add_argument("--hidden_dims", type=int, nargs="+",
+                   default=[128, 128, 128])
+    p.add_argument("--n_downsample", type=int, default=2)
+    p.add_argument("--corr_levels", type=int, default=4)
+    p.add_argument("--corr_radius", type=int, default=4)
+    p.add_argument("--shared_backbone", action="store_true")
+    p.add_argument("--slow_fast_gru", action="store_true")
+    p.add_argument("--context_norm", default="batch")
+    args = p.parse_args(argv)
+
+    _stub_modules()
+    sys.path.insert(0, os.path.join(REF, "core"))
+    sys.path.insert(0, REF)
+    import torch
+    # Determinism hygiene: one thread = one summation order, independent of
+    # host core count/load (moot on this 1-core box, load-bearing on real
+    # multi-core hosts where torch intra-op threading splits reductions).
+    torch.set_num_threads(1)
+    _patch_cuda_noop()
+
+    # evaluate_stereo does sys.path.append('core') relative to cwd — we've
+    # already inserted the absolute paths above, so that append is inert.
+    import evaluate_stereo as ref_eval
+    from raft_stereo import RAFTStereo
+
+    margs = argparse.Namespace(
+        corr_implementation=args.corr_implementation,
+        shared_backbone=args.shared_backbone, corr_levels=args.corr_levels,
+        corr_radius=args.corr_radius, n_downsample=args.n_downsample,
+        slow_fast_gru=args.slow_fast_gru, n_gru_layers=args.n_gru_layers,
+        hidden_dims=list(args.hidden_dims), mixed_precision=False,
+        context_norm=args.context_norm)
+    if args.save_init:
+        torch.manual_seed(args.seed)
+        model = torch.nn.DataParallel(RAFTStereo(margs))
+        # Saved through the DataParallel wrapper so keys carry the
+        # 'module.' prefix, exactly like released checkpoints
+        # (reference: train_stereo.py:187).
+        torch.save(model.state_dict(), args.ckpt)
+    else:
+        model = torch.nn.DataParallel(RAFTStereo(margs))
+        sd = torch.load(args.ckpt, map_location="cpu", weights_only=True)
+        model.load_state_dict(sd, strict=True)
+    model.eval()
+
+    out_path = os.path.abspath(args.out)
+    ckpt_dir = os.path.abspath(args.workspace)
+    os.chdir(ckpt_dir)  # reference datasets default to relative 'datasets/...'
+
+    results = {}
+    with torch.no_grad():
+        for name in args.datasets:
+            if name == "eth3d":
+                results.update(ref_eval.validate_eth3d(model, iters=args.iters))
+            elif name == "kitti":
+                results.update(ref_eval.validate_kitti(model, iters=args.iters))
+            elif name == "things":
+                results.update(ref_eval.validate_things(model, iters=args.iters))
+            else:
+                split = name.split("_")[1]
+                results.update(ref_eval.validate_middlebury(
+                    model, iters=args.iters, split=split))
+
+    with open(out_path, "w") as f:
+        json.dump({k: float(v) for k, v in results.items()}, f, indent=1)
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""Dataset-layout parity against the reference's own readers (VERDICT
+round-1 item 9): construct the REFERENCE's dataset classes and ours on the
+same synthetic trees and require them to discover exactly the same
+image/disparity file lists.  This replaces author-invented-layout trust with
+the reference code itself as the layout oracle — the same role
+`evaluate_stereo.py` plays for metrics in scripts/parity_cli.py."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from raftstereo_tpu.data import datasets as ds
+from raftstereo_tpu.data.synthetic import (make_synthetic_eth3d,
+                                           make_synthetic_kitti,
+                                           make_synthetic_middlebury,
+                                           make_synthetic_things_test)
+
+REF = "/root/reference"
+
+pytestmark = [pytest.mark.torch_parity, pytest.mark.slow]
+
+pytest.importorskip("torch")
+if not os.path.isdir(REF):
+    pytest.skip("reference tree not mounted", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def ref_datasets():
+    """Import the reference's stereo_datasets with its unused heavy deps
+    stubbed (same adaptation as scripts/ref_eval.py)."""
+    sys.path.insert(0, os.path.join(REF, "core"))
+    sys.path.insert(0, REF)
+    from scripts.ref_eval import _stub_modules
+    _stub_modules()
+    import stereo_datasets
+    return stereo_datasets
+
+
+def _pairs(dataset):
+    """Normalized (img1, img2, disp) path triplets."""
+    return sorted(
+        (os.path.normpath(i1), os.path.normpath(i2), os.path.normpath(d))
+        for (i1, i2), d in zip(dataset.image_list, dataset.disparity_list))
+
+
+def test_eth3d_same_files(ref_datasets, tmp_path, rng):
+    make_synthetic_eth3d(tmp_path, rng=rng)
+    ours = ds.ETH3D(aug_params=None, root=str(tmp_path))
+    theirs = ref_datasets.ETH3D({}, root=str(tmp_path))
+    assert _pairs(ours) == _pairs(theirs) and len(ours) == 3
+
+
+def test_kitti_same_files(ref_datasets, tmp_path, rng):
+    make_synthetic_kitti(tmp_path, n=4, rng=rng)
+    ours = ds.KITTI(aug_params=None, root=str(tmp_path))
+    theirs = ref_datasets.KITTI({}, root=str(tmp_path))
+    assert _pairs(ours) == _pairs(theirs) and len(ours) == 4
+
+
+def test_middlebury_same_files(ref_datasets, tmp_path, rng):
+    make_synthetic_middlebury(tmp_path, rng=rng)
+    ours = ds.Middlebury(aug_params=None, root=str(tmp_path), split="F")
+    theirs = ref_datasets.Middlebury({}, root=str(tmp_path), split="F")
+    assert _pairs(ours) == _pairs(theirs) and len(ours) == 2
+
+
+def test_things_test_same_files_and_val_subset(ref_datasets, tmp_path, rng):
+    """Includes the seeded 400-image validation-subset selection
+    (reference: core/stereo_datasets.py:146-149)."""
+    make_synthetic_things_test(tmp_path, n=3, rng=rng)
+    ours = ds.SceneFlowDatasets(aug_params=None, root=str(tmp_path),
+                                dstype="frames_finalpass", things_test=True)
+    theirs = ref_datasets.SceneFlowDatasets({}, root=str(tmp_path),
+                                            dstype="frames_finalpass",
+                                            things_test=True)
+    assert _pairs(ours) == _pairs(theirs) and len(ours) == 3
+
+
+def test_items_numerically_identical(ref_datasets, tmp_path, rng):
+    """Beyond file lists: the decoded tensors (images, flow, valid) must
+    match elementwise — KITTI exercises the 16-bit png disparity codec and
+    the sparse validity protocol end to end in both stacks."""
+    make_synthetic_kitti(tmp_path, n=2, rng=rng)
+    ours = ds.KITTI(aug_params=None, root=str(tmp_path))
+    theirs = ref_datasets.KITTI({}, root=str(tmp_path))
+    for i in range(2):
+        _, i1, i2, flow, valid = ours[i]
+        _, t1, t2, tflow, tvalid = theirs[i]
+        np.testing.assert_array_equal(i1, t1.permute(1, 2, 0).numpy())
+        np.testing.assert_array_equal(i2, t2.permute(1, 2, 0).numpy())
+        np.testing.assert_allclose(flow[..., 0],
+                                   tflow.permute(1, 2, 0).numpy()[..., 0],
+                                   atol=1e-6)
+        np.testing.assert_array_equal(valid, tvalid.numpy())
