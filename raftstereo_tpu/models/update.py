@@ -43,7 +43,15 @@ class FlowHead(nn.Module):
 class ConvGRU(nn.Module):
     """Conv gated recurrent unit with external context biases
     (reference: core/update.py:16-32).  Concat order [h, x] and [r*h, x]
-    is preserved for checkpoint conversion."""
+    is preserved for checkpoint conversion.
+
+    The z and r gates read the same input, so their convs (the reference's
+    separate ``convz``/``convr``) are one fused conv producing 2*hidden
+    channels — per output channel the arithmetic is identical (the fusion
+    only concatenates along the *output* axis), so converted checkpoints
+    stay bit-compatible; the converter concatenates the torch weights
+    (utils/convert.py).  One fewer HBM read of ``hx`` per GRU per iteration.
+    ``convq``'s input differs (r gates h first) and stays separate."""
 
     hidden_dim: int
     kernel_size: int = 3
@@ -51,15 +59,29 @@ class ConvGRU(nn.Module):
 
     def setup(self):
         k = self.kernel_size
-        self.convz = conv(self.hidden_dim, k, dtype=self.dtype)
-        self.convr = conv(self.hidden_dim, k, dtype=self.dtype)
+
+        def split_fan_out_init(key, shape, dtype=jnp.float32):
+            # From-scratch init must match the reference's two SEPARATE
+            # kaiming fan_out convs (core/extractor.py:155-162 semantics):
+            # per-gate fan_out is hidden*k*k, not the fused 2*hidden*k*k —
+            # plain kaiming on the fused shape would under-scale by sqrt(2).
+            kh, kw, _, o = shape
+            std = (2.0 / (o // 2 * kh * kw)) ** 0.5
+            return std * jax.random.normal(key, shape, dtype)
+
+        self.convzr = nn.Conv(2 * self.hidden_dim, (k, k),
+                              padding=((k // 2, k // 2), (k // 2, k // 2)),
+                              kernel_init=split_fan_out_init,
+                              dtype=self.dtype, name="convzr")
         self.convq = conv(self.hidden_dim, k, dtype=self.dtype)
 
     def __call__(self, h, cz, cr, cq, *x_list):
+        hd = self.hidden_dim
         x = jnp.concatenate(x_list, axis=-1)
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(self.convz(hx) + cz)
-        r = nn.sigmoid(self.convr(hx) + cr)
+        zr = self.convzr(hx)
+        z = nn.sigmoid(zr[..., :hd] + cz)
+        r = nn.sigmoid(zr[..., hd:] + cr)
         q = nn.tanh(self.convq(jnp.concatenate([r * h, x], axis=-1)) + cq)
         return (1 - z) * h + z * q
 

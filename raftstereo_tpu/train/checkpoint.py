@@ -43,7 +43,16 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError("no checkpoint found")
         tgt = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
-        return self._mngr.restore(step, args=ocp.args.StandardRestore(tgt))
+        try:
+            return self._mngr.restore(step, args=ocp.args.StandardRestore(tgt))
+        except Exception as e:
+            if "convz" in str(e) or "convr" in str(e):
+                raise ValueError(
+                    "checkpoint predates the fused GRU gate conv (convz/"
+                    "convr -> convzr, round 2): re-export it through the "
+                    ".pth converter or retrain; full train states (Adam "
+                    "moments) cannot be migrated mechanically") from e
+            raise
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
@@ -58,6 +67,13 @@ def save_weights(path: str, variables: Dict) -> None:
     ckptr.close()
 
 
+def _all_keys(tree):
+    for k, v in tree.items():
+        yield k
+        if isinstance(v, dict):
+            yield from _all_keys(v)
+
+
 def load_weights(path: str, variables_like: Optional[Dict] = None) -> Dict:
     """Load a weights-only checkpoint; ``variables_like`` (e.g. from
     ``model.init``) pins the pytree structure if given."""
@@ -65,8 +81,25 @@ def load_weights(path: str, variables_like: Optional[Dict] = None) -> Dict:
     path = os.path.abspath(path)
     if variables_like is None:
         out = ckptr.restore(path)
+        # Pre-round-2 weights carry separate GRU convz/convr; migrate to
+        # the fused convzr in place (numerically identical — the same
+        # concat the .pth converter applies).
+        leaves = {k for tree in out.values() if isinstance(tree, dict)
+                  for k in _all_keys(tree)}
+        if "convz" in leaves:
+            from ..utils.convert import migrate_prefusion_variables
+            out = migrate_prefusion_variables(out)
     else:
         tgt = jax.tree.map(ocp.utils.to_shape_dtype_struct, variables_like)
-        out = ckptr.restore(path, tgt)
+        try:
+            out = ckptr.restore(path, tgt)
+        except Exception as e:
+            if "convz" in str(e) or "convr" in str(e):
+                raise ValueError(
+                    "weights predate the fused GRU gate conv (convz/convr "
+                    "-> convzr, round 2); load them with "
+                    "utils.convert.migrate_prefusion_variables or "
+                    "re-export") from e
+            raise
     ckptr.close()
     return out

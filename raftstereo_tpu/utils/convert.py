@@ -93,6 +93,18 @@ def _translate_module(flax_path: tuple, shared_backbone: bool) -> str:
 
 def _convert_leaf(name: str, torch_prefix: str,
                   sd: Mapping[str, np.ndarray]) -> np.ndarray:
+    if torch_prefix.endswith(".convzr"):
+        # Our ConvGRU fuses the reference's convz+convr into one conv
+        # (models/update.py) — concatenate the torch weights on the output
+        # axis; per-channel arithmetic is unchanged.
+        parts = [torch_prefix[:-len("convzr")] + c for c in ("convz", "convr")]
+        if name == "kernel":
+            return np.concatenate(
+                [np.transpose(sd[f"{p}.weight"], (2, 3, 1, 0))
+                 for p in parts], axis=-1)
+        if name == "bias":
+            return np.concatenate([sd[f"{p}.bias"] for p in parts])
+        raise KeyError(name)
     if name == "kernel":
         w = sd[f"{torch_prefix}.weight"]
         assert w.ndim == 4, (torch_prefix, w.shape)
@@ -138,7 +150,12 @@ def torch_to_variables(sd: Mapping[str, np.ndarray], template: Dict,
             prefix = _translate_module(tuple(mods), config.shared_backbone)
             arr = _convert_leaf(name, prefix, sd)
             assert arr.shape == leaf.shape, (path, arr.shape, leaf.shape)
-            consumed.add(f"{prefix}.{leaf_to_torch[name]}")
+            if prefix.endswith(".convzr"):  # fused GRU gate conv: two sources
+                for c in ("convz", "convr"):
+                    consumed.add(f"{prefix[:-len('convzr')]}{c}."
+                                 f"{leaf_to_torch[name]}")
+            else:
+                consumed.add(f"{prefix}.{leaf_to_torch[name]}")
             if prefix.endswith(".downsample.1"):
                 # The reference's ResidualBlock registers the projection norm
                 # twice (as `norm3` and inside the downsample Sequential —
@@ -175,6 +192,34 @@ def _set(tree: Dict, path, value):
     for k in path[:-1]:
         tree = tree.setdefault(k, {})
     tree[path[-1]] = value
+
+
+def migrate_prefusion_variables(variables: Mapping) -> Dict:
+    """Migrate a weights pytree saved before the GRU gate-conv fusion
+    (round 2): every ConvGRU's separate ``convz``/``convr`` become one
+    ``convzr`` with kernels/biases concatenated on the output axis — the
+    exact transformation the .pth converter applies, so the migrated model
+    is numerically identical."""
+    import jax.numpy as jnp
+
+    def walk(tree):
+        if not isinstance(tree, Mapping):
+            return tree
+        out = {}
+        keys = set(tree)
+        if {"convz", "convr"} <= keys:
+            out["convzr"] = {
+                "kernel": jnp.concatenate([tree["convz"]["kernel"],
+                                           tree["convr"]["kernel"]], axis=-1),
+                "bias": jnp.concatenate([tree["convz"]["bias"],
+                                         tree["convr"]["bias"]]),
+            }
+            keys -= {"convz", "convr"}
+        for k in keys:
+            out[k] = walk(tree[k])
+        return out
+
+    return walk(variables)
 
 
 def convert_checkpoint(pth_path: str, config: RAFTStereoConfig,

@@ -195,3 +195,43 @@ class TestRemat:
         for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=2e-5)
+
+
+class TestFusedGRUConv:
+    """The convzr fusion (round 2) must not change init statistics or strand
+    pre-fusion checkpoints."""
+
+    def test_init_std_matches_per_gate_kaiming(self):
+        from raftstereo_tpu.models.update import ConvGRU
+
+        gru = ConvGRU(128)
+        h = jnp.zeros((1, 8, 8, 128))
+        c = jnp.zeros((1, 8, 8, 128))
+        x = jnp.zeros((1, 8, 8, 256))
+        params = gru.init(jax.random.key(0), h, c, c, c, x)["params"]
+        kzr = np.asarray(params["convzr"]["kernel"])
+        kq = np.asarray(params["convq"]["kernel"])
+        # Per-gate kaiming fan_out: std = sqrt(2 / (hidden * k * k)) — the
+        # fused conv must NOT use its doubled fan_out (that would shrink the
+        # gate init by sqrt(2) vs the reference's separate convs).
+        expect = (2.0 / (128 * 9)) ** 0.5
+        assert abs(kzr.std() / expect - 1) < 0.05, (kzr.std(), expect)
+        assert abs(kq.std() / expect - 1) < 0.05, (kq.std(), expect)
+
+    def test_migrate_prefusion_variables(self, rng):
+        from raftstereo_tpu.utils.convert import migrate_prefusion_variables
+
+        kz = rng.standard_normal((3, 3, 8, 4)).astype(np.float32)
+        kr = rng.standard_normal((3, 3, 8, 4)).astype(np.float32)
+        old = {"params": {"update": {"gru0": {
+            "convz": {"kernel": kz, "bias": np.zeros(4, np.float32)},
+            "convr": {"kernel": kr, "bias": np.ones(4, np.float32)},
+            "convq": {"kernel": kr, "bias": np.ones(4, np.float32)},
+        }}}}
+        new = migrate_prefusion_variables(old)
+        g = new["params"]["update"]["gru0"]
+        assert set(g) == {"convzr", "convq"}
+        np.testing.assert_array_equal(np.asarray(g["convzr"]["kernel"]),
+                                      np.concatenate([kz, kr], axis=-1))
+        np.testing.assert_array_equal(np.asarray(g["convzr"]["bias"]),
+                                      np.concatenate([np.zeros(4), np.ones(4)]))
