@@ -55,6 +55,7 @@ class Evaluator:
         # W, so each height shard's epipolar lines are self-contained —
         # numerically transparent, tests/test_parallel.py).
         self._in_sharding = None
+        self._mesh = mesh
         if mesh is not None:
             from ..parallel import SPACE_AXIS, replicated, spatial_sharded
             space = mesh.shape.get(SPACE_AXIS, 1)
@@ -98,7 +99,9 @@ class Evaluator:
         shape = tuple(i1.shape[1:3])
         self.last_included_compile = shape not in self.compiled_shapes
         start = time.perf_counter()
-        _, flow_up = self._fn(self.variables, i1, i2)
+        from ..parallel.context import use_corr_mesh
+        with use_corr_mesh(self._mesh):  # lets Pallas backends shard_map
+            _, flow_up = self._fn(self.variables, i1, i2)
         flow_up = np.asarray(flow_up, np.float32)  # host fetch = completion
         self.last_runtime = time.perf_counter() - start
         self.compiled_shapes.add(shape)

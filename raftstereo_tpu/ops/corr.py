@@ -154,6 +154,35 @@ def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
     return corr_fn
 
 
+def _corr_shard_mesh(b: int, h: int):
+    """The active (data, space) mesh if the Pallas backends can partition
+    over it: B divisible by data, H (at corr resolution) by space.
+
+    The kernels' grids are per-(B*H)-row independent — the same independence
+    the reference's CUDA kernel exploits (one thread block per row,
+    sampler/sampler_kernel.cu:19-60) — so batch/height sharding via
+    ``shard_map`` needs no cross-shard communication.  Returns
+    (mesh, row_spec, flat_spec) or None (plain single-device lowering).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.context import active_corr_mesh
+    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
+
+    mesh = active_corr_mesh()
+    if mesh is None:
+        return None
+    d = mesh.shape.get(DATA_AXIS, 1)
+    s = mesh.shape.get(SPACE_AXIS, 1)
+    if d * s == 1 or b % d or h % s:
+        return None
+    # Flat (B*H, ...) arrays shard over BOTH axes at once; each device's
+    # rows are exactly the ones its (b-block, h-block) produced, because
+    # construction and lookup run inside shard_map with the same specs.
+    return (mesh, P(DATA_AXIS, SPACE_AXIS, None, None),
+            P((DATA_AXIS, SPACE_AXIS), None, None))
+
+
 def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                         radius: int, dtype=jnp.float32) -> CorrFn:
     """Precomputed-pyramid backend with the Pallas TPU lookup kernel.
@@ -161,16 +190,41 @@ def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
     Each pyramid level is flattened + W1-padded to the kernel's layout ONCE
     here; per-iteration calls reshape only the taps (the volume pad is an HBM
     copy of the whole volume — done once structurally rather than relying on
-    XLA's loop-invariant code motion)."""
+    XLA's loop-invariant code motion).
+
+    Under an active corr mesh (parallel/context.py) construction and lookups
+    run inside ``shard_map`` over the (data, space) axes, so the backend
+    partitions across chips like the XLA-native ones."""
     from .pallas_corr import (pad_vol_lane, pallas_lookup_pyramid_flat,
                               preflatten_volume)
 
-    volume = build_corr_volume(fmap1.astype(jnp.float32),
-                               fmap2.astype(jnp.float32), dtype=dtype)
-    # Lane-padded level concat along W2: every per-iteration lookup is ONE
-    # kernel launch covering all levels (same construction as pallas_alt).
-    pyramid = [pad_vol_lane(preflatten_volume(v))
+    def construct(f1, f2):
+        volume = build_corr_volume(f1.astype(jnp.float32),
+                                   f2.astype(jnp.float32), dtype=dtype)
+        # Lane-padded level concat along W2: every per-iteration lookup is
+        # ONE kernel launch covering all levels (same as pallas_alt).
+        pyr = [pad_vol_lane(preflatten_volume(v))
                for v in build_corr_pyramid(volume, num_levels)]
+        return tuple(pyr)
+
+    shard = _corr_shard_mesh(fmap1.shape[0], fmap1.shape[1])
+    if shard is None:
+        pyramid = construct(fmap1, fmap2)
+        lookup_flat = pallas_lookup_pyramid_flat
+    else:
+        mesh, row_spec, flat_spec = shard
+        fmap_spec = row_spec
+        pyramid = jax.shard_map(
+            construct, mesh=mesh, in_specs=(fmap_spec, fmap_spec),
+            out_specs=tuple([flat_spec] * num_levels),
+            check_vma=False)(fmap1, fmap2)
+
+        def lookup_flat(vcat, taps, w2s):
+            return jax.shard_map(
+                lambda v, t: pallas_lookup_pyramid_flat(v, t, w2s),
+                mesh=mesh, in_specs=(flat_spec, row_spec),
+                out_specs=row_spec, check_vma=False)(vcat, taps)
+
     w2s = tuple(v.shape[2] for v in pyramid)
     vcat = jnp.concatenate(pyramid, axis=2)
     offsets = _tap_offsets(radius)
@@ -180,7 +234,7 @@ def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
         taps = jnp.concatenate(
             [x[..., None] / (2.0 ** i) + offsets        # (B, H, W1, K)
              for i in range(len(w2s))], axis=-1)
-        return pallas_lookup_pyramid_flat(vcat, taps, w2s)
+        return lookup_flat(vcat, taps, w2s)
 
     return corr_fn
 
@@ -205,9 +259,31 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
     # DMA and takes the MXU's native bf16 path (fp32 accumulation). The
     # pyramid is always POOLED in fp32 first; only the kernel inputs are
     # rounded.
-    f1flat = preflatten_fmap1(fmap1.astype(jnp.float32)).astype(dtype)
-    f2_pyramid = [pad_w2_lane(preflatten_fmap2(f2)).astype(dtype) for f2 in
-                  build_fmap2_pyramid(fmap2.astype(jnp.float32), num_levels)]
+    def construct(f1, f2):
+        f1flat = preflatten_fmap1(f1.astype(jnp.float32)).astype(dtype)
+        f2p = [pad_w2_lane(preflatten_fmap2(x)).astype(dtype) for x in
+               build_fmap2_pyramid(f2.astype(jnp.float32), num_levels)]
+        return (f1flat,) + tuple(f2p)
+
+    shard = _corr_shard_mesh(fmap1.shape[0], fmap1.shape[1])
+    if shard is None:
+        f1flat, *f2_pyramid = construct(fmap1, fmap2)
+        lookup_flat = pallas_alt_pyramid_flat
+    else:
+        # Partition over the mesh (see _corr_shard_mesh): construction and
+        # every lookup run per-shard inside shard_map; no collectives.
+        mesh, row_spec, flat_spec = shard
+        f1flat, *f2_pyramid = jax.shard_map(
+            construct, mesh=mesh, in_specs=(row_spec, row_spec),
+            out_specs=tuple([flat_spec] * (1 + num_levels)),
+            check_vma=False)(fmap1, fmap2)
+
+        def lookup_flat(f1, f2, taps, w2s):
+            return jax.shard_map(
+                lambda a, b, t: pallas_alt_pyramid_flat(a, b, t, w2s),
+                mesh=mesh, in_specs=(flat_spec, flat_spec, row_spec),
+                out_specs=row_spec, check_vma=False)(f1, f2, taps)
+
     w2s = tuple(f2.shape[1] for f2 in f2_pyramid)
     f2cat = jnp.concatenate(f2_pyramid, axis=1)
     offsets = _tap_offsets(radius)
@@ -217,7 +293,7 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
         taps = jnp.concatenate(
             [x[..., None] / (2.0 ** i) + offsets        # (B, H, W1, K)
              for i in range(len(w2s))], axis=-1)
-        return pallas_alt_pyramid_flat(f1flat, f2cat, taps, w2s)
+        return lookup_flat(f1flat, f2cat, taps, w2s)
 
     return corr_fn
 

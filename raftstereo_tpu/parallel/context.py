@@ -1,0 +1,52 @@
+"""Trace-time mesh context for mesh-partitionable Pallas backends.
+
+Pallas kernels compile to XLA custom calls, which the SPMD partitioner
+cannot split on its own — without help, a Pallas corr backend under a
+multi-device ``jit`` would be a scaling boundary (the round-1 state).  The
+kernels' grids are per-(B*H)-row independent (the same independence the
+reference's CUDA kernel exploits: one thread block per row,
+sampler/sampler_kernel.cu:19-60), so batch- and height-sharding need no
+cross-shard communication at all: the right program is "run the same kernel
+on each shard's rows", i.e. ``shard_map``.
+
+``shard_map`` needs the concrete mesh at trace time, which the functional
+ops layer can't see from inside ``jit``.  This context hands it down:
+entry points that own a mesh (train loop, Evaluator, dryrun) wrap their
+trace in ``use_corr_mesh(mesh)``; ``ops/corr.py`` consults
+``active_corr_mesh()`` when building a Pallas backend and wraps
+construction + per-iteration lookups in ``shard_map`` over the mesh's
+(data, space) axes.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_state = threading.local()
+
+
+@contextmanager
+def use_corr_mesh(mesh: Optional[Mesh]):
+    """Make ``mesh`` visible to Pallas corr-backend construction during
+    tracing.  ``None`` is allowed (no-op) so callers can pass their
+    maybe-mesh straight through."""
+    prev = getattr(_state, "mesh", None)
+    _state.mesh = mesh
+    try:
+        yield
+    finally:
+        _state.mesh = prev
+
+
+def active_corr_mesh() -> Optional[Mesh]:
+    """The mesh set by the innermost ``use_corr_mesh``, if any (and only if
+    it actually has more than one device — a trivial 1x1 mesh means plain
+    single-device lowering is the right program)."""
+    mesh = getattr(_state, "mesh", None)
+    if mesh is not None and mesh.size > 1:
+        return mesh
+    return None

@@ -107,10 +107,22 @@ def make_train_step(model, tx, cfg: TrainConfig, lr_schedule=None,
 
 def jit_train_step(step_fn, mesh):
     """Compile the step over a mesh: state/metrics replicated, batch sharded
-    on ``data``.  ``donate_argnums=0`` reuses the old state's HBM buffers."""
+    on ``data``.  ``donate_argnums=0`` reuses the old state's HBM buffers.
+
+    The mesh is also exposed to tracing via ``use_corr_mesh`` so Pallas corr
+    backends partition over it (shard_map) instead of being replicated
+    custom-call islands (parallel/context.py)."""
+    from ..parallel.context import use_corr_mesh
+
     repl = replicated(mesh)
     data = batch_sharded(mesh)
-    return jax.jit(step_fn,
-                   in_shardings=(repl, (data, data, data, data)),
-                   out_shardings=(repl, repl),
-                   donate_argnums=(0,))
+    jitted = jax.jit(step_fn,
+                     in_shardings=(repl, (data, data, data, data)),
+                     out_shardings=(repl, repl),
+                     donate_argnums=(0,))
+
+    def call(state, batch):
+        with use_corr_mesh(mesh):  # active at (first-call) trace time
+            return jitted(state, batch)
+
+    return call
