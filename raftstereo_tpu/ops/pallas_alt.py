@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_corr import (_block_w1, _interpret, _pad_taps, _pad_w1,
+from .pallas_corr import (_BLOCK_ROWS, _COMPILER_PARAMS, _block_w1,
+                          _interpret, _pad_rows, _pad_taps, _pad_w1,
                           bounds_from_widths, pad_lane)
 
 
@@ -59,14 +60,14 @@ def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds):
     # Feed the MXU the stored dtype directly: bf16 inputs take the native
     # bf16 path with fp32 accumulation (HIGHEST would force a multi-pass
     # fp32 emulation ~8x slower); fp32 inputs keep exact fp32.
-    f1 = f1_ref[0]                                # (blk, C)
-    f2 = f2_ref[0]                                # (W2cat, C)
-    taps = taps_ref[0].astype(jnp.float32)        # (blk, L*K)
+    f1 = f1_ref[...]                              # (R, blk, C)
+    f2 = f2_ref[...]                              # (R, W2cat, C)
+    taps = taps_ref[...].astype(jnp.float32)      # (R, blk, L*K)
     prec = (jax.lax.Precision.HIGHEST if f1.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
-    m = jax.lax.dot_general(f1, f2, (((1,), (1,)), ((), ())),
+    m = jax.lax.dot_general(f1, f2, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32,
-                            precision=prec) * scale
+                            precision=prec) * scale   # (R, blk, W2cat)
     kk = taps.shape[-1] // len(bounds)
     cols = []
     for li, (off, w2p) in enumerate(bounds):
@@ -76,48 +77,48 @@ def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds):
         # both measured slower than per-level kernel launches). Levels are
         # zero-padded to lane multiples, and a padded column's m is exactly
         # zero, so no mask is needed for correct zero-outside semantics.
-        ml = m[:, off:off + w2p]
-        j = jax.lax.broadcasted_iota(jnp.int32, (1, w2p), 1).astype(jnp.float32)
+        ml = m[:, :, off:off + w2p]
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2p), 2).astype(jnp.float32)
         for ki in range(kk):                      # L*K is small: unrolled
-            t = taps[:, li * kk + ki][:, None]
+            t = taps[:, :, li * kk + ki][..., None]
             w = jnp.maximum(0.0, 1.0 - jnp.abs(j - t))
-            cols.append(jnp.sum(ml * w, axis=-1))
-    out_ref[0] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
+            cols.append(jnp.sum(ml * w, axis=-1))  # (R, blk)
+    out_ref[...] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
 
 
 def _alt_pyr_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
                         scale, bounds):
-    f1 = f1_ref[0]                                # (blk, C)
-    f2 = f2_ref[0]                                # (W2cat, C)
+    f1 = f1_ref[...]                              # (R, blk, C)
+    f2 = f2_ref[...]                              # (R, W2cat, C)
     prec = (jax.lax.Precision.HIGHEST if f1.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
-    taps = taps_ref[0].astype(jnp.float32)        # (blk, L*K)
-    g = g_ref[0].astype(jnp.float32)              # (blk, L*K)
+    taps = taps_ref[...].astype(jnp.float32)      # (R, blk, L*K)
+    g = g_ref[...].astype(jnp.float32)            # (R, blk, L*K)
     kk = taps.shape[-1] // len(bounds)
     parts = []
     for li, (off, w2p) in enumerate(bounds):
-        j = jax.lax.broadcasted_iota(jnp.int32, (1, w2p), 1).astype(jnp.float32)
-        dml = jnp.zeros((taps.shape[0], w2p), jnp.float32)
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2p), 2).astype(jnp.float32)
+        dml = jnp.zeros(taps.shape[:2] + (w2p,), jnp.float32)
         for ki in range(kk):
-            t = taps[:, li * kk + ki][:, None]
+            t = taps[:, :, li * kk + ki][..., None]
             w = jnp.maximum(0.0, 1.0 - jnp.abs(j - t))
-            dml = dml + g[:, li * kk + ki][:, None] * w
+            dml = dml + g[:, :, li * kk + ki][..., None] * w
         parts.append(dml)
     # Gradient mass landing on a level's zero-padded columns (a tap within 1
     # of the level edge) flows into df2 rows that the caller's concat-pad
     # autodiff discards — matching the per-level kernels exactly.
     dm = (jnp.concatenate(parts, axis=-1) * scale).astype(f1.dtype)
-    df1_ref[0] = jax.lax.dot_general(
-        dm, f2, (((1,), (0,)), ((), ())),
+    df1_ref[...] = jax.lax.dot_general(
+        dm, f2, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
         precision=prec).astype(df1_ref.dtype)
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
-        df2_ref[0] = jnp.zeros_like(df2_ref[0])
+        df2_ref[...] = jnp.zeros_like(df2_ref[...])
 
-    df2_ref[0] += jax.lax.dot_general(
-        dm, f1, (((0,), (0,)), ((), ())),
+    df2_ref[...] += jax.lax.dot_general(
+        dm, f1, (((1,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
         precision=prec).astype(df2_ref.dtype)
 
@@ -130,12 +131,14 @@ def preflatten_fmap1(fmap1: jax.Array) -> jax.Array:
     f1, _ = _pad_w1(
         fmap1.reshape(fmap1.shape[0] * fmap1.shape[1], *fmap1.shape[2:]),
         _block_w1(fmap1.shape[2]))
-    return f1
+    return _pad_rows(f1)
 
 
 def preflatten_fmap2(fmap2: jax.Array) -> jax.Array:
-    """(B, H, W2, C) -> (B*H, W2, C); no padding (W2 rides whole in VMEM)."""
-    return fmap2.reshape(fmap2.shape[0] * fmap2.shape[1], *fmap2.shape[2:])
+    """(B, H, W2, C) -> (B*Hp, W2, C); W2 unpadded (rides whole in VMEM),
+    rows padded to the kernel row-block like preflatten_fmap1."""
+    return _pad_rows(
+        fmap2.reshape(fmap2.shape[0] * fmap2.shape[1], *fmap2.shape[2:]))
 
 
 def pallas_alt_lookup_flat(f1flat: jax.Array, f2flat: jax.Array,
@@ -201,7 +204,10 @@ def _make_alt_pyr(f1flat_shape, f2cat_shape, w2s, f1_dtype, f2_dtype):
     def bwd(res, g):
         f1flat, f2cat, taps = res
         df1, df2 = _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds)
-        return (df1.astype(f1_dtype), df2.astype(f2_dtype),
+        # Row-padding inside the impl is invisible to callers: cotangents
+        # are sliced back to the primal row counts.
+        return (df1[:f1flat.shape[0]].astype(f1_dtype),
+                df2[:f2cat.shape[0]].astype(f2_dtype),
                 jnp.zeros_like(taps))
 
     f.defvjp(fwd, bwd)
@@ -209,58 +215,67 @@ def _make_alt_pyr(f1flat_shape, f2cat_shape, w2s, f1_dtype, f2_dtype):
 
 
 def _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds):
+    f1flat = _pad_rows(f1flat)  # no-ops for preflatten_* outputs
+    f2cat = _pad_rows(f2cat)
     n, w1p, c = f1flat.shape
     b, h, w1, lk = taps.shape
-    t, blk = _pad_taps(taps)
+    t, blk = _pad_taps(taps, n)
     scale = 1.0 / float(c) ** 0.5
     w2cat = f2cat.shape[1]
+    r = _BLOCK_ROWS
     out = pl.pallas_call(
         functools.partial(_alt_pyr_fwd_kernel, scale=scale, bounds=bounds),
         out_shape=jax.ShapeDtypeStruct((n, w1p, lk), jnp.float32),
-        grid=(n, w1p // blk),
+        grid=(n // r, w1p // blk),
         in_specs=[
-            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
+            pl.BlockSpec((r, blk, c), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, w2cat, c), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((r, w2cat, c), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk, lk), lambda i, j: (i, j, 0),
+            pl.BlockSpec((r, blk, lk), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, blk, lk), lambda i, j: (i, j, 0),
+        out_specs=pl.BlockSpec((r, blk, lk), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
     )(f1flat, f2cat, t)
-    return out[:, :w1].reshape(b, h, w1, lk)
+    return out[:b * h, :w1].reshape(b, h, w1, lk)
 
 
 def _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds):
+    f1flat = _pad_rows(f1flat)  # no-ops for preflatten_* outputs
+    f2cat = _pad_rows(f2cat)
     n, w1p, c = f1flat.shape
     b, h, w1, lk = taps.shape
-    t, blk = _pad_taps(taps)
+    t, blk = _pad_taps(taps, n)
     gg, _ = _pad_w1(g.reshape(b * h, w1, lk), blk)
+    gg = _pad_rows(gg)
     scale = 1.0 / float(c) ** 0.5
     w2cat = f2cat.shape[1]
+    r = _BLOCK_ROWS
     df1, df2 = pl.pallas_call(
         functools.partial(_alt_pyr_bwd_kernel, scale=scale, bounds=bounds),
         out_shape=(jax.ShapeDtypeStruct((n, w1p, c), jnp.float32),
                    jax.ShapeDtypeStruct((n, w2cat, c), jnp.float32)),
-        grid=(n, w1p // blk),
+        grid=(n // r, w1p // blk),
         in_specs=[
-            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
+            pl.BlockSpec((r, blk, c), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, w2cat, c), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((r, w2cat, c), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk, lk), lambda i, j: (i, j, 0),
+            pl.BlockSpec((r, blk, lk), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk, lk), lambda i, j: (i, j, 0),
+            pl.BlockSpec((r, blk, lk), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0),
+            pl.BlockSpec((r, blk, c), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, w2cat, c), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((r, w2cat, c), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ),
         interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
     )(f1flat, f2cat, t, gg)
     return df1, df2
