@@ -39,9 +39,71 @@ def resolve_corr(corr: str) -> str:
     return corr
 
 
+def measure_matmul_peak_tflops(reps: int = 400, n: int = 4096) -> float:
+    """The chip's *achievable* bf16 matmul ceiling, measured on the spot.
+
+    MFU against this number answers "how close is the model to what this
+    silicon can actually do" — important here because the tunneled TPU is a
+    fractional slice whose real ceiling is far below the v5e spec sheet
+    (197 TFLOP/s).  The repeat loop runs on device (same dispatch rationale
+    as bench_jax) and the per-dispatch fixed latency — same order as the
+    compute at small reps — is measured with a null program and subtracted,
+    so the probe reports device throughput, not tunnel latency.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16)
+
+    def run(n_reps):
+        def body(i, carry):
+            acc, bb = carry
+            bb = bb + i.astype(bb.dtype) * 0  # defeat loop-invariant hoisting
+            c = jax.lax.dot(a, bb, precision=None,
+                            preferred_element_type=jnp.float32)
+            return acc + c[0, 0], bb
+        acc, _ = jax.lax.fori_loop(0, n_reps, body, (jnp.float32(0), b))
+        return acc
+
+    null = jax.jit(lambda x: x + 1.0)
+    float(null(jnp.float32(0)))  # compile
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(null(jnp.float32(0)))
+    dispatch = (time.perf_counter() - t0) / 3
+
+    fn = jax.jit(run, static_argnums=(0,))
+    float(fn(reps))  # compile + warm
+    t0 = time.perf_counter()
+    float(fn(reps))
+    dt = max(time.perf_counter() - t0 - dispatch, 1e-9)
+    return 2 * n * n * n * reps / dt / 1e12
+
+
+def analyze_forward_flops(model, variables, img1, img2, iters) -> float:
+    """Analytic FLOPs for ONE forward execution (the whole batch), from
+    XLA's cost model on the compiled flagship computation.  Returns 0.0 if
+    the backend does not expose a cost analysis."""
+    import jax
+
+    fwd = jax.jit(lambda v, a, b: model.forward(v, a, b, iters=iters,
+                                                test_mode=True))
+    try:
+        compiled = fwd.lower(variables, img1, img2).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception as e:
+        print(f"cost analysis unavailable: {e}", file=sys.stderr)
+        return 0.0
+
+
 def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
               reps: int, compute_dtype: str,
-              corr_dtype: str = "float32", realtime: bool = False) -> float:
+              corr_dtype: str = "float32", realtime: bool = False,
+              mfu: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -91,7 +153,24 @@ def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
     t0 = time.perf_counter()
     float(fn(variables, img1, img2, reps))
     dt = time.perf_counter() - t0
-    return batch * reps / dt
+    pairs_per_sec = batch * reps / dt
+    if not mfu:
+        return pairs_per_sec, None
+
+    flops_exec = analyze_forward_flops(model, variables, img1, img2, iters)
+    if jax.default_backend() == "tpu":
+        peak = measure_matmul_peak_tflops()
+    else:  # CPU dev runs: a small probe, just to keep the field meaningful
+        peak = measure_matmul_peak_tflops(reps=2, n=1024)
+    flops_per_pair = flops_exec / batch
+    model_tflops = flops_per_pair * pairs_per_sec / 1e12
+    return pairs_per_sec, {
+        "flops_per_pair": flops_per_pair,
+        "model_tflops": round(model_tflops, 3),
+        "measured_peak_tflops": round(peak, 2),
+        "mfu_vs_measured_peak": (round(model_tflops / peak, 4)
+                                 if peak else 0.0),
+    }
 
 
 def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
@@ -239,11 +318,17 @@ def main() -> None:
                    choices=["float32", "bfloat16"])
     p.add_argument("--corr_dtype", default="float32",
                    choices=["float32", "bfloat16"],
-                   help="correlation volume storage dtype; honoured by the "
-                        "pallas backend only (reg/alt/pallas_alt pin fp32, "
-                        "mirroring the reference's fp32-volume torch paths)")
+                   help="correlation volume/fmap storage dtype for the "
+                        "pallas and pallas_alt backends (the CUDA kernel's "
+                        "fp16 dispatch equivalent); reg/alt pin fp32, "
+                        "mirroring the reference's fp32-volume torch paths")
     p.add_argument("--quick", action="store_true",
                    help="tiny shapes / few reps (CPU development)")
+    p.add_argument("--mfu", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="emit FLOP accounting + MFU next to pairs/sec "
+                        "(XLA cost model + on-the-spot matmul-ceiling "
+                        "measurement; default: on unless --quick)")
     p.add_argument("--realtime", action="store_true",
                    help="benchmark the realtime configuration (shared "
                         "backbone, n_downsample 3, 2 GRU layers, slow_fast, "
@@ -303,9 +388,11 @@ def main() -> None:
         }))
         return
 
-    value = bench_jax(args.height, args.width, args.batch, args.iters,
-                      args.corr, args.reps, args.compute_dtype,
-                      args.corr_dtype, realtime=args.realtime)
+    mfu = (not args.quick) if args.mfu is None else args.mfu
+    value, mfu_stats = bench_jax(args.height, args.width, args.batch,
+                                 args.iters, args.corr, args.reps,
+                                 args.compute_dtype, args.corr_dtype,
+                                 realtime=args.realtime, mfu=mfu)
 
     baseline = None
     if not args.quick and not args.realtime:
@@ -330,12 +417,15 @@ def main() -> None:
     if args.realtime:
         metric = (f"stereo-pairs/sec/chip @{args.width}x{args.height}, "
                   f"realtime config, {args.iters} GRU iters")
-    print(json.dumps({
+    record = {
         "metric": metric,
         "value": round(value, 4),
         "unit": "pairs/sec",
         "vs_baseline": round(value / baseline, 4) if baseline else 0.0,
-    }))
+    }
+    if mfu_stats:
+        record.update(mfu_stats)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
