@@ -170,6 +170,27 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
             f"empty train loader: {len(dataset)} samples < batch_size "
             f"{cfg.batch_size} (check --train_datasets/--dataset_root)")
 
+    # Fail fast if the periodic regression check can't run (reference runs
+    # validate_things every 10k steps, train_stereo.py:184-191; silently
+    # skipping it would let a training run go fully unchecked).  Probing at
+    # startup also means the validation dataset is built exactly once.
+    val_dataset = None
+    if not no_validation:
+        from ..data import datasets as ds
+        try:
+            val_dataset = ds.SceneFlowDatasets(
+                aug_params=None, dstype="frames_finalpass", things_test=True,
+                **({"root": dataset_root} if dataset_root else {}))
+        except Exception as e:
+            raise ValueError(
+                "in-training validation requires the FlyingThings3D TEST "
+                f"split and it could not be loaded ({e}); fix the dataset "
+                "root or pass --no_validation to opt out explicitly") from e
+        if len(val_dataset) == 0:
+            raise ValueError(
+                "in-training validation dataset is empty; fix the dataset "
+                "root or pass --no_validation to opt out explicitly")
+
     step_fn = jit_train_step(
         make_train_step(model, tx, cfg, schedule,
                         photometric_params=photometric_params), mesh)
@@ -185,10 +206,15 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
         try:
             results = validate_things(
                 model, state.variables, iters=cfg.valid_iters,
-                root=dataset_root, max_images=200)
-        except Exception as e:  # dataset absent on this host — not fatal
-            logger.warning("Skipping validation: %s", e)
+                dataset=val_dataset, max_images=200)
+        except Exception as e:
+            # Startup probed the dataset, so this is a genuine runtime
+            # failure — make it loud and countable, not a silent skip.
+            logger.error("Validation FAILED (counted as "
+                         "validation_skipped): %s", e)
+            metrics_logger.push({"validation_skipped": 1.0})
             return
+        metrics_logger.push({"validation_skipped": 0.0})
         logger.info("Validation: %s", results)
         metrics_logger.write_dict(results)
 
