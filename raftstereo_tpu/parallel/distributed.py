@@ -46,9 +46,11 @@ def initialize(coordinator_address: Optional[str] = None,
     environment is a silent no-op so single-host entry points need no guard.
     """
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        _initialized = True
+    if _initialized:
         return
+    # NB: do NOT probe jax.process_count()/jax.devices() here — reading them
+    # initializes the XLA backend, after which distributed bring-up is
+    # permanently "too late" (the round-1 bug that kept this path untested).
     if (coordinator_address is None and num_processes is None
             and process_id is None):
         import os
