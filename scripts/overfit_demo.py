@@ -1,0 +1,101 @@
+"""Convergence demonstration: overfit a tiny synthetic stereo set.
+
+Proves the full training pipeline (augment-free loader -> sequence loss ->
+AdamW + OneCycle -> grad clip -> update) actually LEARNS: on 16 in-memory
+texture-shift pairs with known ground truth (data/synthetic.py::
+ShiftStereoDataset) the EPE must collapse far below its initial value.
+A green test suite shows training *runs*; this shows it *descends*.
+
+    python scripts/overfit_demo.py --steps 300 --out docs/convergence.jsonl
+
+Writes one JSON line per step {step, loss, epe, 1px}; prints a summary.
+The committed curve lives at docs/convergence_r02.jsonl.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run(steps=300, batch=4, hw=(64, 96), lr=4e-4, seed=0, log_every=10,
+        platform=None, out=None):
+    from raftstereo_tpu.utils.platform import apply_env_platform
+    apply_env_platform(platform)
+
+    import jax
+    import numpy as np
+
+    from raftstereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raftstereo_tpu.data.loader import DataLoader
+    from raftstereo_tpu.data.synthetic import ShiftStereoDataset
+    from raftstereo_tpu.models import RAFTStereo
+    from raftstereo_tpu.parallel import make_mesh
+    from raftstereo_tpu.train import (create_train_state, make_optimizer,
+                                      make_train_step)
+    from raftstereo_tpu.train.step import jit_train_step
+
+    mcfg = RAFTStereoConfig(corr_implementation="reg", n_gru_layers=2,
+                            hidden_dims=(64, 64), corr_levels=2,
+                            corr_radius=3)
+    tcfg = TrainConfig(batch_size=batch, train_iters=6, image_size=hw,
+                      num_steps=steps, lr=lr, seed=seed)
+    dataset = ShiftStereoDataset(n=16, hw=hw, seed=seed)
+    loader = DataLoader(dataset, batch, shuffle=True, drop_last=True,
+                        num_workers=0, seed=seed)
+
+    model = RAFTStereo(mcfg)
+    tx, sched = make_optimizer(tcfg)
+    state = create_train_state(model, jax.random.key(seed), tx, hw)
+    mesh = make_mesh(data=1)
+    step_fn = jit_train_step(
+        make_train_step(model, tx, tcfg, lr_schedule=sched), mesh)
+
+    records = []
+    total = 0
+    while total < steps:
+        for batch_data in loader:
+            state, metrics = step_fn(state, tuple(
+                jax.numpy.asarray(x) for x in batch_data))
+            total += 1
+            rec = {"step": total, "loss": float(metrics["loss"]),
+                   "epe": float(metrics["epe"]),
+                   "1px": float(metrics["1px"])}
+            records.append(rec)
+            if total % log_every == 0 or total == 1:
+                print(json.dumps(rec))
+            if total >= steps:
+                break
+
+    if out:
+        with open(out, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+
+    first = np.mean([r["epe"] for r in records[:10]])
+    last = np.mean([r["epe"] for r in records[-10:]])
+    print(f"# EPE first-10 mean {first:.3f} -> last-10 mean {last:.3f} "
+          f"({first / max(last, 1e-9):.1f}x reduction over {total} steps)")
+    return records
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--lr", type=float, default=4e-4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. cpu)")
+    p.add_argument("--out", default=None, help="JSONL output path")
+    a = p.parse_args(argv)
+    run(steps=a.steps, batch=a.batch, lr=a.lr, seed=a.seed,
+        platform=a.platform, out=a.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
