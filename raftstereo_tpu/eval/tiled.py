@@ -38,7 +38,24 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["plan_tiles", "tile_weight", "tiled_infer"]
+__all__ = ["plan_tiles", "tile_weight", "tiled_infer", "seam_gradient"]
+
+
+def seam_gradient(pred: np.ndarray, gt: np.ndarray) -> float:
+    """Seam-quality metric: the largest one-pixel jump of the ERROR field.
+
+    ``max |∇(pred - gt)|`` over both axes.  Subtracting the ground truth
+    removes the scene's own gradients, so what remains is stitching
+    artifacts: a hard (unfeathered) tile boundary with per-tile bias ``b``
+    shows a jump of ~``b`` at the seam, while a correct ``overlap``-pixel
+    feather bounds the jump by ~``b / overlap``.  Guarded by
+    tests/test_tiled.py::test_seam_gradient_bounded so feathering
+    regressions are caught quantitatively.
+    """
+    err = np.asarray(pred, np.float64) - np.asarray(gt, np.float64)
+    jumps = [np.abs(np.diff(err, axis=0)).max() if err.shape[0] > 1 else 0.0,
+             np.abs(np.diff(err, axis=1)).max() if err.shape[1] > 1 else 0.0]
+    return float(max(jumps))
 
 
 def plan_tiles(size: int, tile: int, stride: int) -> List[int]:
