@@ -39,7 +39,7 @@ def resolve_corr(corr: str) -> str:
     return corr
 
 
-def measure_matmul_peak_tflops(reps: int = 400, n: int = 4096) -> float:
+def measure_matmul_peak_tflops(reps: int = 2000, n: int = 4096) -> float:
     """The chip's *achievable* bf16 matmul ceiling, measured on the spot.
 
     MFU against this number answers "how close is the model to what this
@@ -59,26 +59,41 @@ def measure_matmul_peak_tflops(reps: int = 400, n: int = 4096) -> float:
     def run(n_reps):
         def body(i, carry):
             acc, bb = carry
-            bb = bb + i.astype(bb.dtype) * 0  # defeat loop-invariant hoisting
             c = jax.lax.dot(a, bb, precision=None,
                             preferred_element_type=jnp.float32)
-            return acc + c[0, 0], bb
+            # Consume EVERY element of c and feed it back into next bb:
+            # anything less and XLA legally deletes the FLOPs — `acc +
+            # c[0,0]` alone reduces the "matmul" to one row-dot via
+            # dot-slice fusion, and `i * 0` / `0.0 * acc` perturbations get
+            # constant-folded, collapsing the loop entirely (both bugs made
+            # earlier "peak" numbers pure dispatch noise).  The feedback
+            # scalar is runtime data far below bf16 resolution, so bb's
+            # value never changes.
+            s = c.sum()
+            acc = acc + s
+            bb = bb + (s * 1e-38).astype(bb.dtype)
+            return acc, bb
         acc, _ = jax.lax.fori_loop(0, n_reps, body, (jnp.float32(0), b))
         return acc
 
-    null = jax.jit(lambda x: x + 1.0)
-    float(null(jnp.float32(0)))  # compile
-    t0 = time.perf_counter()
-    for _ in range(3):
-        float(null(jnp.float32(0)))
-    dispatch = (time.perf_counter() - t0) / 3
-
     fn = jax.jit(run, static_argnums=(0,))
-    float(fn(reps))  # compile + warm
-    t0 = time.perf_counter()
-    float(fn(reps))
-    dt = max(time.perf_counter() - t0 - dispatch, 1e-9)
-    return 2 * n * n * n * reps / dt / 1e12
+    lo = max(reps // 5, 1)
+    float(fn(lo)), float(fn(reps))  # compile both trip counts + warm
+
+    def timed(k):
+        t0 = time.perf_counter()
+        float(fn(k))
+        return time.perf_counter() - t0
+
+    # Two-point difference with medians: rate from the DELTA between rep
+    # counts, so the per-dispatch fixed latency (tunnel round trip, can be
+    # seconds under host load) cancels; median-of-3 at each point defends
+    # against its run-to-run variance, and the large rep count keeps the
+    # device-time delta well above that variance.
+    t_lo = sorted(timed(lo) for _ in range(3))[1]
+    t_hi = sorted(timed(reps) for _ in range(3))[1]
+    dt = max(t_hi - t_lo, 1e-9)
+    return 2 * n * n * n * (reps - lo) / dt / 1e12
 
 
 def analyze_forward_flops(model, variables, img1, img2, iters) -> float:
