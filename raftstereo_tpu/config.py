@@ -28,7 +28,9 @@ class RAFTStereoConfig:
     # lookup), "alt" (on-demand, O(H*W) memory), "pallas" (precomputed pyramid +
     # Pallas TPU lookup kernel — the reg_cuda analogue; reference: core/corr.py),
     # "pallas_alt" (on-demand Pallas kernel, O(H*W) memory — working form of
-    # the reference's dead alt_cuda backend, core/corr.py:159-188).
+    # the reference's dead alt_cuda backend, core/corr.py:159-188), "auto"
+    # (the fastest backend for the active platform: pallas_alt on TPU — also
+    # O(H*W) memory — reg elsewhere; resolved at trace time, ops/corr.py).
     corr_implementation: str = "reg"
     corr_levels: int = 4
     corr_radius: int = 4
@@ -61,7 +63,8 @@ class RAFTStereoConfig:
     def __post_init__(self):
         if isinstance(self.hidden_dims, list):
             object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
-        assert self.corr_implementation in ("reg", "alt", "pallas", "pallas_alt"), self.corr_implementation
+        assert self.corr_implementation in (
+            "auto", "reg", "alt", "pallas", "pallas_alt"), self.corr_implementation
         assert 1 <= self.n_gru_layers <= 3, self.n_gru_layers
         assert len(self.hidden_dims) >= self.n_gru_layers
 
@@ -142,8 +145,10 @@ class TrainConfig:
 def add_model_args(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("model")
     g.add_argument("--corr_implementation",
-                   choices=["reg", "alt", "pallas", "pallas_alt"],
-                   default="reg")
+                   choices=["auto", "reg", "alt", "pallas", "pallas_alt"],
+                   default="reg",
+                   help="correlation backend; 'auto' = fastest for the "
+                        "active platform (pallas_alt on TPU, reg elsewhere)")
     g.add_argument("--corr_levels", type=int, default=4)
     g.add_argument("--corr_radius", type=int, default=4)
     g.add_argument("--n_downsample", type=int, default=2)

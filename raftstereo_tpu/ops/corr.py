@@ -300,7 +300,15 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
 
 def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
                  num_levels: int, radius: int, dtype=jnp.float32) -> CorrFn:
-    """Backend dispatch (reference: core/raft_stereo.py:90-100)."""
+    """Backend dispatch (reference: core/raft_stereo.py:90-100).
+
+    ``auto`` resolves to the fastest backend for the active platform: the
+    on-demand Pallas kernel on TPU (fastest measured AND O(H*W) memory),
+    the XLA gather path elsewhere (the Pallas kernels are TPU-tuned; their
+    interpret mode is for correctness tests, not speed)."""
+    if implementation == "auto":
+        implementation = ("pallas_alt" if jax.default_backend() == "tpu"
+                          else "reg")
     if implementation == "reg":
         return make_reg_corr_fn(fmap1, fmap2, num_levels, radius, dtype=jnp.float32)
     if implementation == "alt":
