@@ -235,3 +235,22 @@ class TestFusedGRUConv:
                                       np.concatenate([kz, kr], axis=-1))
         np.testing.assert_array_equal(np.asarray(g["convzr"]["bias"]),
                                       np.concatenate([np.zeros(4), np.ones(4)]))
+
+    def test_load_weights_migrates_prefusion_tree(self, tmp_path):
+        """A weights dir saved with pre-fusion convz/convr loads through the
+        templateless load_weights path and comes back fused."""
+        from raftstereo_tpu.train.checkpoint import load_weights, save_weights
+
+        kz = np.ones((3, 3, 4, 2), np.float32)
+        kr = np.full((3, 3, 4, 2), 2.0, np.float32)
+        old = {"params": {"update": {"gru0": {
+            "convz": {"kernel": kz, "bias": np.zeros(2, np.float32)},
+            "convr": {"kernel": kr, "bias": np.ones(2, np.float32)},
+            "convq": {"kernel": kr, "bias": np.ones(2, np.float32)},
+        }}}}
+        save_weights(str(tmp_path / "w"), old)
+        out = load_weights(str(tmp_path / "w"))
+        g = out["params"]["update"]["gru0"]
+        assert set(g) == {"convzr", "convq"}
+        np.testing.assert_array_equal(np.asarray(g["convzr"]["kernel"]),
+                                      np.concatenate([kz, kr], axis=-1))
