@@ -22,7 +22,7 @@ sys.path.insert(0, REPO)
 
 
 def run(steps=300, batch=4, hw=(64, 96), lr=4e-4, seed=0, log_every=10,
-        platform=None, out=None):
+        platform=None, out=None, train_iters=6):
     from raftstereo_tpu.utils.platform import apply_env_platform
     apply_env_platform(platform)
 
@@ -41,8 +41,8 @@ def run(steps=300, batch=4, hw=(64, 96), lr=4e-4, seed=0, log_every=10,
     mcfg = RAFTStereoConfig(corr_implementation="reg", n_gru_layers=2,
                             hidden_dims=(64, 64), corr_levels=2,
                             corr_radius=3)
-    tcfg = TrainConfig(batch_size=batch, train_iters=6, image_size=hw,
-                      num_steps=steps, lr=lr, seed=seed)
+    tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
+                      image_size=hw, num_steps=steps, lr=lr, seed=seed)
     dataset = ShiftStereoDataset(n=16, hw=hw, seed=seed)
     loader = DataLoader(dataset, batch, shuffle=True, drop_last=True,
                         num_workers=0, seed=seed)
