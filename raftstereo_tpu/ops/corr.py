@@ -32,6 +32,8 @@ concatenation (core/corr.py:133-146).
 
 from __future__ import annotations
 
+import functools
+import warnings
 from typing import Callable, List
 
 import jax
@@ -154,6 +156,15 @@ def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
     return corr_fn
 
 
+@functools.lru_cache(maxsize=None)
+def _warn_corr_unshardable(reason: str) -> None:
+    """Trace-time warning, once per distinct shape/mesh mismatch."""
+    warnings.warn(
+        f"corr mesh is active but the Pallas corr backend cannot partition "
+        f"over it ({reason}); falling back to replicated lowering",
+        RuntimeWarning, stacklevel=4)
+
+
 def _corr_shard_mesh(b: int, h: int):
     """The active (data, space) mesh if the Pallas backends can partition
     over it: B divisible by data, H (at corr resolution) by space.
@@ -174,7 +185,19 @@ def _corr_shard_mesh(b: int, h: int):
         return None
     d = mesh.shape.get(DATA_AXIS, 1)
     s = mesh.shape.get(SPACE_AXIS, 1)
-    if d * s == 1 or b % d or h % s:
+    if d * s == 1:
+        return None
+    if b % d or h % s:
+        # Loud, not silent: on a real mesh a user with e.g. batch 12 on
+        # data=8 would otherwise lose corr partitioning with no signal.
+        reasons = []
+        if b % d:
+            reasons.append(f"batch {b} not divisible by '{DATA_AXIS}' "
+                           f"mesh axis {d}")
+        if h % s:
+            reasons.append(f"corr-height {h} not divisible by "
+                           f"'{SPACE_AXIS}' mesh axis {s}")
+        _warn_corr_unshardable("; ".join(reasons))
         return None
     # Flat (B*H, ...) arrays shard over BOTH axes at once; each device's
     # rows are exactly the ones its (b-block, h-block) produced, because

@@ -17,6 +17,31 @@ import orbax.checkpoint as ocp
 from .state import TrainState
 
 
+def _tree_has_exact_key(tree, key: str) -> bool:
+    """True if any dict node in ``tree`` has a child named exactly ``key``
+    (NOT substring — SepConvGRU's convz1/convr1 must not match 'convz')."""
+    if not isinstance(tree, dict):
+        return False
+    return any(k == key or _tree_has_exact_key(v, key)
+               for k, v in tree.items())
+
+
+def _metadata_tree(md):
+    """The nested-dict structure out of an orbax metadata object
+    (StepMetadata wraps TreeMetadata in .item_metadata; TreeMetadata holds
+    the dict in .tree)."""
+    item = getattr(md, "item_metadata", md)
+    tree = getattr(item, "tree", item)
+    return tree if isinstance(tree, dict) else {}
+
+
+_PREFUSION_MSG = (
+    "checkpoint predates the fused GRU gate conv (convz/convr -> convzr, "
+    "round 2): re-export it through the .pth converter or load weights-only "
+    "via utils.convert.migrate_prefusion_variables; full train states (Adam "
+    "moments) cannot be migrated mechanically")
+
+
 class CheckpointManager:
     """Step-indexed checkpoints under ``directory`` with max_to_keep."""
 
@@ -46,13 +71,19 @@ class CheckpointManager:
         try:
             return self._mngr.restore(step, args=ocp.args.StandardRestore(tgt))
         except Exception as e:
-            if "convz" in str(e) or "convr" in str(e):
-                raise ValueError(
-                    "checkpoint predates the fused GRU gate conv (convz/"
-                    "convr -> convzr, round 2): re-export it through the "
-                    ".pth converter or retrain; full train states (Adam "
-                    "moments) cannot be migrated mechanically") from e
+            # Classify by the SAVED tree's structure, not the exception text
+            # (error strings need not name the keys, and substring matching
+            # would also catch SepConvGRU's convz1/convr1).
+            if self._saved_has_prefusion_gates(step):
+                raise ValueError(_PREFUSION_MSG) from e
             raise
+
+    def _saved_has_prefusion_gates(self, step: int) -> bool:
+        try:
+            md = self._mngr.item_metadata(step)
+        except Exception:
+            return False
+        return _tree_has_exact_key(_metadata_tree(md), "convz")
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
@@ -94,12 +125,12 @@ def load_weights(path: str, variables_like: Optional[Dict] = None) -> Dict:
         try:
             out = ckptr.restore(path, tgt)
         except Exception as e:
-            if "convz" in str(e) or "convr" in str(e):
-                raise ValueError(
-                    "weights predate the fused GRU gate conv (convz/convr "
-                    "-> convzr, round 2); load them with "
-                    "utils.convert.migrate_prefusion_variables or "
-                    "re-export") from e
+            try:
+                saved = _metadata_tree(ckptr.metadata(path))
+            except Exception:
+                saved = {}
+            if _tree_has_exact_key(saved, "convz"):
+                raise ValueError(_PREFUSION_MSG) from e
             raise
     ckptr.close()
     return out

@@ -30,6 +30,7 @@ contractive and track far tighter; random init is the adversarial case.
 import argparse
 import json
 import os
+import shutil
 import subprocess
 import sys
 
@@ -106,9 +107,16 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     ws = os.path.abspath(args.workspace)
-    if not os.path.isdir(os.path.join(ws, "datasets")):
+    # The marker is written only after build_workspace completes, so a tree
+    # left half-built by an interrupted run is rebuilt instead of silently
+    # reused (which used to surface as confusing downstream codec errors).
+    marker = os.path.join(ws, "datasets", ".complete")
+    if not os.path.isfile(marker):
+        shutil.rmtree(os.path.join(ws, "datasets"), ignore_errors=True)
         os.makedirs(ws, exist_ok=True)
         build_workspace(ws)
+        with open(marker, "w") as f:
+            f.write("workspace build completed\n")
         print(f"built synthetic trees under {ws}/datasets")
 
     ckpt = os.path.join(ws, "ref_random_init.pth")

@@ -254,3 +254,39 @@ class TestFusedGRUConv:
         assert set(g) == {"convzr", "convq"}
         np.testing.assert_array_equal(np.asarray(g["convzr"]["kernel"]),
                                       np.concatenate([kz, kr], axis=-1))
+
+    def test_load_weights_prefusion_hint_from_saved_structure(self, tmp_path):
+        """Templated restore of a pre-fusion tree raises the migration hint —
+        classified from the SAVED tree's structure (exact 'convz' node), not
+        from exception text."""
+        import pytest
+
+        from raftstereo_tpu.train.checkpoint import load_weights, save_weights
+
+        old = {"params": {"update": {"gru0": {
+            "convz": {"kernel": np.ones((3, 3, 4, 2), np.float32)},
+        }}}}
+        save_weights(str(tmp_path / "w"), old)
+        like = {"params": {"update": {"gru0": {
+            "convzr": {"kernel": np.ones((3, 3, 4, 4), np.float32)},
+        }}}}
+        with pytest.raises(ValueError, match="fused GRU gate conv"):
+            load_weights(str(tmp_path / "w"), like)
+
+    def test_load_weights_unrelated_mismatch_not_mislabeled(self, tmp_path):
+        """A structure mismatch whose keys merely CONTAIN 'convz' (SepConvGRU's
+        convz1) must surface the real error, not the pre-fusion hint."""
+        import pytest
+
+        from raftstereo_tpu.train.checkpoint import load_weights, save_weights
+
+        old = {"params": {"update": {"gru0": {
+            "convz1": {"kernel": np.ones((1, 5, 4, 2), np.float32)},
+        }}}}
+        save_weights(str(tmp_path / "w"), old)
+        like = {"params": {"update": {"gru0": {
+            "somethingelse": {"kernel": np.ones((1, 5, 4, 2), np.float32)},
+        }}}}
+        with pytest.raises(Exception) as ei:
+            load_weights(str(tmp_path / "w"), like)
+        assert "fused GRU gate conv" not in str(ei.value)

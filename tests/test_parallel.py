@@ -110,10 +110,11 @@ class TestShardedPallasCorr:
                                        rtol=1e-5, atol=1e-5)
 
     def test_indivisible_shapes_fall_back(self, rng):
-        """B=3 over data=4 cannot partition -> plain lowering, same result."""
+        """B=3 over data=4 cannot partition -> plain lowering, same result,
+        and a LOUD trace-time warning naming the indivisible axis."""
         import jax.numpy as jnp
 
-        from raftstereo_tpu.ops.corr import make_corr_fn
+        from raftstereo_tpu.ops.corr import _warn_corr_unshardable, make_corr_fn
         from raftstereo_tpu.parallel.context import use_corr_mesh
 
         b, h, w, c = 3, 6, 24, 8
@@ -121,8 +122,11 @@ class TestShardedPallasCorr:
         f2 = jnp.asarray(rng.standard_normal((b, h, w, c)), jnp.float32)
         coords = jnp.asarray(rng.uniform(0, w, (b, h, w, 1)), jnp.float32)
         ref = make_corr_fn("pallas_alt", f1, f2, 2, 3)(coords)
+        _warn_corr_unshardable.cache_clear()  # once-per-shape memo
         with use_corr_mesh(make_mesh(data=4)):
-            got = make_corr_fn("pallas_alt", f1, f2, 2, 3)(coords)
+            with pytest.warns(RuntimeWarning,
+                              match="batch 3 not divisible by 'data'"):
+                got = make_corr_fn("pallas_alt", f1, f2, 2, 3)(coords)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-6, atol=1e-6)
 
