@@ -96,29 +96,89 @@ def measure_matmul_peak_tflops(reps: int = 2000, n: int = 4096) -> float:
     return 2 * n * n * n * (reps - lo) / dt / 1e12
 
 
+def _cost_model_flops(compiled) -> float:
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0]
+    return float(ca.get("flops", 0.0))
+
+
+def pallas_corr_flops_per_iter(model, batch: int, height: int,
+                               width: int) -> float:
+    """Analytic per-iteration FLOPs of the Pallas correlation kernels —
+    custom calls are invisible to XLA's cost model, so without this the
+    default TPU path's corr work would be missing from MFU.
+
+    Counts the on-demand matmul (pallas_alt: rows x W1p x W2cat x C x 2) and
+    the hat-weight tap reduction (~4 flops per swept element: subtract, hat,
+    multiply, accumulate) using the kernels' real padded shapes."""
+    from raftstereo_tpu.ops.pallas_corr import (LANE, _BLOCK_ROWS, _block_w1)
+
+    cfg = model.config
+    impl = cfg.corr_implementation
+    if impl == "auto":
+        impl = resolve_corr(impl)
+    if impl not in ("pallas", "pallas_alt"):
+        return 0.0
+
+    def rup(x, m):
+        return -(-x // m) * m
+
+    # Ceil division matches the encoders' ceil-halving per stride (and thus
+    # both callers: bench_jax pre-pads to a 32-multiple, where this is
+    # exact division; the train path feeds raw crops like the reference's
+    # 320x720, where rounding the IMAGE up to 32 first would overcount).
+    f = cfg.factor
+    h0 = -(-height // f)
+    w0 = -(-width // f)
+    n = rup(batch * h0, _BLOCK_ROWS)
+    w1p = rup(w0, _block_w1(w0))
+    widths = [w0]
+    for _ in range(cfg.corr_levels - 1):
+        widths.append(widths[-1] // 2)
+    padded = [rup(w, LANE) for w in widths]
+    w2cat = sum(padded)
+    k = 2 * cfg.corr_radius + 1
+    hat = 4.0 * n * w1p * k * sum(padded)
+    if impl == "pallas_alt":
+        c = 256  # fnet feature channels
+        return 2.0 * n * w1p * w2cat * c + hat
+    return hat  # pallas: volume matmul is XLA-side (cost model sees it)
+
+
 def analyze_forward_flops(model, variables, img1, img2, iters) -> float:
-    """Analytic FLOPs for ONE forward execution (the whole batch), from
-    XLA's cost model on the compiled flagship computation.  Returns 0.0 if
-    the backend does not expose a cost analysis."""
+    """True FLOPs for ONE forward execution (the whole batch).
+
+    XLA's cost model counts a rolled scan/while body ONCE regardless of trip
+    count (verified: a scanned matmul reports identical flops for length
+    1/4/16 — this undercounted round-2 MFU by ~5x), so the per-iteration
+    body cost is measured from the DIFFERENCE of two fully-unrolled
+    compilations (1 vs 2 iterations) and scaled to ``iters``; Pallas corr
+    kernel flops (custom calls, also invisible) are added analytically.
+    Returns 0.0 if the backend exposes no cost analysis."""
     import jax
 
-    fwd = jax.jit(lambda v, a, b: model.forward(v, a, b, iters=iters,
-                                                test_mode=True))
+    def flops_at(n):
+        fwd = jax.jit(lambda v, a, b: model.forward(
+            v, a, b, iters=n, test_mode=True, unroll=n))
+        return _cost_model_flops(fwd.lower(variables, img1, img2).compile())
+
     try:
-        compiled = fwd.lower(variables, img1, img2).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
-            ca = ca[0]
-        return float(ca.get("flops", 0.0))
+        f1, f2 = flops_at(1), flops_at(2)
     except Exception as e:
         print(f"cost analysis unavailable: {e}", file=sys.stderr)
         return 0.0
+    body = f2 - f1
+    fixed = max(f1 - body, 0.0)
+    body += pallas_corr_flops_per_iter(model, img1.shape[0], img1.shape[1],
+                                       img1.shape[2])
+    return fixed + iters * body
 
 
 def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
               reps: int, compute_dtype: str,
-              corr_dtype: str = "float32", realtime: bool = False,
-              mfu: bool = False):
+              corr_dtype: str = "float32", corr_precision: str = "highest",
+              realtime: bool = False, mfu: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -136,7 +196,8 @@ def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
                         hidden_dims=(128, 128), slow_fast_gru=True)
     cfg = RAFTStereoConfig(corr_implementation=corr,
                            compute_dtype=compute_dtype,
-                           corr_dtype=corr_dtype, **model_kw)
+                           corr_dtype=corr_dtype,
+                           corr_precision=corr_precision, **model_kw)
     model = RAFTStereo(cfg)
     variables = model.init(jax.random.key(0), (64, 96))
 
@@ -192,9 +253,61 @@ def bench_jax(height: int, width: int, batch: int, iters: int, corr: str,
     return pairs_per_sec, extras
 
 
+def analyze_train_flops(model, tx, tcfg, state, batch_data, iters) -> float:
+    """True FLOPs for ONE training step (fwd + loss + bwd + update), by the
+    same unrolled two-point method as analyze_forward_flops (the rolled scan
+    body is counted once by the cost model; with remat the unrolled HLO also
+    contains the recompute, so rematerialisation cost is included).  The
+    Pallas corr kernels are invisible custom calls; per iteration they
+    execute the forward lookup (twice under remat) plus a backward whose two
+    feature-gradient matmuls cost ~2x the forward matmul."""
+    import jax
+    import optax
+
+    from raftstereo_tpu.train.loss import sequence_loss
+
+    def make_step(n):
+        def loss_fn(params, img1, img2, disp_gt, valid):
+            variables = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            preds = model.forward(variables, img1, img2, iters=n, unroll=n)
+            return sequence_loss(preds, disp_gt, valid,
+                                 loss_gamma=tcfg.loss_gamma,
+                                 max_flow=tcfg.max_flow)
+
+        def step(st, batch):
+            img1, img2, disp_gt, valid = batch
+            (loss, m), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                st.params, img1, img2, disp_gt, valid)
+            updates, opt_state = tx.update(grads, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            return params, opt_state, loss
+
+        return step
+
+    def flops_at(n):
+        compiled = jax.jit(make_step(n)).lower(state, batch_data).compile()
+        return _cost_model_flops(compiled)
+
+    try:
+        f1, f2 = flops_at(1), flops_at(2)
+    except Exception as e:
+        print(f"cost analysis unavailable: {e}", file=sys.stderr)
+        return 0.0
+    body = f2 - f1
+    fixed = max(f1 - body, 0.0)
+    img1 = batch_data[0]
+    corr_fwd = pallas_corr_flops_per_iter(model, img1.shape[0], img1.shape[1],
+                                          img1.shape[2])
+    corr_mult = (2.0 if model.config.remat else 1.0) + 2.0
+    return fixed + iters * (body + corr_mult * corr_fwd)
+
+
 def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
                 reps: int, compute_dtype: str,
-                corr_dtype: str = "float32") -> float:
+                corr_dtype: str = "float32", corr_precision: str = "highest",
+                mfu: bool = False):
     """Training throughput: full fwd+loss+bwd+clip+update steps/sec, the
     whole repeat loop compiled on-device (same dispatch rationale as
     bench_jax).  The reference recipe trains on 320x720 crops
@@ -214,7 +327,8 @@ def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
     # activations without it — far past one chip's HBM.
     cfg = RAFTStereoConfig(corr_implementation=corr,
                            compute_dtype=compute_dtype,
-                           corr_dtype=corr_dtype, remat=True)
+                           corr_dtype=corr_dtype,
+                           corr_precision=corr_precision, remat=True)
     tcfg = TrainConfig(batch_size=batch, train_iters=iters,
                        image_size=(height, width))
     model = RAFTStereo(cfg)
@@ -239,6 +353,10 @@ def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
             return s
         return jax.lax.fori_loop(0, n, body, st)
 
+    # FLOP accounting first: the timed loop donates the state's buffers.
+    flops_step = (analyze_train_flops(model, tx, tcfg, state, batch_data,
+                                      iters) if mfu else 0.0)
+
     fn = jax.jit(run_reps, static_argnums=(2,), donate_argnums=(0,))
     state = fn(state, batch_data, reps)
     jax.block_until_ready(state.params)
@@ -247,7 +365,22 @@ def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
     state = fn(state, batch_data, reps)
     _ = float(jax.tree.leaves(state.params)[0].sum())
     dt = time.perf_counter() - t0
-    return reps / dt
+    steps_per_sec = reps / dt
+    if not mfu:
+        return steps_per_sec, None
+    model_tflops = flops_step * steps_per_sec / 1e12
+    extras = {
+        "flops_per_step": flops_step,
+        "model_tflops": round(model_tflops, 3),
+        "measured_peak_tflops": None,
+        "mfu_vs_measured_peak": None,
+    }
+    if jax.default_backend() == "tpu":
+        peak = measure_matmul_peak_tflops()
+        extras["measured_peak_tflops"] = round(peak, 2)
+        extras["mfu_vs_measured_peak"] = (round(model_tflops / peak, 4)
+                                          if peak else 0.0)
+    return steps_per_sec, extras
 
 
 def bench_data(batch: int, num_workers: int) -> float:
@@ -341,6 +474,9 @@ def main() -> None:
                         "pallas and pallas_alt backends (the CUDA kernel's "
                         "fp16 dispatch equivalent); reg/alt pin fp32, "
                         "mirroring the reference's fp32-volume torch paths")
+    p.add_argument("--corr_precision", default="highest",
+                   choices=["highest", "high", "default"],
+                   help="MXU multiply precision for fp32 correlation matmuls")
     p.add_argument("--quick", action="store_true",
                    help="tiny shapes / few reps (CPU development)")
     p.add_argument("--mfu", action=argparse.BooleanOptionalAction,
@@ -395,22 +531,28 @@ def main() -> None:
         if args.measure_baseline:
             p.error("--train does not support --measure-baseline (the torch "
                     "baseline covers the inference path only)")
-        value = bench_train(args.height, args.width, args.batch, args.iters,
-                            args.corr, args.reps, args.compute_dtype,
-                            args.corr_dtype)
-        print(json.dumps({
+        mfu = (not args.quick) if args.mfu is None else args.mfu
+        value, mfu_stats = bench_train(args.height, args.width, args.batch,
+                                       args.iters, args.corr, args.reps,
+                                       args.compute_dtype, args.corr_dtype,
+                                       args.corr_precision, mfu=mfu)
+        record = {
             "metric": f"train-steps/sec/chip @{args.width}x{args.height}, "
                       f"batch {args.batch}, {args.iters} GRU iters",
             "value": round(value, 4),
             "unit": "steps/sec",
             "vs_baseline": 0.0,
-        }))
+        }
+        if mfu_stats:
+            record.update(mfu_stats)
+        print(json.dumps(record))
         return
 
     mfu = (not args.quick) if args.mfu is None else args.mfu
     value, mfu_stats = bench_jax(args.height, args.width, args.batch,
                                  args.iters, args.corr, args.reps,
                                  args.compute_dtype, args.corr_dtype,
+                                 args.corr_precision,
                                  realtime=args.realtime, mfu=mfu)
 
     baseline = None

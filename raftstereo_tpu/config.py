@@ -52,6 +52,11 @@ class RAFTStereoConfig:
     # accuracy is precision-sensitive (reference: evaluate_stereo.py:227-230).
     compute_dtype: str = "float32"
     corr_dtype: str = "float32"
+    # MXU multiply precision for the fp32 correlation matmuls: "highest"
+    # (6-pass bf16 emulation, exact fp32), "high" (3-pass, ~fp32-accurate at
+    # half the MXU cost), "default" (single bf16 pass).  Only consulted when
+    # the inputs are fp32 — bf16 corr_dtype always takes the native path.
+    corr_precision: str = "highest"
 
     # Rematerialize each GRU iteration in the backward pass (jax.checkpoint
     # on the scan body): activation memory drops from O(iters) to O(1) at the
@@ -65,6 +70,8 @@ class RAFTStereoConfig:
             object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
         assert self.corr_implementation in (
             "auto", "reg", "alt", "pallas", "pallas_alt"), self.corr_implementation
+        assert self.corr_precision in (
+            "highest", "high", "default"), self.corr_precision
         assert 1 <= self.n_gru_layers <= 3, self.n_gru_layers
         assert len(self.hidden_dims) >= self.n_gru_layers
 
@@ -161,6 +168,10 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--mixed_precision", action="store_true",
                    help="bfloat16 compute for encoders and GRUs")
     g.add_argument("--corr_dtype", choices=["float32", "bfloat16"], default="float32")
+    g.add_argument("--corr_precision", choices=["highest", "high", "default"],
+                   default="highest",
+                   help="MXU multiply precision for fp32 correlation matmuls "
+                        "(highest=exact 6-pass, high=3-pass, default=1-pass)")
     g.add_argument("--remat", action="store_true",
                    help="rematerialize each GRU iteration in backward: "
                         "O(1) activation memory instead of O(iters); "
@@ -180,5 +191,6 @@ def model_config_from_args(args: argparse.Namespace) -> RAFTStereoConfig:
         context_norm=args.context_norm,
         compute_dtype="bfloat16" if args.mixed_precision else "float32",
         corr_dtype=args.corr_dtype,
+        corr_precision=args.corr_precision,
         remat=args.remat,
     )

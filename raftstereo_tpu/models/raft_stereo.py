@@ -166,7 +166,7 @@ class RAFTStereo:
 
     def forward(self, variables: Dict, image1: jax.Array, image2: jax.Array,
                 iters: int = 12, flow_init: Optional[jax.Array] = None,
-                test_mode: bool = False):
+                test_mode: bool = False, unroll: int = 1):
         cfg = self.config
         dtype = self.dtype
         b = image1.shape[0]
@@ -196,7 +196,9 @@ class RAFTStereo:
                       else jnp.float32)
         corr_fn = make_corr_fn(cfg.corr_implementation, fmap1, fmap2,
                                cfg.corr_levels, cfg.corr_radius,
-                               dtype=corr_dtype)
+                               dtype=corr_dtype,
+                               precision=cfg.corr_precision,
+                               out_dtype=dtype)
 
         h0, w0 = net_list[0].shape[1:3]
         grid = coords_grid_x(b, h0, w0)
@@ -207,12 +209,11 @@ class RAFTStereo:
         update_vars = self._split_vars(variables, "update")
         sf = cfg.slow_fast_gru
         n = cfg.n_gru_layers
-        mask0 = jnp.zeros((b, h0, w0, 9 * cfg.factor * cfg.factor), jnp.float32)
 
         def step(carry, _):
-            nets, d, _ = carry
+            nets, d = carry
             d = jax.lax.stop_gradient(d)
-            corr = corr_fn(grid + d).astype(dtype)
+            corr = corr_fn(grid + d)  # already emitted in model dtype
             flow = jnp.concatenate([d, jnp.zeros_like(d)], axis=-1).astype(dtype)
 
             if n == 3 and sf:
@@ -223,24 +224,36 @@ class RAFTStereo:
                 nets = self.update.apply(update_vars, nets, zqr_list,
                                          iter2=(n == 3), iter1=True,
                                          iter0=False, update=False)
+            # Test mode skips the mask head inside the loop: only the final
+            # mask is consumed and it depends only on net[0], so it is
+            # computed ONCE after the scan (measured ~0.18 ms/iter saved at
+            # flagship shapes: the 128->256 conv, the 1x1 head, the f32
+            # cast, and the carry's HBM round trip).
             nets, mask, delta = self.update.apply(
                 update_vars, nets, zqr_list, corr, flow,
-                iter2=(n == 3), iter1=(n >= 2))
+                iter2=(n == 3), iter1=(n >= 2), with_mask=not test_mode)
 
             d = d + delta[..., :1].astype(jnp.float32)
-            mask = mask.astype(jnp.float32)
             if test_mode:
-                # Only the final mask is needed; carry it instead of stacking
-                # O(iters) masks in the scan outputs.
-                return (tuple(nets), d, mask), None
-            up = convex_upsample(d, mask, cfg.factor)
-            return (tuple(nets), d, mask), up
+                return (tuple(nets), d), None
+            up = convex_upsample(d, mask.astype(jnp.float32), cfg.factor)
+            return (tuple(nets), d), up
 
         body = jax.checkpoint(step) if cfg.remat else step
-        (nets, disp, last_mask), ys = jax.lax.scan(
-            body, (tuple(net_list), disp, mask0), None, length=iters)
+        # ``unroll`` feeds lax.scan's unroll factor.  Perf-neutral by default
+        # (1); bench.py's FLOP accounting compiles fully-unrolled variants
+        # because XLA's cost model counts a rolled loop body ONCE regardless
+        # of trip count (verified: scan of a matmul reports identical flops
+        # for length 1/4/16), so per-iteration flops are only observable
+        # unrolled.
+        (nets, disp), ys = jax.lax.scan(
+            body, (tuple(net_list), disp), None, length=iters,
+            unroll=unroll)
         if test_mode:
-            disp_up = convex_upsample(disp, last_mask, cfg.factor)
+            mask = self.update.apply(update_vars, nets[0],
+                                     method="upsample_mask")
+            disp_up = convex_upsample(disp, mask.astype(jnp.float32),
+                                      cfg.factor)
             return disp, disp_up
         return ys  # (iters, B, H*f, W*f, 1)
 

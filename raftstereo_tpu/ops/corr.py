@@ -44,21 +44,31 @@ from .sampler import linear_sample_1d
 CorrFn = Callable[[jax.Array], jax.Array]
 
 
+_PRECISIONS = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
+
+
 def build_corr_volume(fmap1: jax.Array, fmap2: jax.Array,
-                      dtype=jnp.float32) -> jax.Array:
+                      dtype=jnp.float32, precision: str = "highest") -> jax.Array:
     """(B, H, W1, C) x (B, H, W2, C) -> (B, H, W1, W2), scaled by 1/sqrt(C).
 
     One einsum = a batched matmul over B*H rows, which XLA tiles directly onto
     the MXU (reference equivalent: core/corr.py:148-156).
     """
     c = fmap1.shape[-1]
-    # Full fp32 multiply precision: sub-pixel disparity refinement reads tiny
-    # differences between neighbouring correlation values, so the MXU's default
-    # bf16-multiply path is not acceptable here (the reference likewise pins
-    # the volume to fp32: core/raft_stereo.py:92).
+    # fp32-accurate multiply precision: sub-pixel disparity refinement reads
+    # tiny differences between neighbouring correlation values, so the MXU's
+    # single-pass bf16 path is not the right default (the reference likewise
+    # pins the volume to fp32: core/raft_stereo.py:92).  "highest" is exact
+    # 6-pass emulation and stays the default: the cheaper forms measured NO
+    # speedup on the flagship path (docs/perf_notes_r03.md), so there is
+    # nothing to trade accuracy for.
     corr = jnp.einsum("bhwc,bhvc->bhwv", fmap1, fmap2,
                       preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST)
+                      precision=_PRECISIONS[precision])
     return (corr / jnp.sqrt(jnp.float32(c))).astype(dtype)
 
 
@@ -80,10 +90,12 @@ def _tap_offsets(radius: int) -> jax.Array:
 
 
 def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
-                     radius: int, dtype=jnp.float32) -> CorrFn:
+                     radius: int, dtype=jnp.float32,
+                     precision: str = "highest") -> CorrFn:
     """Precomputed-volume backend (reference: CorrBlock1D, core/corr.py:110-156)."""
     volume = build_corr_volume(fmap1.astype(jnp.float32),
-                               fmap2.astype(jnp.float32), dtype=dtype)
+                               fmap2.astype(jnp.float32), dtype=dtype,
+                               precision=precision)
     pyramid = build_corr_pyramid(volume, num_levels)
     offsets = _tap_offsets(radius)
 
@@ -116,7 +128,7 @@ def build_fmap2_pyramid(fmap2: jax.Array, num_levels: int) -> List[jax.Array]:
 
 
 def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
-                     radius: int) -> CorrFn:
+                     radius: int, precision: str = "highest") -> CorrFn:
     """On-demand backend: O(H*W) memory, recomputes correlation only at the
     sampled taps (reference: PytorchAlternateCorrBlock1D, core/corr.py:64-107).
     """
@@ -149,7 +161,8 @@ def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
             v0 = jnp.where(((i0 >= 0) & (i0 <= w2 - 1))[..., None], v0, 0)
             v1 = jnp.where(((i1 >= 0) & (i1 <= w2 - 1))[..., None], v1, 0)
             f2_taps = v0 * (1.0 - dx)[..., None] + v1 * dx[..., None]
-            corr = jnp.einsum("bhwc,bhwkc->bhwk", fmap1, f2_taps) * scale
+            corr = jnp.einsum("bhwc,bhwkc->bhwk", fmap1, f2_taps,
+                              precision=_PRECISIONS[precision]) * scale
             out.append(corr)
         return jnp.concatenate(out, axis=-1)
 
@@ -207,7 +220,8 @@ def _corr_shard_mesh(b: int, h: int):
 
 
 def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
-                        radius: int, dtype=jnp.float32) -> CorrFn:
+                        radius: int, dtype=jnp.float32,
+                        precision: str = "highest") -> CorrFn:
     """Precomputed-pyramid backend with the Pallas TPU lookup kernel.
 
     Each pyramid level is flattened + W1-padded to the kernel's layout ONCE
@@ -223,7 +237,8 @@ def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
 
     def construct(f1, f2):
         volume = build_corr_volume(f1.astype(jnp.float32),
-                                   f2.astype(jnp.float32), dtype=dtype)
+                                   f2.astype(jnp.float32), dtype=dtype,
+                                   precision=precision)
         # Lane-padded level concat along W2: every per-iteration lookup is
         # ONE kernel launch covering all levels (same as pallas_alt).
         pyr = [pad_vol_lane(preflatten_volume(v))
@@ -264,12 +279,14 @@ def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
 
 def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
                             num_levels: int, radius: int,
-                            dtype=jnp.float32) -> CorrFn:
+                            dtype=jnp.float32,
+                            precision: str = "highest",
+                            out_dtype=jnp.float32) -> CorrFn:
     """On-demand Pallas backend: O(H*W) HBM like ``alt``, but each W1-block's
     correlation rows are recomputed inside a TPU kernel (MXU matmul + hat
     reduction in VMEM).  Working form of the reference's dead ``alt_cuda``
     backend (reference: core/corr.py:159-188 raises NotImplementedError)."""
-    from .pallas_alt import (pad_w2_lane, pallas_alt_pyramid_flat,
+    from .pallas_alt import (pad_w2_lane, pallas_alt_pyramid_radial_flat,
                              preflatten_fmap1, preflatten_fmap2)
 
     # Flatten/pad ONCE so each corr_fn call touches only the taps (the f1
@@ -291,7 +308,11 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
     shard = _corr_shard_mesh(fmap1.shape[0], fmap1.shape[1])
     if shard is None:
         f1flat, *f2_pyramid = construct(fmap1, fmap2)
-        lookup_flat = pallas_alt_pyramid_flat
+
+        def lookup_flat(f1, f2, xl, w2s):
+            return pallas_alt_pyramid_radial_flat(f1, f2, xl, w2s, radius,
+                                                  precision=precision,
+                                                  out_dtype=out_dtype)
     else:
         # Partition over the mesh (see _corr_shard_mesh): construction and
         # every lookup run per-shard inside shard_map; no collectives.
@@ -301,45 +322,59 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
             out_specs=tuple([flat_spec] * (1 + num_levels)),
             check_vma=False)(fmap1, fmap2)
 
-        def lookup_flat(f1, f2, taps, w2s):
+        def lookup_flat(f1, f2, xl, w2s):
             return jax.shard_map(
-                lambda a, b, t: pallas_alt_pyramid_flat(a, b, t, w2s),
+                lambda a, b, t: pallas_alt_pyramid_radial_flat(
+                    a, b, t, w2s, radius, precision=precision,
+                    out_dtype=out_dtype),
                 mesh=mesh, in_specs=(flat_spec, flat_spec, row_spec),
-                out_specs=row_spec, check_vma=False)(f1, f2, taps)
+                out_specs=row_spec, check_vma=False)(f1, f2, xl)
 
     w2s = tuple(f2.shape[1] for f2 in f2_pyramid)
     f2cat = jnp.concatenate(f2_pyramid, axis=1)
-    offsets = _tap_offsets(radius)
 
     def corr_fn(coords: jax.Array) -> jax.Array:
         x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
-        taps = jnp.concatenate(
-            [x[..., None] / (2.0 ** i) + offsets        # (B, H, W1, K)
-             for i in range(len(w2s))], axis=-1)
-        return lookup_flat(f1flat, f2cat, taps, w2s)
+        # Per-level local centers; the kernel resolves the radius taps
+        # itself (shared-fraction window form, _alt_pyr_radial_kernel).
+        xl = jnp.stack([x / (2.0 ** i) for i in range(len(w2s))], axis=-1)
+        return lookup_flat(f1flat, f2cat, xl, w2s)
 
     return corr_fn
 
 
 def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
-                 num_levels: int, radius: int, dtype=jnp.float32) -> CorrFn:
+                 num_levels: int, radius: int, dtype=jnp.float32,
+                 precision: str = "highest", out_dtype=jnp.float32) -> CorrFn:
     """Backend dispatch (reference: core/raft_stereo.py:90-100).
 
     ``auto`` resolves to the fastest backend for the active platform: the
     on-demand Pallas kernel on TPU (fastest measured AND O(H*W) memory),
     the XLA gather path elsewhere (the Pallas kernels are TPU-tuned; their
-    interpret mode is for correctness tests, not speed)."""
+    interpret mode is for correctness tests, not speed).
+
+    ``out_dtype`` is the dtype of the returned correlation features.  The
+    lookup math is identical (fp32 accumulation everywhere); a bf16 model
+    requests bf16 directly so the Pallas kernel emits it and the
+    post-lookup convert + HBM round trip disappear from the loop."""
     if implementation == "auto":
         implementation = ("pallas_alt" if jax.default_backend() == "tpu"
                           else "reg")
     if implementation == "reg":
-        return make_reg_corr_fn(fmap1, fmap2, num_levels, radius, dtype=jnp.float32)
-    if implementation == "alt":
-        return make_alt_corr_fn(fmap1, fmap2, num_levels, radius)
-    if implementation == "pallas":
-        return make_pallas_corr_fn(fmap1, fmap2, num_levels, radius,
-                                   dtype=dtype)
-    if implementation == "pallas_alt":
+        fn = make_reg_corr_fn(fmap1, fmap2, num_levels, radius,
+                              dtype=jnp.float32, precision=precision)
+    elif implementation == "alt":
+        fn = make_alt_corr_fn(fmap1, fmap2, num_levels, radius,
+                              precision=precision)
+    elif implementation == "pallas":
+        fn = make_pallas_corr_fn(fmap1, fmap2, num_levels, radius,
+                                 dtype=dtype, precision=precision)
+    elif implementation == "pallas_alt":
         return make_pallas_alt_corr_fn(fmap1, fmap2, num_levels, radius,
-                                       dtype=dtype)
-    raise ValueError(f"unknown corr implementation: {implementation}")
+                                       dtype=dtype, precision=precision,
+                                       out_dtype=out_dtype)
+    else:
+        raise ValueError(f"unknown corr implementation: {implementation}")
+    if jnp.dtype(out_dtype) == jnp.float32:
+        return fn
+    return lambda coords: fn(coords).astype(out_dtype)

@@ -45,7 +45,37 @@ from .pallas_corr import (_BLOCK_ROWS, _COMPILER_PARAMS, _block_w1,
                           bounds_from_widths, pad_lane)
 
 
-def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds):
+def _dot(a, b, dims, prec: str):
+    """dot_general with a precision POLICY string, not a lax.Precision:
+    Mosaic only lowers DEFAULT and HIGHEST, so the 3-pass "high" form
+    (jax.lax.Precision.HIGH outside kernels) is built manually — split each
+    fp32 operand into a bf16 head + bf16 residual and sum the three
+    significant cross products (hi*hi + hi*lo + lo*hi), which is exactly
+    XLA's bf16x3 emulation.  bf16 operands always take the native single
+    pass regardless of the policy."""
+    if a.dtype != jnp.float32 or prec == "default":
+        return jax.lax.dot_general(a, b, dims,
+                                   preferred_element_type=jnp.float32,
+                                   precision=jax.lax.Precision.DEFAULT)
+    if prec == "highest":
+        return jax.lax.dot_general(a, b, dims,
+                                   preferred_element_type=jnp.float32,
+                                   precision=jax.lax.Precision.HIGHEST)
+    a_hi = a.astype(jnp.bfloat16)
+    a_lo = (a - a_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    b_hi = b.astype(jnp.bfloat16)
+    b_lo = (b - b_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    def d(x, y):
+        return jax.lax.dot_general(x, y, dims,
+                                   preferred_element_type=jnp.float32,
+                                   precision=jax.lax.Precision.DEFAULT)
+
+    return d(a_hi, b_hi) + d(a_hi, b_lo) + d(a_lo, b_hi)
+
+
+def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds,
+                        prec="highest"):
     """Fused all-levels lookup: the fmap2 pyramid is concatenated along W2
     and every level's taps are resolved against one (blk x W2cat) matmul:
     out[x1, l*K + k] = sum_j M_l[x1, j] * hat(j - taps[x1, l*K + k]).
@@ -58,16 +88,15 @@ def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds):
     ``pallas_alt_lookup`` path is this same kernel with bounds=((0, w2),).
     """
     # Feed the MXU the stored dtype directly: bf16 inputs take the native
-    # bf16 path with fp32 accumulation (HIGHEST would force a multi-pass
-    # fp32 emulation ~8x slower); fp32 inputs keep exact fp32.
+    # bf16 path with fp32 accumulation (multi-pass emulation on bf16 inputs
+    # would be pure waste); fp32 inputs use the requested emulation depth
+    # ("highest" = exact 6-pass, "high" = 3-pass at half the MXU cost;
+    # see _dot).
     f1 = f1_ref[...]                              # (R, blk, C)
     f2 = f2_ref[...]                              # (R, W2cat, C)
     taps = taps_ref[...].astype(jnp.float32)      # (R, blk, L*K)
-    prec = (jax.lax.Precision.HIGHEST if f1.dtype == jnp.float32
-            else jax.lax.Precision.DEFAULT)
-    m = jax.lax.dot_general(f1, f2, (((2,), (2,)), ((0,), (0,))),
-                            preferred_element_type=jnp.float32,
-                            precision=prec) * scale   # (R, blk, W2cat)
+    m = _dot(f1, f2, (((2,), (2,)), ((0,), (0,))),
+             prec) * scale                        # (R, blk, W2cat)
     kk = taps.shape[-1] // len(bounds)
     cols = []
     for li, (off, w2p) in enumerate(bounds):
@@ -86,12 +115,44 @@ def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds):
     out_ref[...] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
 
 
-def _alt_pyr_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
-                        scale, bounds):
+def _alt_pyr_radial_kernel(f1_ref, f2_ref, x_ref, out_ref, *, scale, bounds,
+                           radius, prec="highest"):
+    """Model-pattern lookup: taps are x + k for k in [-radius, radius], so
+    every tap of a level shares floor(x)/frac(x).  Instead of K dense hat
+    sweeps (~6 VPU ops per column-visit), sweep K+1 integer WINDOWS
+    win[d] = M[x1, floor(x)+d-radius] (~3 ops per visit: one shared integer
+    offset, then compare + masked-accumulate per window) and lerp
+    per-pixel:  out_k = (1-f)*win[k] + f*win[k+1].  Algebraically identical
+    to the hat form — hat(j - (b0+f+k-r)) is nonzero exactly at
+    j = b0+k-r (weight 1-f) and j+1 (weight f) — including zero-outside
+    edges (out-of-range windows sum nothing) and NaN coords (f = NaN
+    poisons the lerp).  ~1.7x fewer VPU ops on the kernel's dominant cost
+    (docs/perf_notes_r03.md)."""
     f1 = f1_ref[...]                              # (R, blk, C)
     f2 = f2_ref[...]                              # (R, W2cat, C)
-    prec = (jax.lax.Precision.HIGHEST if f1.dtype == jnp.float32
-            else jax.lax.Precision.DEFAULT)
+    x = x_ref[...].astype(jnp.float32)            # (R, blk, L)
+    m = _dot(f1, f2, (((2,), (2,)), ((0,), (0,))),
+             prec) * scale                        # (R, blk, W2cat)
+    kk = 2 * radius + 1
+    cols = []
+    for li, (off, w2p) in enumerate(bounds):
+        ml = m[:, :, off:off + w2p]
+        xl = x[:, :, li]
+        b0 = jnp.floor(xl)
+        f = xl - b0                               # (R, blk)
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2p), 2)
+        z = j - b0.astype(jnp.int32)[..., None] + radius   # (R, blk, w2p)
+        wins = [jnp.sum(jnp.where(z == d, ml, 0.0), axis=-1)
+                for d in range(kk + 1)]           # each (R, blk)
+        for ki in range(kk):
+            cols.append(wins[ki] * (1.0 - f) + wins[ki + 1] * f)
+    out_ref[...] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
+
+
+def _alt_pyr_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
+                        scale, bounds, prec="highest"):
+    f1 = f1_ref[...]                              # (R, blk, C)
+    f2 = f2_ref[...]                              # (R, W2cat, C)
     taps = taps_ref[...].astype(jnp.float32)      # (R, blk, L*K)
     g = g_ref[...].astype(jnp.float32)            # (R, blk, L*K)
     kk = taps.shape[-1] // len(bounds)
@@ -108,19 +169,15 @@ def _alt_pyr_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
     # of the level edge) flows into df2 rows that the caller's concat-pad
     # autodiff discards — matching the per-level kernels exactly.
     dm = (jnp.concatenate(parts, axis=-1) * scale).astype(f1.dtype)
-    df1_ref[...] = jax.lax.dot_general(
-        dm, f2, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-        precision=prec).astype(df1_ref.dtype)
+    df1_ref[...] = _dot(dm, f2, (((2,), (1,)), ((0,), (0,))),
+                        prec).astype(df1_ref.dtype)
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
         df2_ref[...] = jnp.zeros_like(df2_ref[...])
 
-    df2_ref[...] += jax.lax.dot_general(
-        dm, f1, (((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-        precision=prec).astype(df2_ref.dtype)
+    df2_ref[...] += _dot(dm, f1, (((1,), (1,)), ((0,), (0,))),
+                         prec).astype(df2_ref.dtype)
 
 
 def preflatten_fmap1(fmap1: jax.Array) -> jax.Array:
@@ -142,12 +199,13 @@ def preflatten_fmap2(fmap2: jax.Array) -> jax.Array:
 
 
 def pallas_alt_lookup_flat(f1flat: jax.Array, f2flat: jax.Array,
-                           taps: jax.Array) -> jax.Array:
+                           taps: jax.Array,
+                           precision: str = "highest") -> jax.Array:
     """Lookup against preflattened feature maps; taps stay in model layout
     (B, H, W1, K) and are the only tensor reshaped per call. Single-level
     special case of the fused pyramid kernel."""
     return _make_alt_pyr(f1flat.shape, f2flat.shape, (f2flat.shape[1],),
-                         f1flat.dtype.name, f2flat.dtype.name)(
+                         f1flat.dtype.name, f2flat.dtype.name, precision)(
                              f1flat, f2flat, taps)
 
 
@@ -173,37 +231,127 @@ def pad_w2_lane(f2flat: jax.Array) -> jax.Array:
 
 
 def pallas_alt_pyramid_flat(f1flat: jax.Array, f2cat: jax.Array,
-                            taps: jax.Array, w2s: tuple) -> jax.Array:
+                            taps: jax.Array, w2s: tuple,
+                            precision: str = "highest",
+                            out_dtype=jnp.float32) -> jax.Array:
     """All pyramid levels in ONE kernel call.
 
     f1flat: (B*H, W1p, C) from preflatten_fmap1; f2cat: (B*H, sum(w2s), C) —
     the per-level preflattened, ``pad_w2_lane``-padded fmap2 pyramid
     concatenated along W2; taps: (B, H, W1, L*K) per-level LOCAL tap
     coordinates, level-major; w2s: static per-level PADDED widths (each a
-    lane multiple). Returns (B, H, W1, L*K) float32 with the exact
-    per-level ``pallas_alt_lookup`` semantics (equivalence pinned in
+    lane multiple). Returns (B, H, W1, L*K) in ``out_dtype`` (fp32
+    accumulation in-kernel; emitting bf16 directly saves the model's
+    post-lookup convert + one HBM round trip) with the exact per-level
+    ``pallas_alt_lookup`` semantics (equivalence pinned in
     tests/test_pallas_alt.py).
     """
     return _make_alt_pyr(f1flat.shape, f2cat.shape, tuple(w2s),
-                         f1flat.dtype.name, f2cat.dtype.name)(
-                             f1flat, f2cat, taps)
+                         f1flat.dtype.name, f2cat.dtype.name, precision,
+                         jnp.dtype(out_dtype).name)(f1flat, f2cat, taps)
+
+
+def pallas_alt_pyramid_radial_flat(f1flat: jax.Array, f2cat: jax.Array,
+                                   x_levels: jax.Array, w2s: tuple,
+                                   radius: int,
+                                   precision: str = "highest",
+                                   out_dtype=jnp.float32) -> jax.Array:
+    """Model-pattern variant of :func:`pallas_alt_pyramid_flat`: instead of
+    explicit per-tap coordinates it takes the per-level LOCAL center
+    ``x_levels`` (B, H, W1, L) and the static ``radius``, and resolves the
+    taps ``x + k, k in [-radius, radius]`` with the cheaper shared-fraction
+    window kernel.  Output channel order and semantics are identical to the
+    general entry with ``taps = x[..., None] + arange(-r, r+1)``
+    (equivalence pinned in tests/test_pallas_alt.py)."""
+    return _make_alt_pyr_radial(f1flat.shape, f2cat.shape, tuple(w2s),
+                                radius, f1flat.dtype.name, f2cat.dtype.name,
+                                precision, jnp.dtype(out_dtype).name)(
+                                    f1flat, f2cat, x_levels)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_alt_pyr(f1flat_shape, f2cat_shape, w2s, f1_dtype, f2_dtype):
+def _make_alt_pyr_radial(f1flat_shape, f2cat_shape, w2s, radius, f1_dtype,
+                         f2_dtype, precision="highest", out_dtype="float32"):
     bounds = bounds_from_widths(w2s)
+    odt = jnp.dtype(out_dtype)
+
+    @jax.custom_vjp
+    def f(f1flat, f2cat, x):
+        return _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
+                                        precision, odt)
+
+    def fwd(f1flat, f2cat, x):
+        return _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
+                                        precision, odt), (f1flat, f2cat, x)
+
+    def bwd(res, g):
+        f1flat, f2cat, x = res
+        # The general backward kernel already handles arbitrary taps; the
+        # radial pattern is just its special case, so materialize the taps
+        # (a small XLA broadcast-add on the backward path only).
+        offsets = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+        taps = (x.astype(jnp.float32)[..., None] + offsets).reshape(
+            *x.shape[:-1], x.shape[-1] * (2 * radius + 1))
+        df1, df2 = _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds,
+                                     precision)
+        return (df1[:f1flat.shape[0]].astype(f1_dtype),
+                df2[:f2cat.shape[0]].astype(f2_dtype),
+                jnp.zeros_like(x))
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
+                             prec="highest", out_dtype=jnp.float32):
+    f1flat = _pad_rows(f1flat)  # no-ops for preflatten_* outputs
+    f2cat = _pad_rows(f2cat)
+    n, w1p, c = f1flat.shape
+    b, h, w1, nl = x.shape
+    t, blk = _pad_taps(x, n)
+    scale = 1.0 / float(c) ** 0.5
+    w2cat = f2cat.shape[1]
+    lk = nl * (2 * radius + 1)
+    r = _BLOCK_ROWS
+    out = pl.pallas_call(
+        functools.partial(_alt_pyr_radial_kernel, scale=scale, bounds=bounds,
+                          radius=radius, prec=prec),
+        out_shape=jax.ShapeDtypeStruct((n, w1p, lk), out_dtype),
+        grid=(n // r, w1p // blk),
+        in_specs=[
+            pl.BlockSpec((r, blk, c), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, w2cat, c), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, blk, nl), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, blk, lk), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )(f1flat, f2cat, t)
+    return out[:b * h, :w1].reshape(b, h, w1, lk)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_alt_pyr(f1flat_shape, f2cat_shape, w2s, f1_dtype, f2_dtype,
+                  precision="highest", out_dtype="float32"):
+    bounds = bounds_from_widths(w2s)
+    prec = precision
+    odt = jnp.dtype(out_dtype)
 
     @jax.custom_vjp
     def f(f1flat, f2cat, taps):
-        return _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds)
+        return _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds, prec, odt)
 
     def fwd(f1flat, f2cat, taps):
-        return _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds), (
+        return _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds, prec, odt), (
             f1flat, f2cat, taps)
 
     def bwd(res, g):
         f1flat, f2cat, taps = res
-        df1, df2 = _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds)
+        df1, df2 = _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds, prec)
         # Row-padding inside the impl is invisible to callers: cotangents
         # are sliced back to the primal row counts.
         return (df1[:f1flat.shape[0]].astype(f1_dtype),
@@ -214,7 +362,8 @@ def _make_alt_pyr(f1flat_shape, f2cat_shape, w2s, f1_dtype, f2_dtype):
     return f
 
 
-def _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds):
+def _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds, prec="highest",
+                      out_dtype=jnp.float32):
     f1flat = _pad_rows(f1flat)  # no-ops for preflatten_* outputs
     f2cat = _pad_rows(f2cat)
     n, w1p, c = f1flat.shape
@@ -224,8 +373,9 @@ def _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds):
     w2cat = f2cat.shape[1]
     r = _BLOCK_ROWS
     out = pl.pallas_call(
-        functools.partial(_alt_pyr_fwd_kernel, scale=scale, bounds=bounds),
-        out_shape=jax.ShapeDtypeStruct((n, w1p, lk), jnp.float32),
+        functools.partial(_alt_pyr_fwd_kernel, scale=scale, bounds=bounds,
+                          prec=prec),
+        out_shape=jax.ShapeDtypeStruct((n, w1p, lk), out_dtype),
         grid=(n // r, w1p // blk),
         in_specs=[
             pl.BlockSpec((r, blk, c), lambda i, j: (i, j, 0),
@@ -243,7 +393,7 @@ def _alt_pyr_fwd_impl(f1flat, f2cat, taps, bounds):
     return out[:b * h, :w1].reshape(b, h, w1, lk)
 
 
-def _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds):
+def _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds, prec="highest"):
     f1flat = _pad_rows(f1flat)  # no-ops for preflatten_* outputs
     f2cat = _pad_rows(f2cat)
     n, w1p, c = f1flat.shape
@@ -255,7 +405,8 @@ def _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds):
     w2cat = f2cat.shape[1]
     r = _BLOCK_ROWS
     df1, df2 = pl.pallas_call(
-        functools.partial(_alt_pyr_bwd_kernel, scale=scale, bounds=bounds),
+        functools.partial(_alt_pyr_bwd_kernel, scale=scale, bounds=bounds,
+                          prec=prec),
         out_shape=(jax.ShapeDtypeStruct((n, w1p, c), jnp.float32),
                    jax.ShapeDtypeStruct((n, w2cat, c), jnp.float32)),
         grid=(n // r, w1p // blk),

@@ -17,13 +17,17 @@ import orbax.checkpoint as ocp
 from .state import TrainState
 
 
+def _all_keys(tree):
+    for k, v in tree.items():
+        yield k
+        if isinstance(v, dict):
+            yield from _all_keys(v)
+
+
 def _tree_has_exact_key(tree, key: str) -> bool:
     """True if any dict node in ``tree`` has a child named exactly ``key``
     (NOT substring — SepConvGRU's convz1/convr1 must not match 'convz')."""
-    if not isinstance(tree, dict):
-        return False
-    return any(k == key or _tree_has_exact_key(v, key)
-               for k, v in tree.items())
+    return isinstance(tree, dict) and key in _all_keys(tree)
 
 
 def _metadata_tree(md):
@@ -96,13 +100,6 @@ def save_weights(path: str, variables: Dict) -> None:
     ckptr.save(os.path.abspath(path), variables, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
-
-
-def _all_keys(tree):
-    for k, v in tree.items():
-        yield k
-        if isinstance(v, dict):
-            yield from _all_keys(v)
 
 
 def load_weights(path: str, variables_like: Optional[Dict] = None) -> Dict:

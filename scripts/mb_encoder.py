@@ -1,0 +1,109 @@
+"""Microbenchmark: the fixed-stage encoders at flagship resolution.
+
+The device trace (docs/perf_notes_r03.md) shows the ~50 ms fixed stage is
+~90% data movement around the half-resolution 64-channel convs.  This
+harness times the encoder subgraphs in isolation so layout/packing
+experiments get a fast measured verdict (the round-2 lesson: microbenches
+are hypotheses, the flagship bench is the final verdict — confirm winners
+E2E).
+
+Usage: python scripts/mb_encoder.py [--height 540] [--width 960] [--reps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--height", type=int, default=540)
+    p.add_argument("--width", type=int, default=960)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--reps", type=int, default=20)
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args()
+
+    from raftstereo_tpu.utils import apply_env_platform
+    apply_env_platform()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raftstereo_tpu.models.encoders import BasicEncoder, MultiBasicEncoder
+    from raftstereo_tpu.ops.image import InputPadder
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (args.batch, args.height, args.width, 3))
+    img = jnp.asarray(img.astype(np.float32))
+    padder = InputPadder(img.shape, divis_by=32)
+    img, _ = padder.pad(img, img)
+    img = (2.0 * (img / 255.0) - 1.0).astype(dtype)
+    both = jnp.concatenate([img, img], 0)
+
+    def bench(make_fn, x, name):
+        fn, variables = make_fn(x)
+        jitted = jax.jit(lambda v, a: fn(v, a))
+
+        def run(v, a, n):
+            def body(i, acc):
+                y = fn(v, a + i.astype(a.dtype) * 0)
+                return acc + jax.tree.leaves(y)[0].astype(jnp.float32).sum()
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+
+        r = jax.jit(run, static_argnums=(2,))
+        lo = max(args.reps // 5, 1)
+        float(r(variables, x, lo)); float(r(variables, x, args.reps))
+        t0 = time.perf_counter(); float(r(variables, x, args.reps))
+        t1 = time.perf_counter(); float(r(variables, x, lo))
+        t2 = time.perf_counter()
+        dt = max((t1 - t0) - (t2 - t1), 1e-9) / (args.reps - lo)
+        print(f"{name:28s}: {dt*1000:8.2f} ms")
+        return dt
+
+    def full_fnet(x):
+        enc = BasicEncoder(output_dim=256, norm_fn="instance", downsample=2,
+                           dtype=dtype)
+        v = enc.init(jax.random.key(0), x[:1])
+        return (lambda vv, a: enc.apply(vv, a)), v
+
+    def full_cnet(x):
+        enc = MultiBasicEncoder(output_dims=((128,) * 3, (128,) * 3),
+                                norm_fn="batch", downsample=2, dtype=dtype)
+        v = enc.init(jax.random.key(0), x[:1])
+        return (lambda vv, a: enc.apply(vv, a)), v
+
+    def stem_layer1(x):
+        """conv1 + norm1 + relu + layer1 (the half-res 64-channel stage)."""
+        import flax.linen as nn
+
+        from raftstereo_tpu.models.layers import ResidualBlock, conv, make_norm
+
+        class Stem(nn.Module):
+            @nn.compact
+            def __call__(self, a):
+                a = conv(64, 7, stride=1, padding=3, dtype=dtype)(a)
+                a = make_norm("instance", 64, dtype)(a)
+                a = nn.relu(a)
+                a = ResidualBlock(64, 64, "instance", 1, dtype)(a)
+                a = ResidualBlock(64, 64, "instance", 1, dtype)(a)
+                return a
+
+        m = Stem()
+        v = m.init(jax.random.key(0), x[:1])
+        return (lambda vv, a: m.apply(vv, a)), v
+
+    bench(full_fnet, both, "fnet (2 imgs, instance)")
+    bench(full_cnet, img, "cnet (1 img, frozen batch)")
+    bench(stem_layer1, both, "stem+layer1 (2 imgs)")
+
+
+if __name__ == "__main__":
+    main()

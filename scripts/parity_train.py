@@ -1,0 +1,151 @@
+"""Two-stack TRAINING parity (VERDICT r2 item 5).
+
+Runs N identical optimization steps in both stacks — the reference torch
+trainer (scripts/ref_train_probe.py: its model, sequence_loss,
+AdamW+OneCycleLR+clip, train_stereo.py:162-200) and raftstereo_tpu's
+train step — from the SAME random init (converted by utils/convert) on the
+SAME fixed synthetic batches (no augmentation, fixed order), and compares
+the loss trajectories.  This pins, end to end, the one pipeline
+PARITY_CLI.md does not cover: gradients, the optimizer, the LR schedule,
+and gradient clipping.
+
+Both stacks run CPU fp32.  Divergence grows with step count (fp
+reassociation amplified by the recurrent model — same mechanism as the
+eval-parity drift analysis in scripts/parity_cli.py), so the gate is on
+relative loss difference per step with a step-50 tolerance.
+
+    python scripts/parity_train.py --workspace /tmp/ptrain --steps 50
+
+Writes PARITY_TRAIN.md / .json at the repo root; non-zero exit on
+mismatch.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_reference(args, ws):
+    ckpt = os.path.join(ws, "init.pth")
+    out = os.path.join(ws, "ref_losses.json")
+    if not (os.path.exists(ckpt) and os.path.exists(out) and args.reuse):
+        cmd = [sys.executable,
+               os.path.join(REPO, "scripts", "ref_train_probe.py"),
+               "--steps", str(args.steps), "--batch", str(args.batch),
+               "--height", str(args.height), "--width", str(args.width),
+               "--train_iters", str(args.train_iters),
+               "--ckpt", ckpt, "--out", out]
+        env = dict(os.environ, CUDA_VISIBLE_DEVICES="")
+        subprocess.run(cmd, check=True, env=env)
+    with open(out) as f:
+        return ckpt, json.load(f)
+
+
+def run_ours(args, ckpt):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from raftstereo_tpu.utils import apply_env_platform
+    apply_env_platform()
+
+    import jax
+    import jax.numpy as jnp
+
+    from raftstereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.train import make_optimizer, make_train_step
+    from raftstereo_tpu.train.state import state_from_variables
+    from raftstereo_tpu.utils.convert import convert_checkpoint
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from ref_train_probe import synth_batches
+
+    cfg = RAFTStereoConfig(corr_implementation="reg")  # fp32 everywhere
+    tcfg = TrainConfig(batch_size=args.batch, train_iters=args.train_iters,
+                       image_size=(args.height, args.width),
+                       lr=2e-4, wdecay=1e-5, num_steps=1000)
+    model = RAFTStereo(cfg)
+    tx, sched = make_optimizer(tcfg)
+    variables = convert_checkpoint(ckpt, cfg, (args.height, args.width))
+    state = state_from_variables(variables, tx)
+    step = jax.jit(make_train_step(model, tx, tcfg, lr_schedule=sched))
+
+    losses, epes = [], []
+    for img1, img2, disp, valid in synth_batches(
+            args.steps, args.batch, args.height, args.width):
+        batch = (jnp.asarray(img1), jnp.asarray(img2), jnp.asarray(disp),
+                 jnp.asarray(valid))
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+        epes.append(float(metrics["epe"]))
+        print(f"step {len(losses):3d}  loss {losses[-1]:.6f}  "
+              f"epe {epes[-1]:.4f}", flush=True)
+    return losses, epes
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workspace", default="/tmp/parity_train")
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--height", type=int, default=96)
+    p.add_argument("--width", type=int, default=160)
+    p.add_argument("--train_iters", type=int, default=5)
+    p.add_argument("--tol_rel_final", type=float, default=2e-2,
+                   help="relative loss tolerance at the final step")
+    p.add_argument("--tol_rel_early", type=float, default=1e-3,
+                   help="relative loss tolerance over the first 10 steps")
+    p.add_argument("--reuse", action="store_true",
+                   help="reuse an existing reference run in the workspace")
+    args = p.parse_args()
+
+    os.makedirs(args.workspace, exist_ok=True)
+    ckpt, ref = run_reference(args, args.workspace)
+    ours_losses, ours_epes = run_ours(args, ckpt)
+
+    rows = []
+    worst_early = worst = 0.0
+    for i, (a, b) in enumerate(zip(ref["losses"], ours_losses)):
+        rel = abs(a - b) / max(abs(a), 1e-9)
+        worst = max(worst, rel)
+        if i < 10:
+            worst_early = max(worst_early, rel)
+        rows.append((i + 1, a, b, rel))
+
+    md = ["# Two-stack training parity",
+          "",
+          f"{args.steps} identical AdamW+OneCycle+clip steps from the same "
+          f"converted random init on the same synthetic batches "
+          f"(batch {args.batch}, {args.width}x{args.height}, "
+          f"{args.train_iters} GRU iters, CPU fp32 both stacks).",
+          "",
+          "| step | reference loss | ours | rel diff |",
+          "|---|---|---|---|"]
+    for i, a, b, rel in rows[:10] + rows[10::10]:
+        md.append(f"| {i} | {a:.6f} | {b:.6f} | {rel:.2e} |")
+    ok = worst_early <= args.tol_rel_early and rows[-1][3] <= args.tol_rel_final
+    md += ["",
+           f"Max relative diff, steps 1-10: **{worst_early:.2e}** "
+           f"(tolerance {args.tol_rel_early:.0e}); "
+           f"final step: **{rows[-1][3]:.2e}** "
+           f"(tolerance {args.tol_rel_final:.0e}); "
+           f"max anywhere: {worst:.2e}.",
+           "",
+           f"**{'PASS' if ok else 'FAIL'}** — pins gradients, optimizer "
+           f"moments, LR schedule, and clipping across the two stacks "
+           f"(reference loop: train_stereo.py:162-200)."]
+    with open(os.path.join(REPO, "PARITY_TRAIN.md"), "w") as f:
+        f.write("\n".join(md) + "\n")
+    with open(os.path.join(REPO, "PARITY_TRAIN.json"), "w") as f:
+        json.dump({"ref": ref["losses"], "ours": ours_losses,
+                   "ok": ok, "worst_early": worst_early,
+                   "final_rel": rows[-1][3]}, f, indent=1)
+    print("\n".join(md))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Reference-stack training probe for two-stack TRAINING parity.
+
+Runs N identical optimization steps of the REFERENCE trainer machinery
+(its model, its sequence_loss, its AdamW+OneCycleLR+clip recipe — imported
+from /root/reference, never copied) on fixed synthetic batches from a
+seeded generator, saving the random-init checkpoint and the per-step loss
+trajectory.  scripts/parity_train.py replays the SAME init and batches
+through raftstereo_tpu's train step and compares trajectories
+(reference loop being mirrored: train_stereo.py:162-200).
+
+Torch CPU, fp32.  Standalone so the torch stack runs in its own process.
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, "/root/reference")
+sys.path.insert(0, "/root/reference/core")
+
+
+def synth_batches(steps, batch, height, width, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(steps):
+        img1 = rng.integers(0, 255, (batch, height, width, 3)).astype("float32")
+        img2 = rng.integers(0, 255, (batch, height, width, 3)).astype("float32")
+        disp = -np.abs(rng.normal(size=(batch, height, width, 1)) * 8
+                       ).astype("float32")
+        valid = np.ones((batch, height, width), "float32")
+        out.append((img1, img2, disp, valid))
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--height", type=int, default=96)
+    p.add_argument("--width", type=int, default=160)
+    p.add_argument("--train_iters", type=int, default=5)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--wdecay", type=float, default=1e-5)
+    p.add_argument("--num_steps", type=int, default=1000,
+                   help="scheduler horizon (OneCycleLR total = num_steps+100)")
+    p.add_argument("--ckpt", required=True, help="random-init .pth to save")
+    p.add_argument("--out", required=True, help="loss-trajectory JSON")
+    args = p.parse_args()
+
+    import numpy as np
+    import torch
+    from core.raft_stereo import RAFTStereo
+    from train_stereo import fetch_optimizer, sequence_loss
+
+    torch.manual_seed(1234)
+    ns = argparse.Namespace(
+        corr_implementation="reg", corr_levels=4, corr_radius=4,
+        n_downsample=2, n_gru_layers=3, hidden_dims=[128, 128, 128],
+        slow_fast_gru=False, shared_backbone=False, context_norm="batch",
+        mixed_precision=False, lr=args.lr, wdecay=args.wdecay,
+        num_steps=args.num_steps)
+    model = RAFTStereo(ns)
+    torch.save(model.state_dict(), args.ckpt)
+    model.train()
+    model.freeze_bn()
+
+    optimizer, scheduler = fetch_optimizer(ns, model)
+    batches = synth_batches(args.steps, args.batch, args.height, args.width)
+
+    losses, epes = [], []
+    for img1, img2, disp, valid in batches:
+        optimizer.zero_grad()
+        t1 = torch.from_numpy(img1).permute(0, 3, 1, 2).contiguous()
+        t2 = torch.from_numpy(img2).permute(0, 3, 1, 2).contiguous()
+        gt = torch.from_numpy(disp).permute(0, 3, 1, 2).contiguous()
+        va = torch.from_numpy(valid)
+        preds = model(t1, t2, iters=args.train_iters)
+        loss, metrics = sequence_loss(preds, gt, va)
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+        optimizer.step()
+        scheduler.step()
+        losses.append(float(loss.item()))
+        epes.append(float(metrics["epe"]))
+        print(f"step {len(losses):3d}  loss {losses[-1]:.6f}  "
+              f"epe {epes[-1]:.4f}", flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump({"losses": losses, "epes": epes,
+                   "config": vars(args)}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -102,3 +102,22 @@ def test_gradients_flow_through_lookup(fmaps):
     g1, g2 = jax.grad(loss, argnums=(0, 1))(jnp.asarray(f1), jnp.asarray(f2))
     assert np.isfinite(np.asarray(g1)).all() and np.isfinite(np.asarray(g2)).all()
     assert np.abs(np.asarray(g1)).sum() > 0
+
+
+def test_precision_policies_agree(fmaps, rng):
+    """corr_precision plumbing: "high" (3-pass bf16) and "default" (1-pass)
+    stay within their documented error of the exact "highest" path on every
+    backend.  On CPU the XLA einsum ignores precision (native fp32), but the
+    pallas_alt kernel's manual hi/lo decomposition (ops/pallas_alt._dot) is
+    real arithmetic in interpret mode, so the 3-pass construction itself is
+    exercised.  Perf decision (measured on v5e, docs/perf_notes_r03.md):
+    neither is faster on the default path, so "highest" stays the default."""
+    f1, f2 = fmaps
+    x = rng.uniform(0, 20, (2, 6, 20)).astype(np.float32)[..., None]
+    for impl in ("reg", "pallas_alt"):
+        ref = make_corr_fn(impl, jnp.asarray(f1), jnp.asarray(f2), 3, 3,
+                           precision="highest")(jnp.asarray(x))
+        for precision, rtol in (("high", 2e-4), ("default", 2e-2)):
+            got = make_corr_fn(impl, jnp.asarray(f1), jnp.asarray(f2), 3, 3,
+                               precision=precision)(jnp.asarray(x))
+            np.testing.assert_allclose(got, ref, rtol=rtol, atol=rtol)

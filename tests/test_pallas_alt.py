@@ -191,3 +191,93 @@ class TestModelIntegration:
         out_pal = m_pal.forward(variables, i1, i2, iters=2)
         np.testing.assert_allclose(np.asarray(out_pal), np.asarray(out_alt),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestRadialKernel:
+    """The model-pattern radial entry (shared-fraction windows) must be
+    numerically interchangeable with the general-taps kernel — it is the
+    same lookup, resolved with ~1.7x fewer VPU ops."""
+
+    def _flats(self, f1, f2, levels=3):
+        from raftstereo_tpu.ops.corr import build_fmap2_pyramid
+        from raftstereo_tpu.ops.pallas_alt import (pad_w2_lane,
+                                                   preflatten_fmap1,
+                                                   preflatten_fmap2)
+        f1flat = preflatten_fmap1(jnp.asarray(f1))
+        pyr = [pad_w2_lane(preflatten_fmap2(x))
+               for x in build_fmap2_pyramid(jnp.asarray(f2), levels)]
+        w2s = tuple(p.shape[1] for p in pyr)
+        return f1flat, jnp.concatenate(pyr, axis=1), w2s
+
+    def test_matches_general_taps(self, fmaps, coords):
+        from raftstereo_tpu.ops.pallas_alt import (
+            pallas_alt_pyramid_flat, pallas_alt_pyramid_radial_flat)
+        f1, f2 = fmaps
+        radius, levels = 4, 3
+        f1flat, f2cat, w2s = self._flats(f1, f2, levels)
+        x = jnp.asarray(coords)[..., 0]
+        xl = jnp.stack([x / 2.0 ** i for i in range(levels)], axis=-1)
+        offsets = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+        taps = jnp.concatenate([xl[..., i:i + 1] + offsets
+                                for i in range(levels)], axis=-1)
+        want = pallas_alt_pyramid_flat(f1flat, f2cat, taps, w2s)
+        got = pallas_alt_pyramid_radial_flat(f1flat, f2cat, xl, w2s, radius)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_integer_and_oob_centers(self, fmaps):
+        from raftstereo_tpu.ops.pallas_alt import (
+            pallas_alt_pyramid_flat, pallas_alt_pyramid_radial_flat)
+        f1, f2 = fmaps
+        radius, levels = 3, 2
+        f1flat, f2cat, w2s = self._flats(f1, f2, levels)
+        # exact integers (f == 0) and far out-of-range values
+        x = jnp.asarray(np.tile(np.array([0.0, 7.0, -50.0, 200.0, 39.0],
+                                         np.float32), (2, 3, 8))[..., :40])
+        xl = jnp.stack([x / 2.0 ** i for i in range(levels)], axis=-1)
+        offsets = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+        taps = jnp.concatenate([xl[..., i:i + 1] + offsets
+                                for i in range(levels)], axis=-1)
+        want = pallas_alt_pyramid_flat(f1flat, f2cat, taps, w2s)
+        got = pallas_alt_pyramid_radial_flat(f1flat, f2cat, xl, w2s, radius)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradients_match_general(self, fmaps, coords):
+        from raftstereo_tpu.ops.pallas_alt import (
+            pallas_alt_pyramid_flat, pallas_alt_pyramid_radial_flat)
+        f1, f2 = fmaps
+        radius, levels = 2, 2
+        f1flat, f2cat, w2s = self._flats(f1, f2, levels)
+        x = jnp.asarray(coords)[..., 0]
+        xl = jnp.stack([x / 2.0 ** i for i in range(levels)], axis=-1)
+        offsets = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+        taps = jnp.concatenate([xl[..., i:i + 1] + offsets
+                                for i in range(levels)], axis=-1)
+
+        def loss_radial(a, b):
+            return (pallas_alt_pyramid_radial_flat(a, b, xl, w2s, radius)
+                    ** 2).sum()
+
+        def loss_general(a, b):
+            return (pallas_alt_pyramid_flat(a, b, taps, w2s) ** 2).sum()
+
+        gr = jax.grad(loss_radial, argnums=(0, 1))(f1flat, f2cat)
+        gg = jax.grad(loss_general, argnums=(0, 1))(f1flat, f2cat)
+        for a, b in zip(gr, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_bf16_out_dtype(self, fmaps, coords):
+        from raftstereo_tpu.ops.pallas_alt import (
+            pallas_alt_pyramid_radial_flat)
+        f1, f2 = fmaps
+        f1flat, f2cat, w2s = self._flats(f1, f2, 2)
+        x = jnp.asarray(coords)[..., 0]
+        xl = jnp.stack([x / 2.0 ** i for i in range(2)], axis=-1)
+        ref = pallas_alt_pyramid_radial_flat(f1flat, f2cat, xl, w2s, 3)
+        got = pallas_alt_pyramid_radial_flat(f1flat, f2cat, xl, w2s, 3,
+                                             out_dtype=jnp.bfloat16)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref), rtol=1e-2, atol=1e-2)
