@@ -17,6 +17,32 @@ import jax.numpy as jnp
 from .layers import ResidualBlock, conv, make_norm
 
 
+def _stem_layer1(enc, x):
+    """norm1 + relu + layer1, with the fused Pallas fast path on TPU.
+
+    The plain path's four layer1 instance norms at flagship resolution
+    cost ~21 ms of XLA layout churn (measured — docs/perf_notes_r03.md);
+    the fused pipeline (ops/pallas_encoder.py) keeps the whole stage in
+    row-major packed form, consuming conv1's raw output directly (both
+    split points measured E2E — see fused_stem_layer1's docstring).
+    Numerically pinned against this exact module path in
+    tests/test_pallas_encoder.py; init always takes the plain path so the
+    parameter tree is identical either way."""
+    from ..ops.pallas_encoder import stem_layer1, use_fused_stem
+
+    if (not enc.is_initializing()
+            and use_fused_stem(enc.norm_fn, x.shape[2])):
+        params = {
+            "c10": enc.layer1_0.conv1.variables["params"],
+            "c11": enc.layer1_0.conv2.variables["params"],
+            "c20": enc.layer1_1.conv1.variables["params"],
+            "c21": enc.layer1_1.conv2.variables["params"],
+        }
+        return stem_layer1(x, params)
+    x = nn.relu(enc.norm1(x))
+    return enc.layer1_1(enc.layer1_0(x))
+
+
 class BasicEncoder(nn.Module):
     """Residual trunk -> ``output_dim`` feature maps at 1/2^downsample res
     (reference: core/extractor.py:122-197).  The reference's list-input
@@ -41,8 +67,8 @@ class BasicEncoder(nn.Module):
         self.conv2 = conv(self.output_dim, 1, padding=0, dtype=self.dtype)
 
     def __call__(self, x):
-        x = nn.relu(self.norm1(self.conv1(x)))
-        for blk in (self.layer1_0, self.layer1_1, self.layer2_0, self.layer2_1,
+        x = _stem_layer1(self, self.conv1(x))
+        for blk in (self.layer2_0, self.layer2_1,
                     self.layer3_0, self.layer3_1):
             x = blk(x)
         return self.conv2(x)
@@ -107,8 +133,8 @@ class MultiBasicEncoder(nn.Module):
         self.heads32 = heads32
 
     def __call__(self, x, dual_inp: bool = False, num_layers: int = 3):
-        x = nn.relu(self.norm1(self.conv1(x)))
-        for blk in (self.layer1_0, self.layer1_1, self.layer2_0, self.layer2_1,
+        x = _stem_layer1(self, self.conv1(x))
+        for blk in (self.layer2_0, self.layer2_1,
                     self.layer3_0, self.layer3_1):
             x = blk(x)
         trunk = None

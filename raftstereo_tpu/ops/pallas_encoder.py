@@ -1,0 +1,439 @@
+"""Fused Pallas pipeline for the feature encoder's instance-norm stage.
+
+Why: at flagship resolution the stem + layer1 stage (five 64-channel convs
+with instance norms at 544x960) costs ~27 ms of which ~21 ms is XLA layout
+churn — every cross-(H,W) reduction forces ~4 full-tensor relayouts of
+135 MB each between the convs' space-to-depth blocked layouts and the
+reduce's, and NO XLA-side formulation escapes it (lane-packed views,
+direct/fp32 reduces, MXU ones-vector matmuls, 128-channel padding ALL
+measured 27-62 ms; scripts/mb_encoder.py, docs/perf_notes_r03.md).
+
+The fix is to own the stage end-to-end in Pallas so every tensor stays in
+row-major (B, H, W, C):
+
+* The (H, W, 64) tensor is VIEWED as (H, W/2, 128) — a free row-major
+  reinterpretation that packs adjacent pixel pairs into full MXU/VPU
+  lanes (the same trick XLA's blocked layouts buy with relayouts).  A
+  3x3x64->64 conv becomes a 3x3-tap 128->128 conv over packed columns
+  whose (parity-in, parity-out) weight blocks embed the original taps:
+  measured 90.8 TF/s packed (= ~45 TF/s of useful 64-ch flops) vs
+  XLA's 29.8 TF/s row-major / ~70 TF/s blocked-plus-relayouts.
+* Each kernel call fuses the whole conv INPUT preparation — instance-norm
+  apply from precomputed stats, relu, optional residual add (itself
+  normalized from a second raw tensor) — and accumulates the fp32
+  per-channel sum/sum-of-squares of its raw OUTPUT for the next norm, so
+  a norm never touches HBM as a separate op.
+* dy taps read halo rows (built by cheap strided row slices, 2 rows per
+  block); dx taps are resolved post-matmul by rolling the accumulated
+  output one packed column and masking the wrap (operands stay
+  contiguous — the data-stationary formulation from scripts/mb_gru_kernel).
+
+Semantics are exactly BasicEncoder's stem + layer1 (conv1-norm1-relu,
+two ResidualBlocks; reference: core/extractor.py:122-197 structure) with
+instance-norm statistics in fp32.  The backward pass is the XLA reference
+formulation's VJP via jax.custom_vjp (training keeps its current cost;
+this pipeline removes fixed-stage inference time).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_corr import _COMPILER_PARAMS, _interpret
+from .pallas_norm import _row_block
+
+
+# None = auto (fused on TPU backends); True/False force — tests force True
+# to exercise the interpret-mode kernels on CPU.
+fused_stem_override = None
+
+
+def use_fused_stem(norm_fn: str, width: int) -> bool:
+    """Gate for the fused stage: instance norm, even width, TPU backend
+    (the kernels interpret on CPU for tests, but the plain XLA path is the
+    sane CPU default).
+
+    Sharding: a bare pallas_call cannot be SPMD-partitioned, so the fused
+    stage must never sit inside a partitioned program.  It is disabled
+    under an active corr mesh (the evaluator/train paths) AND whenever
+    more than one device is visible — a user may jit with shardings
+    directly, without the use_corr_mesh context, and the plain XLA stage
+    (which XLA partitions with halo exchanges) must remain what they get.
+    Single-device hosts cannot shard, so the gate is exact there; a
+    shard_map wrapper is the future multi-chip path."""
+    from ..parallel.context import active_corr_mesh
+
+    ok = norm_fn == "instance" and width % 2 == 0
+    if active_corr_mesh() is not None:  # None for trivial 1-device meshes
+        return False
+    if fused_stem_override is not None:
+        return fused_stem_override and ok
+    return (ok and jax.default_backend() == "tpu"
+            and len(jax.devices()) == 1)
+
+
+# --------------------------------------------------------------- packing
+
+def pack_view(x: jax.Array) -> jax.Array:
+    """(B, H, W, C) -> (B, H, W/2, 2C): free row-major reinterpretation
+    (adjacent pixel pair -> one packed column)."""
+    b, h, w, c = x.shape
+    return x.reshape(b, h, w // 2, 2 * c)
+
+
+def unpack_view(x: jax.Array) -> jax.Array:
+    b, h, wp, c2 = x.shape
+    return x.reshape(b, h, wp * 2, c2 // 2)
+
+
+def pack_weights(w: jax.Array) -> jax.Array:
+    """(3, 3, C, C) HWIO conv weights -> (9, 2C, 2C) packed [dy*3 + dp].
+
+    Output pixel w_out = 2p + po with tap dx reads input pixel
+    2p + po + dx = packed column p + dp, parity pi, where
+    dp = floor((po+dx)/2), pi = (po+dx) mod 2:
+      dp=-1: (pi=1 -> po=0) = W[dy, dx=-1]
+      dp= 0: full 2x2 parity block
+      dp=+1: (pi=0 -> po=1) = W[dy, dx=+1]
+    """
+    c = w.shape[2]
+    out = jnp.zeros((3, 3, 2 * c, 2 * c), w.dtype)
+    for po in range(2):
+        for dxi, dx in enumerate((-1, 0, 1)):
+            dp = (po + dx) // 2
+            pi = (po + dx) % 2
+            out = out.at[:, dp + 1,
+                         pi * c:(pi + 1) * c,
+                         po * c:(po + 1) * c].set(w[:, dxi])
+    return out.reshape(9, 2 * c, 2 * c)
+
+
+def pack_vec(v: jax.Array) -> jax.Array:
+    """Per-channel vector -> packed duplicate [v, v] (both parities)."""
+    return jnp.concatenate([v, v], axis=-1)
+
+
+def stats_from_packed(s1: jax.Array, s2: jax.Array, n: float
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Packed (B, 1, 2C) fp32 sums -> per-original-channel (B, 1, C)
+    mean / rstd (parity halves sum exactly: they partition the pixels)."""
+    c = s1.shape[-1] // 2
+    t1 = s1[..., :c] + s1[..., c:]
+    t2 = s2[..., :c] + s2[..., c:]
+    mean = t1 / n
+    var = jnp.maximum(t2 / n - mean * mean, 0.0)
+    return mean, jax.lax.rsqrt(var + 1e-5)
+
+
+# ---------------------------------------------------------------- kernels
+
+def _prep(x, m_ref, s_ref):
+    """Instance-norm apply + relu from packed stats refs."""
+    m = m_ref[...][:, :, None, :].astype(x.dtype)
+    s = s_ref[...][:, :, None, :].astype(x.dtype)
+    return jnp.maximum((x - m) * s, 0)
+
+
+def _edge_mask_halo(th):
+    """Zero the prepped halo rows that lie OUTSIDE the image: conv zero
+    padding applies in the PREPPED domain, but prepping a zero-filled edge
+    halo yields relu(-m*s) != 0.  Row 0 (above) is outside at the first
+    row-block, row 1 (below) at the last."""
+    j = pl.program_id(1)
+    # Scalar multiplies, not a stacked bool mask: Mosaic cannot shape-cast
+    # a vector<2xi1> to the broadcast rank.  Edge halo values are finite
+    # (prep of a zero row), so multiply-by-zero is exact.
+    top = th[:, 0:1] * (j > 0).astype(th.dtype)
+    bot = th[:, 1:2] * (j < pl.num_programs(1) - 1).astype(th.dtype)
+    return jnp.concatenate([top, bot], axis=1)
+
+
+def _conv_packed(t, halo, w_ref, bias_ref, wp):
+    """3x3 packed conv of the prepped tile.
+
+    t: (1, R, Wp, 2C) prepped center rows; halo: (1, 2, Wp, 2C) prepped
+    halo rows [above, below]; w_ref: (9, 2C, 2C); returns (1, R, Wp, 2C)
+    fp32 + bias."""
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, wp, 1), 2)
+    y = None
+    for dpi in range(3):
+        u = None
+        for dyi in range(3):
+            if dyi == 0:
+                rows = jnp.concatenate([halo[:, 0:1], t[:, :-1]], axis=1)
+            elif dyi == 1:
+                rows = t
+            else:
+                rows = jnp.concatenate([t[:, 1:], halo[:, 1:2]], axis=1)
+            m = jax.lax.dot_general(
+                rows, w_ref[dyi * 3 + dpi],
+                (((3,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            u = m if u is None else u + m
+        o = dpi - 1
+        if o == 0:
+            shifted = u
+        else:
+            shifted = pltpu.roll(u, (-o) % wp, 2)
+            if o == 1:
+                shifted = jnp.where(col < wp - 1, shifted, 0.0)
+            else:
+                shifted = jnp.where(col > 0, shifted, 0.0)
+        y = shifted if y is None else y + shifted
+    return y + bias_ref[...][:, :, None, :]
+
+
+def _enc_conv_kernel(x_ref, xh_ref, m_ref, s_ref, w_ref, b_ref,
+                     y_ref, s1_ref, s2_ref, *, wp):
+    """prep(x) -> packed conv -> raw y + packed output stats."""
+    t = _prep(x_ref[...], m_ref, s_ref)
+    th = _edge_mask_halo(_prep(xh_ref[...][:, 0], m_ref, s_ref))
+    y = _conv_packed(t, th, w_ref, b_ref, wp)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref[...])
+        s2_ref[...] = jnp.zeros_like(s2_ref[...])
+
+    s1_ref[...] += jnp.sum(y, axis=(1, 2))[:, None, :]
+    s2_ref[...] += jnp.sum(y * y, axis=(1, 2))[:, None, :]
+
+
+def _enc_conv_res_kernel(x_ref, xh_ref, m_ref, s_ref,
+                         r_ref, rh_ref, rm_ref, rs_ref,
+                         w_ref, b_ref, y_ref, s1_ref, s2_ref, *, wp):
+    """Residual-block boundary: the conv input is
+    relu( prep(res_raw) + prep(x_raw) ) — both tensors arrive RAW with
+    their stats and are normalized in-register."""
+    t = jnp.maximum(_prep(r_ref[...], rm_ref, rs_ref)
+                    + _prep(x_ref[...], m_ref, s_ref), 0)
+    th = _edge_mask_halo(
+        jnp.maximum(_prep(rh_ref[...][:, 0], rm_ref, rs_ref)
+                    + _prep(xh_ref[...][:, 0], m_ref, s_ref), 0))
+    y = _conv_packed(t, th, w_ref, b_ref, wp)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref[...])
+        s2_ref[...] = jnp.zeros_like(s2_ref[...])
+
+    s1_ref[...] += jnp.sum(y, axis=(1, 2))[:, None, :]
+    s2_ref[...] += jnp.sum(y * y, axis=(1, 2))[:, None, :]
+
+
+def _enc_finish_kernel(y1_ref, m1_ref, s1_ref, c11_ref, m11_ref, s11_ref,
+                       c21_ref, m21_ref, s21_ref, o_ref):
+    """t2 = relu( relu( t0 + u2 ) + v2 ): the stage output in the final
+    domain, from the three raw tensors + their stats."""
+    t0 = _prep(y1_ref[...], m1_ref, s1_ref)
+    u2 = _prep(c11_ref[...], m11_ref, s11_ref)
+    v2 = _prep(c21_ref[...], m21_ref, s21_ref)
+    o_ref[...] = jnp.maximum(jnp.maximum(t0 + u2, 0) + v2,
+                             0).astype(o_ref.dtype)
+
+
+# ------------------------------------------------------------- host side
+
+def _halo_rows(x: jax.Array, r: int) -> jax.Array:
+    """(B, H, Wp, C2) -> (B, H//r, 2, Wp, C2): rows above/below each
+    r-row block (zeros at image edges); strided slices, ~2/r of a pass."""
+    b, h, wp, c2 = x.shape
+    nblk = h // r
+    zero = jnp.zeros((b, 1, wp, c2), x.dtype)
+    top = jnp.concatenate([zero, x[:, r - 1::r][:, : nblk - 1]], axis=1)
+    bot = jnp.concatenate([x[:, r::r], zero], axis=1)
+    return jnp.stack([top, bot], axis=2)
+
+
+def _enc_conv(x, stats, w9, bias, res=None, res_stats=None):
+    """One fused prep+conv+stats call on packed arrays.
+
+    x: (B, H, Wp, C2) raw; stats: (mean, rstd) each (B, 1, C2) packed;
+    w9: (9, C2, C2); bias: (1, 1, C2).  Returns (y_raw fp-of-x, (s1, s2))."""
+    b, h, wp, c2 = x.shape
+    r = _row_block(h)
+    grid = (b, h // r)
+    xh = _halo_rows(x, r)
+    m, s = stats
+
+    def row_spec():
+        return pl.BlockSpec((1, r, wp, c2), lambda i, j: (i, j, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def halo_spec():
+        return pl.BlockSpec((1, 1, 2, wp, c2), lambda i, j: (i, j, 0, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def stat_spec():
+        return pl.BlockSpec((1, 1, c2), lambda i, j: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    wspec = pl.BlockSpec((9, c2, c2), lambda i, j: (0, 0, 0),
+                         memory_space=pltpu.VMEM)
+    bspec = pl.BlockSpec((1, 1, c2), lambda i, j: (0, 0, 0),
+                         memory_space=pltpu.VMEM)
+
+    if res is None:
+        kernel = functools.partial(_enc_conv_kernel, wp=wp)
+        operands = (x, xh, m, s, w9, bias[None, None, :])
+        in_specs = [row_spec(), halo_spec(), stat_spec(), stat_spec(),
+                    wspec, bspec]
+    else:
+        rm, rs = res_stats
+        rh = _halo_rows(res, r)
+        kernel = functools.partial(_enc_conv_res_kernel, wp=wp)
+        operands = (x, xh, m, s, res, rh, rm, rs, w9, bias[None, None, :])
+        in_specs = [row_spec(), halo_spec(), stat_spec(), stat_spec(),
+                    row_spec(), halo_spec(), stat_spec(), stat_spec(),
+                    wspec, bspec]
+
+    y, s1, s2 = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct(x.shape, x.dtype),
+                   jax.ShapeDtypeStruct((b, 1, c2), jnp.float32),
+                   jax.ShapeDtypeStruct((b, 1, c2), jnp.float32)),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(row_spec(),
+                   stat_spec(), stat_spec()),
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )(*operands)
+    return y, (s1, s2)
+
+
+def _packed_stats(x):
+    """Packed per-channel fp32 (sum, sumsq) of a raw packed tensor via the
+    layout-preserving stats kernel (pallas_norm)."""
+    from .pallas_norm import _in_stats_kernel
+
+    b, h, wp, c2 = x.shape
+    r = _row_block(h)
+    return pl.pallas_call(
+        _in_stats_kernel,
+        out_shape=(jax.ShapeDtypeStruct((b, 1, c2), jnp.float32),
+                   jax.ShapeDtypeStruct((b, 1, c2), jnp.float32)),
+        grid=(b, h // r),
+        in_specs=[pl.BlockSpec((1, r, wp, c2), lambda i, j: (i, j, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((1, 1, c2), lambda i, j: (i, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 1, c2), lambda i, j: (i, 0, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )(x)
+
+
+def _expand_stats(s1, s2, n):
+    """Packed sums -> packed (mean, rstd) duplicated over parities."""
+    mean, rstd = stats_from_packed(s1, s2, n)
+    return pack_vec(mean), pack_vec(rstd)
+
+
+def fused_stem_layer1(y1_raw: jax.Array, params: dict) -> jax.Array:
+    """norm1 + relu + layer1 (two ResidualBlocks), fused, from conv1's RAW
+    output (B, H, W, 64), any even W.
+
+    Both split points were measured E2E: letting norm1 run in XLA (so
+    conv1 keeps its fused blocked lowering) costs MORE than it saves —
+    conv1 drops 1.4 -> 3.8 ms when its consumer is row-major, but the XLA
+    norm1's own relayouts cost ~3 ms more (9.49 vs 9.77 pairs/sec), so
+    the pipeline consumes conv1 raw and computes norm1's stats with the
+    layout-preserving kernel.
+    params: {"c10","c11","c20","c21"} -> {"kernel": (3,3,64,64),
+    "bias": (64,)} — layer1_0.conv1/conv2, layer1_1.conv1/conv2.
+    Returns the stage output in the final (post-relu) domain.
+    """
+    xp = pack_view(y1_raw)
+    n = float(y1_raw.shape[1] * y1_raw.shape[2])
+    dt = y1_raw.dtype
+
+    def pw(name):
+        return (pack_weights(params[name]["kernel"]).astype(dt),
+                pack_vec(params[name]["bias"]).astype(dt))
+
+    st1 = _expand_stats(*_packed_stats(xp), n)
+    c10, s10 = _enc_conv(xp, st1, *pw("c10"))
+    st10 = _expand_stats(*s10, n)
+    c11, s11 = _enc_conv(c10, st10, *pw("c11"))
+    st11 = _expand_stats(*s11, n)
+    # block boundary: input of layer1_1.conv1 is relu(t0 + u2)
+    c20, s20 = _enc_conv(c11, st11, *pw("c20"), res=xp, res_stats=st1)
+    st20 = _expand_stats(*s20, n)
+    c21, s21 = _enc_conv(c20, st20, *pw("c21"))
+    st21 = _expand_stats(*s21, n)
+
+    b, h, wp, c2 = xp.shape
+    r = _row_block(h)
+
+    def row_spec():
+        return pl.BlockSpec((1, r, wp, c2), lambda i, j: (i, j, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def stat_spec():
+        return pl.BlockSpec((1, 1, c2), lambda i, j: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        _enc_finish_kernel,
+        out_shape=jax.ShapeDtypeStruct(xp.shape, dt),
+        grid=(b, h // r),
+        in_specs=[row_spec(), stat_spec(), stat_spec(),
+                  row_spec(), stat_spec(), stat_spec(),
+                  row_spec(), stat_spec(), stat_spec()],
+        out_specs=row_spec(),
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )(xp, *st1, c11, *st11, c21, *st21)
+    return unpack_view(out)
+
+
+# ------------------------------------------------- reference + custom VJP
+
+def _xla_reference(y1_raw, params):
+    """Plain-XLA mirror of fused_stem_layer1 (oracle + backward)."""
+    from .pallas_norm import _xla_instance_norm
+
+    def norm_relu(x):
+        return _xla_instance_norm(x, relu=True)
+
+    def conv(x, p):
+        return jax.lax.conv_general_dilated(
+            x, p["kernel"].astype(x.dtype), (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype) + p["bias"].astype(x.dtype)
+
+    t0 = norm_relu(y1_raw)
+    u2 = norm_relu(conv(norm_relu(conv(t0, params["c10"])), params["c11"]))
+    t1 = jnp.maximum(t0 + u2, 0)
+    v2 = norm_relu(conv(norm_relu(conv(t1, params["c20"])), params["c21"]))
+    return jnp.maximum(t1 + v2, 0)
+
+
+@jax.custom_vjp
+def stem_layer1(y1_raw: jax.Array, params: dict) -> jax.Array:
+    """Fused forward; XLA-reference backward (see module docstring)."""
+    return fused_stem_layer1(y1_raw, params)
+
+
+def _fwd(y1_raw, params):
+    return fused_stem_layer1(y1_raw, params), (y1_raw, params)
+
+
+def _bwd(residuals, g):
+    y1_raw, params = residuals
+    _, vjp = jax.vjp(_xla_reference, y1_raw, params)
+    return vjp(g)
+
+
+stem_layer1.defvjp(_fwd, _bwd)

@@ -1,0 +1,101 @@
+"""Fused Pallas encoder stem (ops/pallas_encoder.py): equivalence with the
+plain flax path it replaces, in interpret mode on the CPU suite."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raftstereo_tpu.ops import pallas_encoder as pe
+
+
+@pytest.fixture
+def stage(rng):
+    B, H, W, C = 2, 16, 24, 8
+    y1 = jnp.asarray(rng.normal(size=(B, H, W, C)).astype(np.float32)) * 2 + 0.3
+    params = {k: {"kernel": jnp.asarray(
+                      rng.normal(size=(3, 3, C, C)).astype(np.float32)) * 0.2,
+                  "bias": jnp.asarray(
+                      rng.normal(size=(C,)).astype(np.float32)) * 0.1}
+              for k in ("c10", "c11", "c20", "c21")}
+    return y1, params
+
+
+class TestPackedConv:
+    def test_matches_lax_conv(self, rng):
+        B, H, W, C = 1, 8, 12, 8
+        x = jnp.asarray(np.abs(rng.normal(size=(B, H, W, C))).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(3, 3, C, C)).astype(np.float32)) * 0.2
+        ident = (jnp.zeros((B, 1, 2 * C), jnp.float32),
+                 jnp.ones((B, 1, 2 * C), jnp.float32))
+        y, _ = pe._enc_conv(pe.pack_view(x), ident, pe.pack_weights(w),
+                            pe.pack_vec(jnp.zeros((C,), jnp.float32)))
+        want = jax.lax.conv_general_dilated(
+            x, w, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(pe.unpack_view(y)),
+                                   np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+class TestFusedStage:
+    def test_matches_reference(self, stage):
+        y1, params = stage
+        got = pe.fused_stem_layer1(y1, params)
+        want = pe._xla_reference(y1, params)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_multi_block_halo(self, rng):
+        """H spanning several row blocks exercises the prepped-halo edge
+        masking (zero padding must stay zero AFTER normalization)."""
+        B, H, W, C = 1, 24, 16, 8   # _row_block(24) = 8 -> 3 blocks
+        y1 = jnp.asarray(rng.normal(size=(B, H, W, C)).astype(np.float32)) - 0.7
+        params = {k: {"kernel": jnp.asarray(
+                          rng.normal(size=(3, 3, C, C)).astype(np.float32)) * 0.2,
+                      "bias": jnp.zeros((C,), jnp.float32)}
+                  for k in ("c10", "c11", "c20", "c21")}
+        got = pe.fused_stem_layer1(y1, params)
+        want = pe._xla_reference(y1, params)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gradients_match_reference(self, stage):
+        y1, params = stage
+        g1 = jax.grad(lambda a: (pe.stem_layer1(a, params) ** 2).sum())(y1)
+        g2 = jax.grad(lambda a: (pe._xla_reference(a, params) ** 2).sum())(y1)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestEncoderIntegration:
+    def test_encoder_fused_equals_plain(self, rng):
+        """BasicEncoder end-to-end: the fused fast path must match the
+        plain flax path (which the CPU suite, torch parity, and all
+        sharded paths keep using) at stat-precision tolerance."""
+        from raftstereo_tpu.models.encoders import BasicEncoder
+
+        enc = BasicEncoder(output_dim=32, norm_fn="instance", downsample=2,
+                           dtype=jnp.float32)
+        x = jnp.asarray(rng.normal(size=(2, 32, 48, 3)).astype(np.float32))
+        v = enc.init(jax.random.key(0), x)
+        plain = enc.apply(v, x)
+        pe.fused_stem_override = True
+        try:
+            fused = enc.apply(v, x)
+        finally:
+            pe.fused_stem_override = None
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(plain),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_gate_off_under_mesh(self):
+        from raftstereo_tpu.parallel import make_mesh
+        from raftstereo_tpu.parallel.context import use_corr_mesh
+
+        assert not pe.use_fused_stem("batch", 64)
+        assert not pe.use_fused_stem("instance", 63)
+        with use_corr_mesh(make_mesh(data=1)):
+            pass  # trivial mesh: gate decided by backend as usual
+        n = jax.device_count()
+        if n > 1:
+            with use_corr_mesh(make_mesh(data=n)):
+                assert not pe.use_fused_stem("instance", 64)
