@@ -194,11 +194,16 @@ class RAFTStereo:
 
         corr_dtype = (jnp.bfloat16 if cfg.corr_dtype == "bfloat16"
                       else jnp.float32)
+        # out_channels: the pallas_alt backend zero-pads the correlation
+        # features to a lane-multiple-friendly width in-kernel (36 lanes
+        # made the motion encoder's 1x1 conv fusion memory-bound); the
+        # motion encoder's padded conv accepts either width.
         corr_fn = make_corr_fn(cfg.corr_implementation, fmap1, fmap2,
                                cfg.corr_levels, cfg.corr_radius,
                                dtype=corr_dtype,
                                precision=cfg.corr_precision,
-                               out_dtype=dtype)
+                               out_dtype=dtype,
+                               out_channels=-(-cfg.cor_planes // 64) * 64)
 
         h0, w0 = net_list[0].shape[1:3]
         grid = coords_grid_x(b, h0, w0)

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ..config import RAFTStereoConfig
 from ..ops.image import avg_pool2x, resize_bilinear_align_corners
-from .layers import conv
+from .layers import conv, kaiming_out
 
 
 class FlowHead(nn.Module):
@@ -38,6 +38,21 @@ class FlowHead(nn.Module):
 
     def __call__(self, x):
         return self.conv2(nn.relu(self.conv1(x)))
+
+
+def _sliced_conv(conv_mod, x, lo, hi, bias=True):
+    """Apply a bound nn.Conv on an input-channel SLICE of its kernel:
+    out = conv(x; kernel[:, :, lo:hi]) (+ bias).  Summing the slices over
+    a channel partition equals the conv of the concatenated input."""
+    p = conv_mod.variables["params"]
+    k = p["kernel"][:, :, lo:hi]
+    pad = conv_mod.padding
+    y = jax.lax.conv_general_dilated(
+        x, k.astype(x.dtype), (1, 1), pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if bias and "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
 
 
 class ConvGRU(nn.Module):
@@ -78,11 +93,27 @@ class ConvGRU(nn.Module):
     def __call__(self, h, cz, cr, cq, *x_list):
         hd = self.hidden_dim
         x = jnp.concatenate(x_list, axis=-1)
-        hx = jnp.concatenate([h, x], axis=-1)
-        zr = self.convzr(hx)
+        if self.is_initializing():
+            # Plain concat form once, so the parameter tree is the
+            # reference-compatible fused-input conv.
+            zr = self.convzr(jnp.concatenate([h, x], axis=-1))
+            z = nn.sigmoid(zr[..., :hd] + cz)
+            r = nn.sigmoid(zr[..., hd:] + cr)
+            q = nn.tanh(self.convq(jnp.concatenate([r * h, x], axis=-1)) + cq)
+            return (1 - z) * h + z * q
+        # Apply each conv as two kernel-sliced convs instead of
+        # materializing the [h, x] concats: kernel[:, :, :hd] convolves h,
+        # kernel[:, :, hd:] convolves x, summed — arithmetically identical
+        # (a conv is linear in its input channels), parameters unchanged.
+        # The concats are real HBM round trips inside the scan loop
+        # (~1.3 ms/iter at batch 8, profiled — docs/perf_notes_r03.md).
+        zr = (_sliced_conv(self.convzr, h, 0, hd, bias=False)
+              + _sliced_conv(self.convzr, x, hd, None))
         z = nn.sigmoid(zr[..., :hd] + cz)
         r = nn.sigmoid(zr[..., hd:] + cr)
-        q = nn.tanh(self.convq(jnp.concatenate([r * h, x], axis=-1)) + cq)
+        q = (_sliced_conv(self.convq, r * h, 0, hd, bias=False)
+             + _sliced_conv(self.convq, x, hd, None))
+        q = nn.tanh(q + cq)
         return (1 - z) * h + z * q
 
 
@@ -117,15 +148,49 @@ class SepConvGRU(nn.Module):
         return h
 
 
+class PointwisePaddedConv(nn.Module):
+    """1x1 conv whose PARAMETER keeps the declared ``in_features`` shape
+    (checkpoint-compatible with the reference's conv) but whose input may
+    arrive with extra trailing ZERO channels — the kernel is zero-padded
+    to match at apply time, which is arithmetically identical.  Lets the
+    Pallas corr backend emit a lane-friendly channel count (36 correlation
+    lanes made the consuming fusion read at ~39 GB/s, measured
+    60 us/iteration at flagship shapes — docs/perf_notes_r03.md)."""
+
+    features: int
+    in_features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.param("kernel", kaiming_out,
+                       (1, 1, self.in_features, self.features))
+        b = self.param("bias", nn.initializers.zeros, (self.features,))
+        pad = x.shape[-1] - self.in_features
+        if pad:
+            k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        x = x.astype(self.dtype)  # flax-Conv-style compute-dtype cast
+        y = jax.lax.conv_general_dilated(
+            x, k.astype(self.dtype), (1, 1), ((0, 0), (0, 0)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        return (y + b.astype(jnp.float32)).astype(x.dtype)
+
+
 class BasicMotionEncoder(nn.Module):
     """Fuses correlation features and current flow into 128 motion channels,
-    the last 2 being the raw flow (reference: core/update.py:64-85)."""
+    the last 2 being the raw flow (reference: core/update.py:64-85).
+
+    ``corr`` may arrive zero-channel-padded past ``cor_planes`` (the
+    Pallas backend's lane-friendly emission); convc1 handles it with an
+    unchanged parameter shape."""
 
     cor_planes: int
     dtype: Any = jnp.float32
 
     def setup(self):
-        self.convc1 = conv(64, 1, padding=0, dtype=self.dtype)
+        self.convc1 = PointwisePaddedConv(64, self.cor_planes,
+                                          dtype=self.dtype)
         self.convc2 = conv(64, 3, dtype=self.dtype)
         self.convf1 = conv(64, 7, padding=3, dtype=self.dtype)
         self.convf2 = conv(64, 3, dtype=self.dtype)

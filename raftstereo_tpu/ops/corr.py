@@ -281,7 +281,8 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
                             num_levels: int, radius: int,
                             dtype=jnp.float32,
                             precision: str = "highest",
-                            out_dtype=jnp.float32) -> CorrFn:
+                            out_dtype=jnp.float32,
+                            out_channels: int = 0) -> CorrFn:
     """On-demand Pallas backend: O(H*W) HBM like ``alt``, but each W1-block's
     correlation rows are recomputed inside a TPU kernel (MXU matmul + hat
     reduction in VMEM).  Working form of the reference's dead ``alt_cuda``
@@ -312,7 +313,8 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
         def lookup_flat(f1, f2, xl, w2s):
             return pallas_alt_pyramid_radial_flat(f1, f2, xl, w2s, radius,
                                                   precision=precision,
-                                                  out_dtype=out_dtype)
+                                                  out_dtype=out_dtype,
+                                                  out_channels=out_channels)
     else:
         # Partition over the mesh (see _corr_shard_mesh): construction and
         # every lookup run per-shard inside shard_map; no collectives.
@@ -326,18 +328,23 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
             return jax.shard_map(
                 lambda a, b, t: pallas_alt_pyramid_radial_flat(
                     a, b, t, w2s, radius, precision=precision,
-                    out_dtype=out_dtype),
+                    out_dtype=out_dtype, out_channels=out_channels),
                 mesh=mesh, in_specs=(flat_spec, flat_spec, row_spec),
                 out_specs=row_spec, check_vma=False)(f1, f2, xl)
 
     w2s = tuple(f2.shape[1] for f2 in f2_pyramid)
     f2cat = jnp.concatenate(f2_pyramid, axis=1)
 
+    inv_scale = jnp.asarray([1.0 / 2.0 ** i for i in range(len(w2s))],
+                            jnp.float32)
+
     def corr_fn(coords: jax.Array) -> jax.Array:
         x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
-        # Per-level local centers; the kernel resolves the radius taps
-        # itself (shared-fraction window form, _alt_pyr_radial_kernel).
-        xl = jnp.stack([x / (2.0 ** i) for i in range(len(w2s))], axis=-1)
+        # Per-level local centers as ONE broadcast multiply; the kernel
+        # resolves the radius taps itself (shared-fraction window form,
+        # _alt_pyr_radial_kernel).  A per-level stack here lowered to a
+        # 9 GB/s loop fusion costing 0.9 ms/iter at batch 8 (profiled).
+        xl = x[..., None] * inv_scale                   # (B, H, W1, L)
         return lookup_flat(f1flat, f2cat, xl, w2s)
 
     return corr_fn
@@ -345,7 +352,8 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
 
 def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
                  num_levels: int, radius: int, dtype=jnp.float32,
-                 precision: str = "highest", out_dtype=jnp.float32) -> CorrFn:
+                 precision: str = "highest", out_dtype=jnp.float32,
+                 out_channels: int = 0) -> CorrFn:
     """Backend dispatch (reference: core/raft_stereo.py:90-100).
 
     ``auto`` resolves to the fastest backend for the active platform: the
@@ -356,7 +364,12 @@ def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
     ``out_dtype`` is the dtype of the returned correlation features.  The
     lookup math is identical (fp32 accumulation everywhere); a bf16 model
     requests bf16 directly so the Pallas kernel emits it and the
-    post-lookup convert + HBM round trip disappear from the loop."""
+    post-lookup convert + HBM round trip disappear from the loop.
+
+    ``out_channels`` (> num_levels*(2r+1)) asks the pallas_alt backend to
+    zero-pad the channel axis in-kernel to a lane-friendly width; other
+    backends return the natural width (consumers must accept both — the
+    motion encoder's padded 1x1 conv does)."""
     if implementation == "auto":
         implementation = ("pallas_alt" if jax.default_backend() == "tpu"
                           else "reg")
@@ -372,7 +385,8 @@ def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
     elif implementation == "pallas_alt":
         return make_pallas_alt_corr_fn(fmap1, fmap2, num_levels, radius,
                                        dtype=dtype, precision=precision,
-                                       out_dtype=out_dtype)
+                                       out_dtype=out_dtype,
+                                       out_channels=out_channels)
     else:
         raise ValueError(f"unknown corr implementation: {implementation}")
     if jnp.dtype(out_dtype) == jnp.float32:

@@ -146,6 +146,12 @@ def _alt_pyr_radial_kernel(f1_ref, f2_ref, x_ref, out_ref, *, scale, bounds,
                 for d in range(kk + 1)]           # each (R, blk)
         for ki in range(kk):
             cols.append(wins[ki] * (1.0 - f) + wins[ki + 1] * f)
+    # Zero channel padding up to the declared output width: a 36-lane
+    # tensor makes the consuming 1x1 conv's fusion read at ~39 GB/s
+    # (measured 60 us/iter); emitting a lane-friendly channel count is
+    # free here and the consumer zero-pads its weights to match.
+    while len(cols) < out_ref.shape[-1]:
+        cols.append(jnp.zeros_like(cols[0]))
     out_ref[...] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
 
 
@@ -255,45 +261,53 @@ def pallas_alt_pyramid_radial_flat(f1flat: jax.Array, f2cat: jax.Array,
                                    x_levels: jax.Array, w2s: tuple,
                                    radius: int,
                                    precision: str = "highest",
-                                   out_dtype=jnp.float32) -> jax.Array:
+                                   out_dtype=jnp.float32,
+                                   out_channels: int = 0) -> jax.Array:
     """Model-pattern variant of :func:`pallas_alt_pyramid_flat`: instead of
     explicit per-tap coordinates it takes the per-level LOCAL center
     ``x_levels`` (B, H, W1, L) and the static ``radius``, and resolves the
     taps ``x + k, k in [-radius, radius]`` with the cheaper shared-fraction
     window kernel.  Output channel order and semantics are identical to the
     general entry with ``taps = x[..., None] + arange(-r, r+1)``
-    (equivalence pinned in tests/test_pallas_alt.py)."""
+    (equivalence pinned in tests/test_pallas_alt.py).
+
+    ``out_channels`` (when > L*K) zero-pads the channel axis in-kernel so
+    consumers read a lane-friendly width (see the kernel comment)."""
     return _make_alt_pyr_radial(f1flat.shape, f2cat.shape, tuple(w2s),
                                 radius, f1flat.dtype.name, f2cat.dtype.name,
-                                precision, jnp.dtype(out_dtype).name)(
-                                    f1flat, f2cat, x_levels)
+                                precision, jnp.dtype(out_dtype).name,
+                                out_channels)(f1flat, f2cat, x_levels)
 
 
 @functools.lru_cache(maxsize=None)
 def _make_alt_pyr_radial(f1flat_shape, f2cat_shape, w2s, radius, f1_dtype,
-                         f2_dtype, precision="highest", out_dtype="float32"):
+                         f2_dtype, precision="highest", out_dtype="float32",
+                         out_channels=0):
     bounds = bounds_from_widths(w2s)
     odt = jnp.dtype(out_dtype)
 
     @jax.custom_vjp
     def f(f1flat, f2cat, x):
         return _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
-                                        precision, odt)
+                                        precision, odt, out_channels)
 
     def fwd(f1flat, f2cat, x):
-        return _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
-                                        precision, odt), (f1flat, f2cat, x)
+        return _alt_pyr_radial_fwd_impl(
+            f1flat, f2cat, x, bounds, radius, precision, odt,
+            out_channels), (f1flat, f2cat, x)
 
     def bwd(res, g):
         f1flat, f2cat, x = res
         # The general backward kernel already handles arbitrary taps; the
         # radial pattern is just its special case, so materialize the taps
-        # (a small XLA broadcast-add on the backward path only).
+        # (a small XLA broadcast-add on the backward path only).  Channel
+        # padding carries no gradient: slice the cotangent back to L*K.
+        lk = x.shape[-1] * (2 * radius + 1)
         offsets = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
         taps = (x.astype(jnp.float32)[..., None] + offsets).reshape(
-            *x.shape[:-1], x.shape[-1] * (2 * radius + 1))
-        df1, df2 = _alt_pyr_bwd_impl(f1flat, f2cat, taps, g, bounds,
-                                     precision)
+            *x.shape[:-1], lk)
+        df1, df2 = _alt_pyr_bwd_impl(f1flat, f2cat, taps, g[..., :lk],
+                                     bounds, precision)
         return (df1[:f1flat.shape[0]].astype(f1_dtype),
                 df2[:f2cat.shape[0]].astype(f2_dtype),
                 jnp.zeros_like(x))
@@ -303,7 +317,8 @@ def _make_alt_pyr_radial(f1flat_shape, f2cat_shape, w2s, radius, f1_dtype,
 
 
 def _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
-                             prec="highest", out_dtype=jnp.float32):
+                             prec="highest", out_dtype=jnp.float32,
+                             out_channels=0):
     f1flat = _pad_rows(f1flat)  # no-ops for preflatten_* outputs
     f2cat = _pad_rows(f2cat)
     n, w1p, c = f1flat.shape
@@ -311,7 +326,7 @@ def _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
     t, blk = _pad_taps(x, n)
     scale = 1.0 / float(c) ** 0.5
     w2cat = f2cat.shape[1]
-    lk = nl * (2 * radius + 1)
+    lk = max(nl * (2 * radius + 1), out_channels)
     r = _BLOCK_ROWS
     out = pl.pallas_call(
         functools.partial(_alt_pyr_radial_kernel, scale=scale, bounds=bounds,

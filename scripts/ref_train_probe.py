@@ -13,10 +13,35 @@ Torch CPU, fp32.  Standalone so the torch stack runs in its own process.
 
 import argparse
 import json
+import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, "/root/reference")
 sys.path.insert(0, "/root/reference/core")
+
+# The reference's augmentor imports torchvision/skimage at module import
+# (core/utils/augmentor.py:7,15); neither is installed nor used on this
+# path — reuse the eval harness's stubs.
+from ref_eval import _stub_modules  # noqa: E402
+
+_stub_modules()
+
+# train_stereo.py:17 imports utils.dataset.BasicDataset, but the reference
+# tree only ships utils/dataset_original.py (no utils/dataset.py) — the
+# import is broken UPSTREAM and the symbol is unused on the optimizer/loss
+# path this probe needs.  Attach a stub SUBMODULE to the real ``utils``
+# package (which resolves to /root/reference/core/utils and must keep
+# working for evaluate_stereo's `from utils.utils import InputPadder`).
+import types  # noqa: E402
+
+import utils  # noqa: E402  (resolves to /root/reference/core/utils)
+
+if "utils.dataset" not in sys.modules:
+    d = types.ModuleType("utils.dataset")
+    d.BasicDataset = object
+    utils.dataset = d
+    sys.modules["utils.dataset"] = d
 
 
 def synth_batches(steps, batch, height, width, seed=0):
