@@ -141,7 +141,9 @@ def pallas_corr_flops_per_iter(model, batch: int, height: int,
     k = 2 * cfg.corr_radius + 1
     hat = 4.0 * n * w1p * k * sum(padded)
     if impl == "pallas_alt":
-        c = 256  # fnet feature channels
+        # fnet feature channels, from the model (not a literal — a config
+        # variant changing the encoder width must not skew MFU silently).
+        c = model.feature_dim
         return 2.0 * n * w1p * w2cat * c + hat
     return hat  # pallas: volume matmul is XLA-side (cost model sees it)
 

@@ -58,6 +58,14 @@ class RAFTStereoConfig:
     # the inputs are fp32 — bf16 corr_dtype always takes the native path.
     corr_precision: str = "highest"
 
+    # Fused Pallas encoder stem (ops/pallas_encoder.py).  None = auto
+    # (enabled on TPU backends, incl. under a partitionable corr mesh via
+    # shard_map); True/False force one numeric path — the fused stage's
+    # instance-norm stats are fp32 kernel sums, which differ from the XLA
+    # stage at stat-precision level (~1e-3 relative on bf16 activations),
+    # so evaluations comparing runs across device counts can pin the path.
+    fused_encoder: Optional[bool] = None
+
     # Rematerialize each GRU iteration in the backward pass (jax.checkpoint
     # on the scan body): activation memory drops from O(iters) to O(1) at the
     # cost of one extra forward per iteration.  Required to fit the reference

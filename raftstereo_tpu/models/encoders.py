@@ -9,7 +9,7 @@ output sits at 1/2^downsample resolution.
 
 from __future__ import annotations
 
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -18,28 +18,51 @@ from .layers import ResidualBlock, conv, make_norm
 
 
 def _stem_layer1(enc, x):
-    """norm1 + relu + layer1, with the fused Pallas fast path on TPU.
+    """conv1 + norm1 + relu + layer1, with the fused Pallas fast path on
+    TPU.  ``x`` is the normalized input image.
 
     The plain path's four layer1 instance norms at flagship resolution
     cost ~21 ms of XLA layout churn (measured — docs/perf_notes_r03.md);
     the fused pipeline (ops/pallas_encoder.py) keeps the whole stage in
-    row-major packed form, consuming conv1's raw output directly (both
-    split points measured E2E — see fused_stem_layer1's docstring).
-    Numerically pinned against this exact module path in
+    row-major packed form.  When conv1 is stride 1 (downsample <= 2) it
+    joins the pipeline as a packed Pallas 7x7 kernel too — removing the
+    XLA-conv <-> row-major boundary relayouts and the 14 TF/s stem conv
+    (round-3 trace) — otherwise the stage consumes conv1's raw XLA output
+    directly.  Numerically pinned against this exact module path in
     tests/test_pallas_encoder.py; init always takes the plain path so the
     parameter tree is identical either way."""
-    from ..ops.pallas_encoder import stem_layer1, use_fused_stem
+    from ..ops.pallas_encoder import (conv1_stem_layer1, stem_layer1,
+                                      use_fused_stem)
 
+    stride = 1 + (enc.downsample > 2)
+    oshape = (x.shape[0], -(-x.shape[1] // stride),
+              -(-x.shape[2] // stride), 64)
     if (not enc.is_initializing()
-            and use_fused_stem(enc.norm_fn, x.shape[2])):
+            and use_fused_stem(enc.norm_fn, oshape, enc.fused_stem)):
         params = {
             "c10": enc.layer1_0.conv1.variables["params"],
             "c11": enc.layer1_0.conv2.variables["params"],
             "c20": enc.layer1_1.conv1.variables["params"],
             "c21": enc.layer1_1.conv2.variables["params"],
         }
-        return stem_layer1(x, params)
-    x = nn.relu(enc.norm1(x))
+        # Pallas conv1 only at small per-shard image counts: measured
+        # same-session A/B at flagship shapes — batch 1 (2 images)
+        # 9.56 -> 9.84 pairs/sec, batch 2 a wash, batch 8 11.87 -> 12.31
+        # for the XLA conv (its blocked lowering amortizes over batch
+        # while the packed K=6 kernel scales linearly).  The 7x7 conv also
+        # needs 3 halo rows from each space-shard neighbor, so each shard
+        # must hold >= 3 rows (ppermute reaches one neighbor only).
+        from ..ops.pallas_encoder import _stem_shard_mesh
+
+        shard = _stem_shard_mesh(oshape)
+        local_imgs = x.shape[0] // (shard[1] if shard is not None else 1)
+        local_h = oshape[1] // (shard[2] if shard is not None else 1)
+        if (stride == 1 and x.shape[-1] == 3 and local_imgs <= 4
+                and local_h >= 3):
+            return conv1_stem_layer1(x, enc.conv1.variables["params"],
+                                     params, enc.dtype)
+        return stem_layer1(enc.conv1(x), params)
+    x = nn.relu(enc.norm1(enc.conv1(x)))
     return enc.layer1_1(enc.layer1_0(x))
 
 
@@ -53,6 +76,9 @@ class BasicEncoder(nn.Module):
     norm_fn: str = "batch"
     downsample: int = 3
     dtype: Any = jnp.float32
+    # Tri-state override of the fused-stem gate (config.fused_encoder):
+    # None = auto (TPU backend), True/False = force one numeric path.
+    fused_stem: Optional[bool] = None
 
     def setup(self):
         d = self.downsample
@@ -67,7 +93,7 @@ class BasicEncoder(nn.Module):
         self.conv2 = conv(self.output_dim, 1, padding=0, dtype=self.dtype)
 
     def __call__(self, x):
-        x = _stem_layer1(self, self.conv1(x))
+        x = _stem_layer1(self, x)
         for blk in (self.layer2_0, self.layer2_1,
                     self.layer3_0, self.layer3_1):
             x = blk(x)
@@ -92,6 +118,7 @@ class MultiBasicEncoder(nn.Module):
     norm_fn: str = "batch"
     downsample: int = 3
     dtype: Any = jnp.float32
+    fused_stem: Optional[bool] = None  # see BasicEncoder.fused_stem
 
     def setup(self):
         d = self.downsample
@@ -133,7 +160,7 @@ class MultiBasicEncoder(nn.Module):
         self.heads32 = heads32
 
     def __call__(self, x, dual_inp: bool = False, num_layers: int = 3):
-        x = _stem_layer1(self, self.conv1(x))
+        x = _stem_layer1(self, x)
         for blk in (self.layer2_0, self.layer2_1,
                     self.layer3_0, self.layer3_1):
             x = blk(x)

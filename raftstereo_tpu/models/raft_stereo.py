@@ -66,7 +66,7 @@ class SharedBackboneHead(nn.Module):
 
     def setup(self):
         self.res = ResidualBlock(128, 128, "instance", 1, self.dtype)
-        self.out = conv(256, 3, dtype=self.dtype)
+        self.out = conv(RAFTStereo.feature_dim, 3, dtype=self.dtype)
 
     def __call__(self, x):
         return self.out(self.res(x))
@@ -95,6 +95,10 @@ class RAFTStereo:
     (reference: core/stereo_datasets.py:77).
     """
 
+    # Correlation feature width emitted by fnet / the shared-backbone head
+    # (reference: core/extractor.py output_dim=256, core/raft_stereo.py:37).
+    feature_dim = 256
+
     def __init__(self, config: RAFTStereoConfig):
         self.config = config
         self.dtype = (jnp.bfloat16 if config.compute_dtype == "bfloat16"
@@ -103,12 +107,13 @@ class RAFTStereo:
         self.cnet = MultiBasicEncoder(
             output_dims=(cfg.hidden_dims, cfg.hidden_dims),
             norm_fn=cfg.context_norm, downsample=cfg.n_downsample,
-            dtype=self.dtype)
+            dtype=self.dtype, fused_stem=cfg.fused_encoder)
         if cfg.shared_backbone:
             self.sb_head = SharedBackboneHead(dtype=self.dtype)
         else:
-            self.fnet = BasicEncoder(output_dim=256, norm_fn="instance",
-                                     downsample=cfg.n_downsample, dtype=self.dtype)
+            self.fnet = BasicEncoder(output_dim=self.feature_dim, norm_fn="instance",
+                                     downsample=cfg.n_downsample, dtype=self.dtype,
+                                     fused_stem=cfg.fused_encoder)
         self.zqr = ContextZQR(cfg, dtype=self.dtype)
         self.update = BasicMultiUpdateBlock(cfg, dtype=self.dtype)
 

@@ -24,6 +24,48 @@ from ..ops.image import avg_pool2x, resize_bilinear_align_corners
 from .layers import conv, kaiming_out
 
 
+# Tri-state override of the tap-matmul head gate (tests force both paths).
+tap_head_override = None
+
+
+def _use_tap_head(batch: int) -> bool:
+    """The tap-matmul form of the narrow 3x3 head conv is a TPU fix (N=2
+    output channels waste the MXU's 128 N-lanes — measured 3.5 TF/s,
+    costing as much as a 256->128 conv; docs/perf_notes_r03.md).  CPU/GPU
+    keep the plain conv, as do large batches: measured same-session A/B at
+    flagship shapes, batch 1 9.80 -> 10.45 pairs/sec (+6.6%), realtime
+    105.7 -> 108.7, but batch 8 11.87 -> 11.47 (the 9-slice shift-add
+    epilogue loses to the conv's batch amortization)."""
+    if tap_head_override is not None:
+        return tap_head_override
+    return jax.default_backend() == "tpu" and batch <= 2
+
+
+def tap_conv3x3(conv_mod, y):
+    """A bound SAME-padded 3x3 nn.Conv with FEW output channels, computed
+    as one 1x1 matmul into kh*kw*co per-tap channels + a 9-slice shift-add.
+
+    o[p] = sum_t K[t] . y[p + t - 1]  ==  sum_t z_t[p + t - 1] where
+    z_t = y . K[t] is pointwise — so one (ci -> 9*co) matmul (padded to a
+    full MXU N-tile instead of 2/128 lanes) replaces the narrow conv, and
+    the taps are combined by 9 shifted adds of a (B, H, W, 9*co) tensor
+    that is ~28x smaller than the conv's input."""
+    p = conv_mod.variables["params"]
+    k = p["kernel"]
+    kh, kw, ci, co = k.shape
+    assert (kh, kw) == (3, 3), (kh, kw)
+    w = k.transpose(2, 0, 1, 3).reshape(ci, kh * kw * co).astype(y.dtype)
+    z = jnp.tensordot(y, w, 1)
+    zp = jnp.pad(z, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    h, wd = y.shape[1], y.shape[2]
+    o = None
+    for t in range(kh * kw):
+        dy, dx = divmod(t, kw)
+        s = zp[:, dy:dy + h, dx:dx + wd, t * co:(t + 1) * co]
+        o = s if o is None else o + s
+    return o + p["bias"].astype(y.dtype)
+
+
 class FlowHead(nn.Module):
     """3x3 conv -> relu -> 3x3 conv (reference: core/update.py:6-14).
     Output stays 2-channel for weight parity; the model uses channel 0."""
@@ -37,13 +79,38 @@ class FlowHead(nn.Module):
         self.conv2 = conv(self.output_dim, 3, dtype=self.dtype)
 
     def __call__(self, x):
-        return self.conv2(nn.relu(self.conv1(x)))
+        y = nn.relu(self.conv1(x))
+        if self.is_initializing() or not _use_tap_head(x.shape[0]):
+            return self.conv2(y)
+        return tap_conv3x3(self.conv2, y)
+
+    def from_hidden(self, y):
+        """Head output from an already-computed relu(conv1(x)) activation
+        (the merged-head path in BasicMultiUpdateBlock)."""
+        if _use_tap_head(y.shape[0]):
+            return tap_conv3x3(self.conv2, y)
+        return self.conv2(y)
 
 
 def _sliced_conv(conv_mod, x, lo, hi, bias=True):
     """Apply a bound nn.Conv on an input-channel SLICE of its kernel:
     out = conv(x; kernel[:, :, lo:hi]) (+ bias).  Summing the slices over
-    a channel partition equals the conv of the concatenated input."""
+    a channel partition equals the conv of the concatenated input.
+
+    Assumes the wrapped conv's default geometry/precision — asserted so a
+    future nn.Conv change fails loudly instead of silently diverging.
+    No ``preferred_element_type``, matching the flax path it replaces: in
+    bf16 mode both emit bf16 gate pre-activations (MXU-internal fp32
+    accumulation, rounded at the output) — intentional, covered by the
+    bf16 torch-parity configs in tests/test_torch_parity.py."""
+    def _pair(v):
+        return (v, v) if v is None or isinstance(v, int) else tuple(v)
+
+    assert _pair(conv_mod.strides) in ((1, 1), (None, None)), conv_mod.strides
+    assert _pair(conv_mod.kernel_dilation) in ((1, 1), (None, None)), \
+        conv_mod.kernel_dilation
+    assert conv_mod.precision is None, conv_mod.precision
+    assert conv_mod.feature_group_count == 1
     p = conv_mod.variables["params"]
     k = p["kernel"][:, :, lo:hi]
     pad = conv_mod.padding
@@ -264,6 +331,18 @@ class BasicMultiUpdateBlock(nn.Module):
         if not update:
             return net
 
+        if with_mask and not self.is_initializing():
+            # Train mode: flow_head.conv1 and mask_conv1 are both 3x3
+            # 128->256 convs on net[0]; one merged 128->512 conv (kernels
+            # concatenated along the output axis — per-channel arithmetic
+            # unchanged, parameters untouched) halves the net[0] HBM reads
+            # and conv launches in the loop body.
+            y = self._merged_head_hidden(net[0])
+            hd = self.flow_head.hidden_dim
+            delta = self.flow_head.from_hidden(y[..., :hd])
+            mask = 0.25 * self.mask_conv2(y[..., hd:])
+            return net, mask, delta
+
         delta = self.flow_head(net[0])
         if not with_mask:
             # Test-mode scan bodies skip the mask head: only the FINAL
@@ -273,6 +352,19 @@ class BasicMultiUpdateBlock(nn.Module):
             # at flagship shapes (docs/perf_notes_r03.md).
             return net, None, delta
         return net, self.upsample_mask(net[0]), delta
+
+    def _merged_head_hidden(self, net0: jax.Array) -> jax.Array:
+        """relu of the concatenated flow/mask first-stage convs on net[0],
+        as ONE conv: [relu(flow.conv1(x)), relu(mask_conv1(x))]."""
+        pf = self.flow_head.conv1.variables["params"]
+        pm = self.mask_conv1.variables["params"]
+        x = net0
+        k = jnp.concatenate([pf["kernel"], pm["kernel"]], axis=-1)
+        b = jnp.concatenate([pf["bias"], pm["bias"]])
+        y = jax.lax.conv_general_dilated(
+            x, k.astype(x.dtype), (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return nn.relu(y + b.astype(x.dtype))
 
     def upsample_mask(self, net0: jax.Array) -> jax.Array:
         """Convex-upsampling mask from the finest GRU state.  0.25 scaling

@@ -50,32 +50,66 @@ from .pallas_norm import _row_block
 
 
 # None = auto (fused on TPU backends); True/False force — tests force True
-# to exercise the interpret-mode kernels on CPU.
+# to exercise the interpret-mode kernels on CPU, and config.fused_encoder
+# forwards a per-model override (so evaluations can pin one numeric path).
 fused_stem_override = None
 
 
-def use_fused_stem(norm_fn: str, width: int) -> bool:
+def _stem_shard_mesh(shape):
+    """The active (data, space) mesh if the fused stage can partition over
+    it via ``shard_map``: B divisible by ``data``, H by ``space`` with >= 2
+    rows per shard (each conv needs one real halo row per boundary).
+    Returns (mesh, data, space) or None (plain single-device lowering)."""
+    import warnings
+
+    from ..parallel.context import active_corr_mesh
+    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
+
+    mesh = active_corr_mesh()
+    if mesh is None:
+        return None
+    b, h = shape[0], shape[1]
+    d = mesh.shape.get(DATA_AXIS, 1)
+    s = mesh.shape.get(SPACE_AXIS, 1)
+    if d * s == 1:
+        return None
+    if b % d or h % s or (h // s) < 2:
+        warnings.warn(
+            f"fused encoder stage cannot partition over the active mesh "
+            f"(batch {b} % data {d}, height {h} % space {s}); using the "
+            f"plain XLA stage", RuntimeWarning, stacklevel=3)
+        return None
+    return mesh, d, s
+
+
+def use_fused_stem(norm_fn: str, shape, override=None) -> bool:
     """Gate for the fused stage: instance norm, even width, TPU backend
     (the kernels interpret on CPU for tests, but the plain XLA path is the
     sane CPU default).
 
-    Sharding: a bare pallas_call cannot be SPMD-partitioned, so the fused
-    stage must never sit inside a partitioned program.  It is disabled
-    under an active corr mesh (the evaluator/train paths) AND whenever
-    more than one device is visible — a user may jit with shardings
-    directly, without the use_corr_mesh context, and the plain XLA stage
-    (which XLA partitions with halo exchanges) must remain what they get.
-    Single-device hosts cannot shard, so the gate is exact there; a
-    shard_map wrapper is the future multi-chip path."""
+    Sharding: a bare pallas_call cannot be SPMD-partitioned, so under an
+    active corr mesh (the evaluator / train / dryrun paths) the stage runs
+    inside ``shard_map`` over the mesh's (data, space) axes — see
+    ``_fused_forward`` — and the gate only asks that the shapes divide.
+    With >1 devices visible but NO mesh context the gate stays off: a user
+    may jit with shardings directly, and the plain XLA stage (which XLA
+    partitions with halo exchanges) must remain what they get.
+
+    ``override`` (tri-state, from config.fused_encoder) wins over the
+    module-level ``fused_stem_override``, which wins over backend auto."""
+    ok = norm_fn == "instance" and shape[2] % 2 == 0
+    if not ok:
+        return False
+    ov = override if override is not None else fused_stem_override
+    if _stem_shard_mesh(shape) is not None:
+        return ov if ov is not None else jax.default_backend() == "tpu"
     from ..parallel.context import active_corr_mesh
 
-    ok = norm_fn == "instance" and width % 2 == 0
-    if active_corr_mesh() is not None:  # None for trivial 1-device meshes
-        return False
-    if fused_stem_override is not None:
-        return fused_stem_override and ok
-    return (ok and jax.default_backend() == "tpu"
-            and len(jax.devices()) == 1)
+    if active_corr_mesh() is not None:
+        return False  # mesh active but not partitionable (warned above)
+    if ov is not None:
+        return ov
+    return jax.default_backend() == "tpu" and len(jax.devices()) == 1
 
 
 # --------------------------------------------------------------- packing
@@ -122,7 +156,9 @@ def pack_vec(v: jax.Array) -> jax.Array:
 def stats_from_packed(s1: jax.Array, s2: jax.Array, n: float
                       ) -> Tuple[jax.Array, jax.Array]:
     """Packed (B, 1, 2C) fp32 sums -> per-original-channel (B, 1, C)
-    mean / rstd (parity halves sum exactly: they partition the pixels)."""
+    mean / rstd (parity halves sum exactly: they partition the pixels).
+    E[x^2]-m^2 precision envelope: see pallas_norm._pallas_forward and
+    tests/test_pallas_encoder.py::TestStatsPrecisionEnvelope."""
     c = s1.shape[-1] // 2
     t1 = s1[..., :c] + s1[..., c:]
     t2 = s2[..., :c] + s2[..., c:]
@@ -140,17 +176,21 @@ def _prep(x, m_ref, s_ref):
     return jnp.maximum((x - m) * s, 0)
 
 
-def _edge_mask_halo(th):
+def _edge_mask_halo(th, hv_ref):
     """Zero the prepped halo rows that lie OUTSIDE the image: conv zero
     padding applies in the PREPPED domain, but prepping a zero-filled edge
-    halo yields relu(-m*s) != 0.  Row 0 (above) is outside at the first
-    row-block, row 1 (below) at the last."""
+    halo yields relu(-m*s) != 0.  Validity comes from an (nblk, 2) SMEM
+    operand (whole array per block, row selected by program_id — Mosaic
+    requires non-divisible block dims to equal the array dims) rather than
+    a program_id comparison so that under space sharding a shard-boundary
+    halo (a REAL neighbor row delivered by ppermute) is kept while a
+    global image edge is still masked."""
     j = pl.program_id(1)
     # Scalar multiplies, not a stacked bool mask: Mosaic cannot shape-cast
     # a vector<2xi1> to the broadcast rank.  Edge halo values are finite
     # (prep of a zero row), so multiply-by-zero is exact.
-    top = th[:, 0:1] * (j > 0).astype(th.dtype)
-    bot = th[:, 1:2] * (j < pl.num_programs(1) - 1).astype(th.dtype)
+    top = th[:, 0:1] * hv_ref[j, 0].astype(th.dtype)
+    bot = th[:, 1:2] * hv_ref[j, 1].astype(th.dtype)
     return jnp.concatenate([top, bot], axis=1)
 
 
@@ -189,11 +229,11 @@ def _conv_packed(t, halo, w_ref, bias_ref, wp):
     return y + bias_ref[...][:, :, None, :]
 
 
-def _enc_conv_kernel(x_ref, xh_ref, m_ref, s_ref, w_ref, b_ref,
+def _enc_conv_kernel(x_ref, xh_ref, m_ref, s_ref, w_ref, b_ref, hv_ref,
                      y_ref, s1_ref, s2_ref, *, wp):
     """prep(x) -> packed conv -> raw y + packed output stats."""
     t = _prep(x_ref[...], m_ref, s_ref)
-    th = _edge_mask_halo(_prep(xh_ref[...][:, 0], m_ref, s_ref))
+    th = _edge_mask_halo(_prep(xh_ref[...][:, 0], m_ref, s_ref), hv_ref)
     y = _conv_packed(t, th, w_ref, b_ref, wp)
     y_ref[...] = y.astype(y_ref.dtype)
 
@@ -208,7 +248,7 @@ def _enc_conv_kernel(x_ref, xh_ref, m_ref, s_ref, w_ref, b_ref,
 
 def _enc_conv_res_kernel(x_ref, xh_ref, m_ref, s_ref,
                          r_ref, rh_ref, rm_ref, rs_ref,
-                         w_ref, b_ref, y_ref, s1_ref, s2_ref, *, wp):
+                         w_ref, b_ref, hv_ref, y_ref, s1_ref, s2_ref, *, wp):
     """Residual-block boundary: the conv input is
     relu( prep(res_raw) + prep(x_raw) ) — both tensors arrive RAW with
     their stats and are normalized in-register."""
@@ -216,7 +256,7 @@ def _enc_conv_res_kernel(x_ref, xh_ref, m_ref, s_ref,
                     + _prep(x_ref[...], m_ref, s_ref), 0)
     th = _edge_mask_halo(
         jnp.maximum(_prep(rh_ref[...][:, 0], rm_ref, rs_ref)
-                    + _prep(xh_ref[...][:, 0], m_ref, s_ref), 0))
+                    + _prep(xh_ref[...][:, 0], m_ref, s_ref), 0), hv_ref)
     y = _conv_packed(t, th, w_ref, b_ref, wp)
     y_ref[...] = y.astype(y_ref.dtype)
 
@@ -242,26 +282,43 @@ def _enc_finish_kernel(y1_ref, m1_ref, s1_ref, c11_ref, m11_ref, s11_ref,
 
 # ------------------------------------------------------------- host side
 
-def _halo_rows(x: jax.Array, r: int) -> jax.Array:
+def _halo_rows(x: jax.Array, r: int, boundary=None) -> jax.Array:
     """(B, H, Wp, C2) -> (B, H//r, 2, Wp, C2): rows above/below each
-    r-row block (zeros at image edges); strided slices, ~2/r of a pass."""
+    r-row block; strided slices, ~2/r of a pass.  ``boundary`` provides the
+    (above, below) rows at the local-array edges — the space-sharding path
+    passes the neighbor shards' edge rows (from ppermute); default zeros
+    (the image edge, masked in-kernel by the halo-validity operand)."""
     b, h, wp, c2 = x.shape
     nblk = h // r
-    zero = jnp.zeros((b, 1, wp, c2), x.dtype)
-    top = jnp.concatenate([zero, x[:, r - 1::r][:, : nblk - 1]], axis=1)
-    bot = jnp.concatenate([x[:, r::r], zero], axis=1)
+    if boundary is None:
+        above = below = jnp.zeros((b, 1, wp, c2), x.dtype)
+    else:
+        above, below = boundary
+    top = jnp.concatenate([above, x[:, r - 1::r][:, : nblk - 1]], axis=1)
+    bot = jnp.concatenate([x[:, r::r], below], axis=1)
     return jnp.stack([top, bot], axis=2)
 
 
-def _enc_conv(x, stats, w9, bias, res=None, res_stats=None):
+def _default_hv(nblk: int) -> jax.Array:
+    """Halo validity for the unsharded case: only the image edges invalid."""
+    return (jnp.ones((nblk, 2), jnp.float32)
+            .at[0, 0].set(0.0).at[nblk - 1, 1].set(0.0))
+
+
+def _enc_conv(x, stats, w9, bias, res=None, res_stats=None,
+              hv=None, boundary=None, res_boundary=None):
     """One fused prep+conv+stats call on packed arrays.
 
     x: (B, H, Wp, C2) raw; stats: (mean, rstd) each (B, 1, C2) packed;
-    w9: (9, C2, C2); bias: (1, 1, C2).  Returns (y_raw fp-of-x, (s1, s2))."""
+    w9: (9, C2, C2); bias: (1, 1, C2); hv: (H//r, 2) halo validity;
+    boundary / res_boundary: neighbor edge rows under space sharding.
+    Returns (y_raw fp-of-x, (s1, s2))."""
     b, h, wp, c2 = x.shape
     r = _row_block(h)
     grid = (b, h // r)
-    xh = _halo_rows(x, r)
+    xh = _halo_rows(x, r, boundary)
+    if hv is None:
+        hv = _default_hv(h // r)
     m, s = stats
 
     def row_spec():
@@ -280,20 +337,22 @@ def _enc_conv(x, stats, w9, bias, res=None, res_stats=None):
                          memory_space=pltpu.VMEM)
     bspec = pl.BlockSpec((1, 1, c2), lambda i, j: (0, 0, 0),
                          memory_space=pltpu.VMEM)
+    hvspec = pl.BlockSpec(hv.shape, lambda i, j: (0, 0),
+                          memory_space=pltpu.SMEM)
 
     if res is None:
         kernel = functools.partial(_enc_conv_kernel, wp=wp)
-        operands = (x, xh, m, s, w9, bias[None, None, :])
+        operands = (x, xh, m, s, w9, bias[None, None, :], hv)
         in_specs = [row_spec(), halo_spec(), stat_spec(), stat_spec(),
-                    wspec, bspec]
+                    wspec, bspec, hvspec]
     else:
         rm, rs = res_stats
-        rh = _halo_rows(res, r)
+        rh = _halo_rows(res, r, res_boundary)
         kernel = functools.partial(_enc_conv_res_kernel, wp=wp)
-        operands = (x, xh, m, s, res, rh, rm, rs, w9, bias[None, None, :])
+        operands = (x, xh, m, s, res, rh, rm, rs, w9, bias[None, None, :], hv)
         in_specs = [row_spec(), halo_spec(), stat_spec(), stat_spec(),
                     row_spec(), halo_spec(), stat_spec(), stat_spec(),
-                    wspec, bspec]
+                    wspec, bspec, hvspec]
 
     y, s1, s2 = pl.pallas_call(
         kernel,
@@ -333,13 +392,19 @@ def _packed_stats(x):
     )(x)
 
 
-def _expand_stats(s1, s2, n):
-    """Packed sums -> packed (mean, rstd) duplicated over parities."""
+def _expand_stats(s1, s2, n, axis_name=None):
+    """Packed sums -> packed (mean, rstd) duplicated over parities.
+    ``axis_name``: psum the partial sums over that mesh axis first (space
+    sharding — instance-norm statistics span the whole image height)."""
+    if axis_name is not None:
+        s1 = jax.lax.psum(s1, axis_name)
+        s2 = jax.lax.psum(s2, axis_name)
     mean, rstd = stats_from_packed(s1, s2, n)
     return pack_vec(mean), pack_vec(rstd)
 
 
-def fused_stem_layer1(y1_raw: jax.Array, params: dict) -> jax.Array:
+def fused_stem_layer1(y1_raw: jax.Array, params: dict, n=None,
+                      space_axis=None, space_size=1) -> jax.Array:
     """norm1 + relu + layer1 (two ResidualBlocks), fused, from conv1's RAW
     output (B, H, W, 64), any even W.
 
@@ -352,28 +417,68 @@ def fused_stem_layer1(y1_raw: jax.Array, params: dict) -> jax.Array:
     params: {"c10","c11","c20","c21"} -> {"kernel": (3,3,64,64),
     "bias": (64,)} — layer1_0.conv1/conv2, layer1_1.conv1/conv2.
     Returns the stage output in the final (post-relu) domain.
+
+    Space sharding (``space_axis`` set, called inside shard_map): the
+    array is an H-shard; stats psum over the axis, each conv's shard-edge
+    halo row arrives from the neighbor by ppermute, and the halo-validity
+    operand keeps those rows while still masking the global image edges.
+    ``n`` is the GLOBAL H*W pixel count (defaults to the local shape's).
     """
     xp = pack_view(y1_raw)
-    n = float(y1_raw.shape[1] * y1_raw.shape[2])
-    dt = y1_raw.dtype
+    if n is None:
+        n = float(y1_raw.shape[1] * y1_raw.shape[2])
+    st1 = _expand_stats(*_packed_stats(xp), n, space_axis)
+    return _stage_on_packed(xp, st1, params, n, space_axis, space_size)
+
+
+def _shard_ctx(nblk: int, space_axis, space_size: int, rows: int = 1):
+    """(halo-validity array, edge-row exchange fn) for one stage geometry.
+    ``rows``: how many boundary rows each conv needs from the neighbor."""
+    if space_axis is None:
+        return _default_hv(nblk), lambda t: None
+    idx = jax.lax.axis_index(space_axis)
+    hv = (jnp.ones((nblk, 2), jnp.float32)
+          .at[0, 0].set((idx > 0).astype(jnp.float32))
+          .at[nblk - 1, 1].set((idx < space_size - 1)
+                               .astype(jnp.float32)))
+    fwd = [(i, i + 1) for i in range(space_size - 1)]
+    bwd = [(i + 1, i) for i in range(space_size - 1)]
+
+    def exch(t):
+        # Neighbor edge rows: shards with no source (global image
+        # edges) receive zeros, which the hv operand masks anyway
+        # (or, for the raw-image conv1 path, ARE the zero padding).
+        above = jax.lax.ppermute(t[:, -rows:], space_axis, fwd)
+        below = jax.lax.ppermute(t[:, :rows], space_axis, bwd)
+        return above, below
+
+    return hv, exch
+
+
+def _stage_on_packed(xp, st1, params, n, space_axis=None, space_size=1):
+    """The four fused convs + finish kernel, from the packed raw stage
+    input ``xp`` and its already-computed packed stats ``st1``."""
+    dt = xp.dtype
+    b, h, wp, c2 = xp.shape
+    r = _row_block(h)
+    nblk = h // r
+    hv, exch = _shard_ctx(nblk, space_axis, space_size)
 
     def pw(name):
         return (pack_weights(params[name]["kernel"]).astype(dt),
                 pack_vec(params[name]["bias"]).astype(dt))
 
-    st1 = _expand_stats(*_packed_stats(xp), n)
-    c10, s10 = _enc_conv(xp, st1, *pw("c10"))
-    st10 = _expand_stats(*s10, n)
-    c11, s11 = _enc_conv(c10, st10, *pw("c11"))
-    st11 = _expand_stats(*s11, n)
+    xb = exch(xp)
+    c10, s10 = _enc_conv(xp, st1, *pw("c10"), hv=hv, boundary=xb)
+    st10 = _expand_stats(*s10, n, space_axis)
+    c11, s11 = _enc_conv(c10, st10, *pw("c11"), hv=hv, boundary=exch(c10))
+    st11 = _expand_stats(*s11, n, space_axis)
     # block boundary: input of layer1_1.conv1 is relu(t0 + u2)
-    c20, s20 = _enc_conv(c11, st11, *pw("c20"), res=xp, res_stats=st1)
-    st20 = _expand_stats(*s20, n)
-    c21, s21 = _enc_conv(c20, st20, *pw("c21"))
-    st21 = _expand_stats(*s21, n)
-
-    b, h, wp, c2 = xp.shape
-    r = _row_block(h)
+    c20, s20 = _enc_conv(c11, st11, *pw("c20"), res=xp, res_stats=st1,
+                         hv=hv, boundary=exch(c11), res_boundary=xb)
+    st20 = _expand_stats(*s20, n, space_axis)
+    c21, s21 = _enc_conv(c20, st20, *pw("c21"), hv=hv, boundary=exch(c20))
+    st21 = _expand_stats(*s21, n, space_axis)
 
     def row_spec():
         return pl.BlockSpec((1, r, wp, c2), lambda i, j: (i, j, 0, 0),
@@ -395,6 +500,189 @@ def fused_stem_layer1(y1_raw: jax.Array, params: dict) -> jax.Array:
         compiler_params=_COMPILER_PARAMS,
     )(xp, *st1, c11, *st11, c21, *st21)
     return unpack_view(out)
+
+
+# --------------------------------------------- fused 7x7 stem conv (conv1)
+
+def pack_weights7(w: jax.Array) -> jax.Array:
+    """(7, 7, 3, 64) HWIO conv1 weights -> (7, 5, 6, 128) packed
+    [dy, dp+2]: output pixel 2p+po with tap dx reads packed column p+dp,
+    parity pi, where dp = floor((po+dx)/2) in [-2, 2], pi = (po+dx) mod 2
+    (same construction as pack_weights, 7 dx taps instead of 3)."""
+    kh, kw, ci, co = w.shape
+    out = jnp.zeros((kh, 5, 2 * ci, 2 * co), w.dtype)
+    for po in range(2):
+        for dxi, dx in enumerate(range(-3, 4)):
+            dp = (po + dx) // 2
+            pi = (po + dx) % 2
+            out = out.at[:, dp + 2,
+                         pi * ci:(pi + 1) * ci,
+                         po * co:(po + 1) * co].set(w[:, dxi])
+    return out
+
+
+def _stem7_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, s1_ref, s2_ref, *,
+                  wp, rows):
+    """7x7 stride-1 packed conv of the RAW input image tile + fp32 output
+    stats (for norm1).  No prep/halo masking: the input is the [-1, 1]
+    image itself, so zero halo rows ARE the conv's zero padding."""
+    t = x_ref[...]                     # (1, R, Wp, 6)
+    th = xh_ref[...][:, 0]             # (1, 6, Wp, 6): 3 above, 3 below
+    full = jnp.concatenate([th[:, :3], t, th[:, 3:]], axis=1)
+    w = w_ref[...]
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, wp, 1), 2)
+    y = None
+    for dpi in range(5):
+        u = None
+        for dyi in range(7):
+            m = jax.lax.dot_general(
+                full[:, dyi:dyi + rows], w[dyi, dpi],
+                (((3,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            u = m if u is None else u + m
+        o = dpi - 2
+        if o == 0:
+            shifted = u
+        else:
+            shifted = pltpu.roll(u, (-o) % wp, 2)
+            if o > 0:
+                shifted = jnp.where(col < wp - o, shifted, 0.0)
+            else:
+                shifted = jnp.where(col >= -o, shifted, 0.0)
+        y = shifted if y is None else y + shifted
+    y = y + b_ref[...][:, :, None, :]
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref[...])
+        s2_ref[...] = jnp.zeros_like(s2_ref[...])
+
+    s1_ref[...] += jnp.sum(y, axis=(1, 2))[:, None, :]
+    s2_ref[...] += jnp.sum(y * y, axis=(1, 2))[:, None, :]
+
+
+def _halo_rows3(x: jax.Array, r: int, boundary=None) -> jax.Array:
+    """(B, H, Wp, C) -> (B, H//r, 6, Wp, C): the 3 rows above and 3 below
+    each r-row block (zeros at local-array edges unless ``boundary``
+    provides the neighbor shards' 3 edge rows each way)."""
+    b, h, wp, c = x.shape
+    nblk = h // r
+    if boundary is None:
+        above = below = jnp.zeros((b, 3, wp, c), x.dtype)
+    else:
+        above, below = boundary
+    xpad_t = jnp.concatenate([above, x[:, : (nblk - 1) * r]], axis=1)
+    xpad_b = jnp.concatenate([x[:, r:], below], axis=1)
+    tops = [xpad_t[:, k::r][:, :nblk] for k in range(3)]
+    bots = [xpad_b[:, k::r][:, :nblk] for k in range(3)]
+    return jnp.stack(tops + bots, axis=2)
+
+
+def _stem_conv1(img, c1_params, dt, boundary=None):
+    """Pallas conv1: (B, H, W, 3) [-1,1] image -> packed raw conv1 output
+    (B, H, Wp, 128) + packed fp32 (sum, sumsq) output stats, one pass.
+    Requires stride 1 (downsample <= 2) and W % 2 == 0."""
+    xp = pack_view(img.astype(dt))                 # (B, H, W/2, 6)
+    b, h, wp, c2 = xp.shape
+    r = _row_block(h)
+    grid = (b, h // r)
+    xh = _halo_rows3(xp, r, boundary)
+    w7 = pack_weights7(c1_params["kernel"]).astype(dt)
+    bias = pack_vec(c1_params["bias"]).astype(dt)[None, None, :]
+    co2 = w7.shape[-1]
+
+    y, s1, s2 = pl.pallas_call(
+        functools.partial(_stem7_kernel, wp=wp, rows=r),
+        out_shape=(jax.ShapeDtypeStruct((b, h, wp, co2), dt),
+                   jax.ShapeDtypeStruct((b, 1, co2), jnp.float32),
+                   jax.ShapeDtypeStruct((b, 1, co2), jnp.float32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, r, wp, c2), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 6, wp, c2), lambda i, j: (i, j, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(w7.shape, lambda i, j: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, co2), lambda i, j: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec((1, r, wp, co2), lambda i, j: (i, j, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 1, co2), lambda i, j: (i, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 1, co2), lambda i, j: (i, 0, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )(xp, xh, w7, bias)
+    return y, (s1, s2)
+
+
+def _fused_forward1(img, c1_params, params, dt):
+    """conv1 + stage, fused end to end; shard_map'd like _fused_forward."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
+
+    def local(im, c1p, p, space_axis=None, space_size=1, n=None):
+        if n is None:
+            n = float(im.shape[1] * im.shape[2])
+        _, exch3 = _shard_ctx(1, space_axis, space_size, rows=3)
+        imp = pack_view(im.astype(dt))
+        yb = exch3(imp) if space_axis is not None else None
+        yp, sums = _stem_conv1(im, c1p, dt, boundary=yb)
+        st1 = _expand_stats(*sums, n, space_axis)
+        return _stage_on_packed(yp, st1, p, n, space_axis, space_size)
+
+    shard = _stem_shard_mesh(img.shape)
+    if shard is None:
+        return local(img, c1_params, params)
+    mesh, d, s = shard
+    n = float(img.shape[1] * img.shape[2])
+    spec = P(DATA_AXIS, SPACE_AXIS, None, None)
+    fn = functools.partial(local, n=n,
+                           space_axis=SPACE_AXIS if s > 1 else None,
+                           space_size=s)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, P(), P()),
+                         out_specs=spec, check_vma=False)(
+                             img, c1_params, params)
+
+
+def _xla_conv1(img, c1_params, dt):
+    """Plain-XLA conv1 (7x7 stride-1 SAME) — backward linearization."""
+    x = img.astype(dt)
+    y = jax.lax.conv_general_dilated(
+        x, c1_params["kernel"].astype(dt), (1, 1), ((3, 3), (3, 3)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(dt) + c1_params["bias"].astype(dt)
+    return y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def conv1_stem_layer1(img, c1_params, params, dt=jnp.float32):
+    """Fused conv1 + norm1 + layer1 from the normalized input image.
+    Forward is all-Pallas (one boundary: the image read); backward is the
+    XLA reference formulation's VJP on global arrays."""
+    return _fused_forward1(img, c1_params, params, dt)
+
+
+def _fwd1(img, c1_params, params, dt):
+    return _fused_forward1(img, c1_params, params, dt), (img, c1_params,
+                                                         params)
+
+
+def _bwd1(dt, residuals, g):
+    img, c1_params, params = residuals
+    _, vjp = jax.vjp(
+        lambda im, c1p, p: _xla_reference(_xla_conv1(im, c1p, dt), p),
+        img, c1_params, params)
+    return vjp(g)
+
+
+conv1_stem_layer1.defvjp(_fwd1, _bwd1)
 
 
 # ------------------------------------------------- reference + custom VJP
@@ -420,14 +708,42 @@ def _xla_reference(y1_raw, params):
     return jnp.maximum(t1 + v2, 0)
 
 
+def _fused_forward(y1_raw, params):
+    """The fused pipeline, shard_map'd over the active (data, space) mesh
+    when one is set (parallel/context.py) and partitionable.
+
+    Batch sharding needs no communication (instance-norm stats are
+    per-image); space sharding adds a stats psum + 2 ppermute'd halo rows
+    per conv — both tiny next to the conv work.  The trace-time mesh
+    consult mirrors ops/corr.py's Pallas backends."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
+
+    shard = _stem_shard_mesh(y1_raw.shape)
+    if shard is None:
+        return fused_stem_layer1(y1_raw, params)
+    mesh, d, s = shard
+    n = float(y1_raw.shape[1] * y1_raw.shape[2])
+    spec = P(DATA_AXIS, SPACE_AXIS, None, None)
+    fn = functools.partial(fused_stem_layer1, n=n,
+                           space_axis=SPACE_AXIS if s > 1 else None,
+                           space_size=s)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, P()),
+                         out_specs=spec, check_vma=False)(y1_raw, params)
+
+
 @jax.custom_vjp
 def stem_layer1(y1_raw: jax.Array, params: dict) -> jax.Array:
-    """Fused forward; XLA-reference backward (see module docstring)."""
-    return fused_stem_layer1(y1_raw, params)
+    """Fused forward; XLA-reference backward (see module docstring).
+    The backward runs on the GLOBAL arrays as plain XLA ops, so under a
+    mesh GSPMD partitions it (conv halo exchanges included) without any
+    manual collectives."""
+    return _fused_forward(y1_raw, params)
 
 
 def _fwd(y1_raw, params):
-    return fused_stem_layer1(y1_raw, params), (y1_raw, params)
+    return _fused_forward(y1_raw, params), (y1_raw, params)
 
 
 def _bwd(residuals, g):

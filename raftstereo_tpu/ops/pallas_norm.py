@@ -105,7 +105,11 @@ def _pallas_forward(x, relu):
     mean = s1 / n
     # E[x^2] - m^2 in fp32: with bf16 inputs the input quantization
     # (~3e-3 relative) dominates any fp32 cancellation; clamped for the
-    # pathological all-constant case.
+    # pathological all-constant case.  Measured fp32 envelope
+    # (tests/test_pallas_encoder.py::TestStatsPrecisionEnvelope): rstd
+    # error < 1e-4 at |mean|/std=10, < 1% at |mean|/std=100 — encoder
+    # activations stay under ~10; a centered second pass would cost a
+    # full extra HBM read of the tensor for precision no consumer needs.
     var = jnp.maximum(s2 / n - mean * mean, 0.0)
     rstd = jax.lax.rsqrt(var + 1e-5)
     return pl.pallas_call(

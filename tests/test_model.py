@@ -290,3 +290,58 @@ class TestFusedGRUConv:
         with pytest.raises(Exception) as ei:
             load_weights(str(tmp_path / "w"), like)
         assert "fused GRU gate conv" not in str(ei.value)
+
+
+class TestHeadFastForms:
+    """The two loop-body head rewrites (models/update.py): the tap-matmul
+    3x3->2 conv and the merged flow/mask first-stage conv must match the
+    plain formulations they replace."""
+
+    def test_tap_conv3x3_matches_conv(self, rng):
+        from raftstereo_tpu.models import update as upd
+
+        head = upd.FlowHead(hidden_dim=32, output_dim=2)
+        x = jnp.asarray(rng.normal(size=(2, 12, 18, 16)).astype(np.float32))
+        v = head.init(jax.random.key(0), x)
+        upd.tap_head_override = False
+        try:
+            plain = head.apply(v, x)
+        finally:
+            upd.tap_head_override = None
+        upd.tap_head_override = True
+        try:
+            tap = head.apply(v, x)
+        finally:
+            upd.tap_head_override = None
+        np.testing.assert_allclose(np.asarray(tap), np.asarray(plain),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_train_mode_merged_head_matches_plain(self, default_model, rng):
+        """Train-mode forward (merged head path) vs a manual per-iteration
+        upsample_mask/flow_head recomputation is covered transitively by
+        test_test_mode_final_equals_train_mode_last; here pin the merged
+        conv helper directly against the two separate convs."""
+        from raftstereo_tpu.models import update as upd
+
+        cfg = RAFTStereoConfig()
+        blk = upd.BasicMultiUpdateBlock(cfg)
+        h, w = 16, 24
+        net = [jnp.asarray(rng.normal(size=(1, h // (2 ** i), w // (2 ** i),
+                                            128)).astype(np.float32))
+               for i in range(3)]
+        inp = [tuple(jnp.zeros_like(n) for _ in range(3)) for n in net]
+        corr = jnp.asarray(rng.normal(size=(1, h, w, cfg.cor_planes))
+                           .astype(np.float32))
+        flow = jnp.zeros((1, h, w, 2), jnp.float32)
+        v = blk.init(jax.random.key(0), net, inp, corr, flow)
+
+        _, mask_m, delta_m = blk.apply(v, net, inp, corr, flow,
+                                       with_mask=True)
+        _, mask_p, delta_p = blk.apply(v, net, inp, corr, flow,
+                                       with_mask=False)
+        net_new, _, _ = blk.apply(v, net, inp, corr, flow, with_mask=False)
+        mask_ref = blk.apply(v, net_new[0], method=blk.upsample_mask)
+        np.testing.assert_allclose(np.asarray(delta_m), np.asarray(delta_p),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(mask_m), np.asarray(mask_ref),
+                                   rtol=1e-5, atol=1e-6)
