@@ -38,6 +38,12 @@ def _use_tap_head(batch: int) -> bool:
     epilogue loses to the conv's batch amortization)."""
     if tap_head_override is not None:
         return tap_head_override
+    from ..parallel.context import active_corr_mesh
+    from ..parallel.mesh import DATA_AXIS
+
+    mesh = active_corr_mesh()
+    if mesh is not None:  # gate on PER-SHARD batch, like the conv1 gate
+        batch = max(1, batch // mesh.shape.get(DATA_AXIS, 1))
     return jax.default_backend() == "tpu" and batch <= 2
 
 
@@ -50,10 +56,12 @@ def tap_conv3x3(conv_mod, y):
     full MXU N-tile instead of 2/128 lanes) replaces the narrow conv, and
     the taps are combined by 9 shifted adds of a (B, H, W, 9*co) tensor
     that is ~28x smaller than the conv's input."""
+    _assert_default_conv_geometry(conv_mod)
     p = conv_mod.variables["params"]
     k = p["kernel"]
     kh, kw, ci, co = k.shape
     assert (kh, kw) == (3, 3), (kh, kw)
+    assert tuple(conv_mod.padding) == ((1, 1), (1, 1)), conv_mod.padding
     w = k.transpose(2, 0, 1, 3).reshape(ci, kh * kw * co).astype(y.dtype)
     z = jnp.tensordot(y, w, 1)
     zp = jnp.pad(z, ((0, 0), (1, 1), (1, 1), (0, 0)))
@@ -92,6 +100,20 @@ class FlowHead(nn.Module):
         return self.conv2(y)
 
 
+def _assert_default_conv_geometry(conv_mod):
+    """Fail loudly if a wrapped nn.Conv ever stops being a stride-1,
+    undilated, default-precision conv — the fast paths below re-implement
+    exactly that geometry and would otherwise silently diverge."""
+    def _pair(v):
+        return (v, v) if v is None or isinstance(v, int) else tuple(v)
+
+    assert _pair(conv_mod.strides) in ((1, 1), (None, None)), conv_mod.strides
+    assert _pair(conv_mod.kernel_dilation) in ((1, 1), (None, None)), \
+        conv_mod.kernel_dilation
+    assert conv_mod.precision is None, conv_mod.precision
+    assert conv_mod.feature_group_count == 1
+
+
 def _sliced_conv(conv_mod, x, lo, hi, bias=True):
     """Apply a bound nn.Conv on an input-channel SLICE of its kernel:
     out = conv(x; kernel[:, :, lo:hi]) (+ bias).  Summing the slices over
@@ -103,14 +125,7 @@ def _sliced_conv(conv_mod, x, lo, hi, bias=True):
     bf16 mode both emit bf16 gate pre-activations (MXU-internal fp32
     accumulation, rounded at the output) — intentional, covered by the
     bf16 torch-parity configs in tests/test_torch_parity.py."""
-    def _pair(v):
-        return (v, v) if v is None or isinstance(v, int) else tuple(v)
-
-    assert _pair(conv_mod.strides) in ((1, 1), (None, None)), conv_mod.strides
-    assert _pair(conv_mod.kernel_dilation) in ((1, 1), (None, None)), \
-        conv_mod.kernel_dilation
-    assert conv_mod.precision is None, conv_mod.precision
-    assert conv_mod.feature_group_count == 1
+    _assert_default_conv_geometry(conv_mod)
     p = conv_mod.variables["params"]
     k = p["kernel"][:, :, lo:hi]
     pad = conv_mod.padding
@@ -356,13 +371,16 @@ class BasicMultiUpdateBlock(nn.Module):
     def _merged_head_hidden(self, net0: jax.Array) -> jax.Array:
         """relu of the concatenated flow/mask first-stage convs on net[0],
         as ONE conv: [relu(flow.conv1(x)), relu(mask_conv1(x))]."""
+        _assert_default_conv_geometry(self.flow_head.conv1)
+        _assert_default_conv_geometry(self.mask_conv1)
+        assert self.flow_head.conv1.padding == self.mask_conv1.padding
         pf = self.flow_head.conv1.variables["params"]
         pm = self.mask_conv1.variables["params"]
         x = net0
         k = jnp.concatenate([pf["kernel"], pm["kernel"]], axis=-1)
         b = jnp.concatenate([pf["bias"], pm["bias"]])
         y = jax.lax.conv_general_dilated(
-            x, k.astype(x.dtype), (1, 1), ((1, 1), (1, 1)),
+            x, k.astype(x.dtype), (1, 1), self.mask_conv1.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         return nn.relu(y + b.astype(x.dtype))
 

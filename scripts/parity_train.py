@@ -9,10 +9,19 @@ the loss trajectories.  This pins, end to end, the one pipeline
 PARITY_CLI.md does not cover: gradients, the optimizer, the LR schedule,
 and gradient clipping.
 
-Both stacks run CPU fp32.  Divergence grows with step count (fp
-reassociation amplified by the recurrent model — same mechanism as the
-eval-parity drift analysis in scripts/parity_cli.py), so the gate is on
-relative loss difference per step with a step-50 tolerance.
+Both stacks run CPU fp32.  Divergence grows with step count — fp
+reassociation amplified by the recurrent model AND the optimizer loop
+(measured: by step 50 the loss trajectories decorrelate to tens of
+percent while staying in the same loss regime).  To separate that
+chaotic amplification from a real cross-stack bias, the harness also
+runs a LYAPUNOV CONTROL: the reference against ITSELF with one weight
+perturbed by 1e-6 (fp-noise scale).  The gate is then two-sided:
+ * steps 1-10 (before amplification) must match tightly — this pins the
+   gradients, AdamW moments, LR schedule, and clipping arithmetic;
+ * the late-step cross-stack divergence must stay within a small factor
+   of the control's SELF-divergence — i.e. the two stacks disagree no
+   faster than the reference disagrees with a hair-flipped copy of
+   itself, which is the system's intrinsic noise floor.
 
     python scripts/parity_train.py --workspace /tmp/ptrain --steps 50
 
@@ -30,23 +39,57 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def run_reference(args, ws):
-    ckpt = os.path.join(ws, "init.pth")
-    out = os.path.join(ws, "ref_losses.json")
-    if not (os.path.exists(ckpt) and os.path.exists(out) and args.reuse):
+def _run_key(args):
+    """Cache key: every parameter that changes the trajectories.  --reuse
+    with a stale key re-runs instead of gating a bogus verdict."""
+    return {"steps": args.steps, "batch": args.batch,
+            "height": args.height, "width": args.width,
+            "train_iters": args.train_iters}
+
+
+def _cache_valid(path, key):
+    if not os.path.exists(path):
+        return False
+    with open(path) as f:
+        d = json.load(f)
+    cfg = d.get("run_key") or d.get("config", {})
+    return all(cfg.get(k) == v for k, v in key.items())
+
+
+def run_reference(args, ws, perturb=0.0):
+    tag = "_pert" if perturb else ""
+    ckpt = os.path.join(ws, f"init{tag}.pth")
+    out = os.path.join(ws, f"ref{tag}_losses.json")
+    if not (os.path.exists(ckpt) and args.reuse
+            and _cache_valid(out, _run_key(args))):
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "ref_train_probe.py"),
                "--steps", str(args.steps), "--batch", str(args.batch),
                "--height", str(args.height), "--width", str(args.width),
                "--train_iters", str(args.train_iters),
                "--ckpt", ckpt, "--out", out]
+        if perturb:
+            cmd += ["--perturb", repr(perturb)]
         env = dict(os.environ, CUDA_VISIBLE_DEVICES="")
         subprocess.run(cmd, check=True, env=env)
     with open(out) as f:
         return ckpt, json.load(f)
 
 
-def run_ours(args, ckpt):
+def run_ours(args, ckpt, ws):
+    cache = os.path.join(ws, "ours_losses.json")
+    if args.reuse and _cache_valid(cache, _run_key(args)):
+        with open(cache) as f:
+            d = json.load(f)
+        return d["losses"], d["epes"]
+    losses, epes = _run_ours_impl(args, ckpt)
+    with open(cache, "w") as f:
+        json.dump({"losses": losses, "epes": epes,
+                   "run_key": _run_key(args)}, f)
+    return losses, epes
+
+
+def _run_ours_impl(args, ckpt):
     os.environ["JAX_PLATFORMS"] = "cpu"
     from raftstereo_tpu.utils import apply_env_platform
     apply_env_platform()
@@ -94,45 +137,77 @@ def main():
     p.add_argument("--height", type=int, default=96)
     p.add_argument("--width", type=int, default=160)
     p.add_argument("--train_iters", type=int, default=5)
-    p.add_argument("--tol_rel_final", type=float, default=2e-2,
-                   help="relative loss tolerance at the final step")
     p.add_argument("--tol_rel_early", type=float, default=1e-3,
                    help="relative loss tolerance over the first 10 steps")
+    p.add_argument("--perturb", type=float, default=1e-6,
+                   help="Lyapunov-control perturbation (one weight, "
+                        "fp-noise scale)")
+    p.add_argument("--envelope_factor", type=float, default=5.0,
+                   help="late-step gate: median cross-stack divergence of "
+                        "the last 10 steps must stay within this factor "
+                        "of the control's self-divergence (+1e-3 floor)")
     p.add_argument("--reuse", action="store_true",
                    help="reuse an existing reference run in the workspace")
     args = p.parse_args()
 
     os.makedirs(args.workspace, exist_ok=True)
     ckpt, ref = run_reference(args, args.workspace)
-    ours_losses, ours_epes = run_ours(args, ckpt)
+    _, ctl = run_reference(args, args.workspace, perturb=args.perturb)
+    ours_losses, ours_epes = run_ours(args, ckpt, args.workspace)
 
-    rows = []
-    worst_early = worst = 0.0
-    for i, (a, b) in enumerate(zip(ref["losses"], ours_losses)):
-        rel = abs(a - b) / max(abs(a), 1e-9)
-        worst = max(worst, rel)
-        if i < 10:
-            worst_early = max(worst_early, rel)
-        rows.append((i + 1, a, b, rel))
+    def rel_traj(a_seq, b_seq):
+        assert len(a_seq) == len(b_seq) == args.steps, \
+            (len(a_seq), len(b_seq), args.steps)
+        return [abs(a - b) / max(abs(a), 1e-9)
+                for a, b in zip(a_seq, b_seq)]
+
+    d_ours = rel_traj(ref["losses"], ours_losses)
+    d_ctl = rel_traj(ref["losses"], ctl["losses"])
+
+    def median(xs):
+        s = sorted(xs)
+        return s[len(s) // 2]
+
+    worst_early = max(d_ours[:10])
+    med_ours = median(d_ours[-10:])
+    med_ctl = median(d_ctl[-10:])
+    late_bound = args.envelope_factor * med_ctl + 1e-3
+    ok = worst_early <= args.tol_rel_early and med_ours <= late_bound
 
     md = ["# Two-stack training parity",
           "",
           f"{args.steps} identical AdamW+OneCycle+clip steps from the same "
           f"converted random init on the same synthetic batches "
           f"(batch {args.batch}, {args.width}x{args.height}, "
-          f"{args.train_iters} GRU iters, CPU fp32 both stacks).",
+          f"{args.train_iters} GRU iters, CPU fp32 both stacks), plus a "
+          f"LYAPUNOV CONTROL: the reference vs itself with one weight "
+          f"perturbed by {args.perturb:g} (fp-noise scale).  The recurrent "
+          f"model + optimizer loop amplify fp-reassociation noise "
+          f"exponentially, so late-step trajectories decorrelate in ANY "
+          f"two runs that differ by one ulp — the control measures that "
+          f"intrinsic envelope, and the cross-stack gate is relative to "
+          f"it.",
           "",
-          "| step | reference loss | ours | rel diff |",
-          "|---|---|---|---|"]
-    for i, a, b, rel in rows[:10] + rows[10::10]:
-        md.append(f"| {i} | {a:.6f} | {b:.6f} | {rel:.2e} |")
-    ok = worst_early <= args.tol_rel_early and rows[-1][3] <= args.tol_rel_final
+          "| step | reference loss | ours | rel diff | control rel diff |",
+          "|---|---|---|---|---|"]
+    rows = list(enumerate(zip(ref["losses"], ours_losses), 1))
+    for i, (a, b) in rows[:10] + rows[10::10]:
+        md.append(f"| {i} | {a:.6f} | {b:.6f} | {d_ours[i-1]:.2e} "
+                  f"| {d_ctl[i-1]:.2e} |")
     md += ["",
-           f"Max relative diff, steps 1-10: **{worst_early:.2e}** "
-           f"(tolerance {args.tol_rel_early:.0e}); "
-           f"final step: **{rows[-1][3]:.2e}** "
-           f"(tolerance {args.tol_rel_final:.0e}); "
-           f"max anywhere: {worst:.2e}.",
+           f"Max relative diff, steps 1-10 (pre-amplification — pins the "
+           f"gradient, AdamW-moment, LR-schedule, and clipping "
+           f"arithmetic): **{worst_early:.2e}** "
+           f"(tolerance {args.tol_rel_early:.0e}).",
+           "",
+           f"Median relative diff over the last 10 steps: ours vs "
+           f"reference **{med_ours:.2e}**; control (reference vs its own "
+           f"{args.perturb:g}-perturbed copy) **{med_ctl:.2e}**; gate "
+           f"<= {args.envelope_factor:g} x control + 1e-3 = "
+           f"{late_bound:.2e}.  The two stacks diverge no faster than "
+           f"the reference diverges from itself under a one-ulp-scale "
+           f"change, i.e. the late-step difference is the system's "
+           f"chaotic noise floor, not a cross-stack bias.",
            "",
            f"**{'PASS' if ok else 'FAIL'}** — pins gradients, optimizer "
            f"moments, LR schedule, and clipping across the two stacks "
@@ -141,8 +216,11 @@ def main():
         f.write("\n".join(md) + "\n")
     with open(os.path.join(REPO, "PARITY_TRAIN.json"), "w") as f:
         json.dump({"ref": ref["losses"], "ours": ours_losses,
-                   "ok": ok, "worst_early": worst_early,
-                   "final_rel": rows[-1][3]}, f, indent=1)
+                   "control": ctl["losses"], "ok": ok,
+                   "worst_early": worst_early,
+                   "med_last10_ours": med_ours,
+                   "med_last10_control": med_ctl,
+                   "late_bound": late_bound}, f, indent=1)
     print("\n".join(md))
     sys.exit(0 if ok else 1)
 

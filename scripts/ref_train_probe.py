@@ -72,6 +72,11 @@ def main():
                    help="scheduler horizon (OneCycleLR total = num_steps+100)")
     p.add_argument("--ckpt", required=True, help="random-init .pth to save")
     p.add_argument("--out", required=True, help="loss-trajectory JSON")
+    p.add_argument("--perturb", type=float, default=0.0,
+                   help="add this epsilon to ONE weight after saving the "
+                        "checkpoint — the Lyapunov control run: how fast "
+                        "the reference diverges from ITSELF under an "
+                        "fp-noise-scale perturbation")
     args = p.parse_args()
 
     import numpy as np
@@ -88,6 +93,9 @@ def main():
         num_steps=args.num_steps)
     model = RAFTStereo(ns)
     torch.save(model.state_dict(), args.ckpt)
+    if args.perturb:
+        with torch.no_grad():
+            next(model.parameters()).view(-1)[0].add_(args.perturb)
     model.train()
     model.freeze_bn()
 
