@@ -252,11 +252,16 @@ class PointwisePaddedConv(nn.Module):
         if pad:
             k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         x = x.astype(self.dtype)  # flax-Conv-style compute-dtype cast
+        # No preferred_element_type: the MXU accumulates bf16 operands in
+        # fp32 internally either way, and a fp32-typed OUTPUT from bf16
+        # operands makes the conv's transpose ill-typed (cotangent fp32 vs
+        # kernel bf16 — lax.conv requires matching dtypes), breaking every
+        # bf16 backward through this op.  Cost: one bf16 rounding before
+        # the bias add.
         y = jax.lax.conv_general_dilated(
             x, k.astype(self.dtype), (1, 1), ((0, 0), (0, 0)),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
-        return (y + b.astype(jnp.float32)).astype(x.dtype)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + b.astype(x.dtype)
 
 
 class BasicMotionEncoder(nn.Module):

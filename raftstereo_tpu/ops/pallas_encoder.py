@@ -37,6 +37,7 @@ this pipeline removes fixed-stage inference time).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Tuple
 
@@ -53,6 +54,26 @@ from .pallas_norm import _row_block
 # to exercise the interpret-mode kernels on CPU, and config.fused_encoder
 # forwards a per-model override (so evaluations can pin one numeric path).
 fused_stem_override = None
+
+
+@contextlib.contextmanager
+def override_fused_stem(value):
+    """Trace-time scope for the module-level gate override.  The train
+    step wraps its forward in override_fused_stem(False): the fused
+    stage's backward is the XLA reference VJP, which re-runs the full XLA
+    forward for linearization — so under differentiation the Pallas
+    forward's saving is paid back with interest (measured: reference
+    recipe 1.264 -> 1.247 steps/sec with the stage on).  A per-model
+    config.fused_encoder=True still wins over this scope (use_fused_stem
+    checks the explicit override first), so the multichip dryrun and
+    forced-path evaluations keep the stage under training."""
+    global fused_stem_override
+    prev = fused_stem_override
+    fused_stem_override = value
+    try:
+        yield
+    finally:
+        fused_stem_override = prev
 
 
 def _stem_shard_mesh(shape):
@@ -651,13 +672,15 @@ def _fused_forward1(img, c1_params, params, dt):
 
 
 def _xla_conv1(img, c1_params, dt):
-    """Plain-XLA conv1 (7x7 stride-1 SAME) — backward linearization."""
+    """Plain-XLA conv1 (7x7 stride-1 SAME) — backward linearization.
+    No preferred_element_type: a fp32-typed output from bf16 operands
+    makes the conv transpose ill-typed (see PointwisePaddedConv), and this
+    formulation exists exactly to be differentiated."""
     x = img.astype(dt)
     y = jax.lax.conv_general_dilated(
         x, c1_params["kernel"].astype(dt), (1, 1), ((3, 3), (3, 3)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32,
-    ).astype(dt) + c1_params["bias"].astype(dt)
+    ) + c1_params["bias"].astype(dt)
     return y
 
 
@@ -695,11 +718,13 @@ def _xla_reference(y1_raw, params):
         return _xla_instance_norm(x, relu=True)
 
     def conv(x, p):
+        # No preferred_element_type — this mirror IS the backward
+        # formulation, and a fp32-typed output from bf16 operands makes
+        # the conv transpose ill-typed (see PointwisePaddedConv).
         return jax.lax.conv_general_dilated(
             x, p["kernel"].astype(x.dtype), (1, 1), ((1, 1), (1, 1)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype) + p["bias"].astype(x.dtype)
+        ) + p["bias"].astype(x.dtype)
 
     t0 = norm_relu(y1_raw)
     u2 = norm_relu(conv(norm_relu(conv(t0, params["c10"])), params["c11"]))

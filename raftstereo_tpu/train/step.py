@@ -39,7 +39,15 @@ def make_train_step(model, tx, cfg: TrainConfig, lr_schedule=None,
         variables = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats
-        preds = model.forward(variables, img1, img2, iters=cfg.train_iters)
+        # Fused-encoder stage off under differentiation by default: its
+        # backward (XLA reference VJP) re-runs the full XLA forward for
+        # linearization, a measured net loss in training (see
+        # pallas_encoder.override_fused_stem).  config.fused_encoder=True
+        # still forces it on.
+        from ..ops.pallas_encoder import override_fused_stem
+        with override_fused_stem(False):
+            preds = model.forward(variables, img1, img2,
+                                  iters=cfg.train_iters)
         return sequence_loss(preds, disp_gt, valid,
                              loss_gamma=cfg.loss_gamma, max_flow=cfg.max_flow)
 

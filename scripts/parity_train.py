@@ -39,12 +39,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def _run_key(args):
+def _run_key(args, perturb=0.0):
     """Cache key: every parameter that changes the trajectories.  --reuse
     with a stale key re-runs instead of gating a bogus verdict."""
-    return {"steps": args.steps, "batch": args.batch,
-            "height": args.height, "width": args.width,
-            "train_iters": args.train_iters}
+    key = {"steps": args.steps, "batch": args.batch,
+           "height": args.height, "width": args.width,
+           "train_iters": args.train_iters}
+    if perturb:
+        key["perturb"] = perturb
+    return key
 
 
 def _cache_valid(path, key):
@@ -61,7 +64,7 @@ def run_reference(args, ws, perturb=0.0):
     ckpt = os.path.join(ws, f"init{tag}.pth")
     out = os.path.join(ws, f"ref{tag}_losses.json")
     if not (os.path.exists(ckpt) and args.reuse
-            and _cache_valid(out, _run_key(args))):
+            and _cache_valid(out, _run_key(args, perturb))):
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "ref_train_probe.py"),
                "--steps", str(args.steps), "--batch", str(args.batch),
@@ -149,6 +152,10 @@ def main():
     p.add_argument("--reuse", action="store_true",
                    help="reuse an existing reference run in the workspace")
     args = p.parse_args()
+    if args.perturb <= 0:
+        p.error("--perturb must be > 0: the Lyapunov control needs a "
+                "nonzero perturbation (0 would collide with the reference "
+                "run's cache files and degenerate the late-step gate)")
 
     os.makedirs(args.workspace, exist_ok=True)
     ckpt, ref = run_reference(args, args.workspace)

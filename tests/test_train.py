@@ -437,3 +437,50 @@ def test_logger_per_key_window_means(tmp_path, capsys):
     rec = json.loads((tmp_path / "m.jsonl").read_text().splitlines()[-1])
     np.testing.assert_allclose(rec["loss"], 2.0)       # undiluted
     np.testing.assert_allclose(rec["skipped"], 0.2)    # true skip rate
+
+
+def test_bf16_remat_pallas_train_step_runs():
+    """Regression: bf16 + remat + pallas_alt training crashed at trace
+    time — convs with preferred_element_type=f32 on bf16 operands produce
+    an ill-typed transpose (cotangent f32 vs kernel bf16) inside the
+    scan/remat backward.  The full mixed-precision reference-recipe
+    combination must take a gradient step.  (The r3 suite only trained
+    fp32, so the break was invisible to it.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from raftstereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raftstereo_tpu.models import RAFTStereo
+    from raftstereo_tpu.train import (create_train_state, make_optimizer,
+                                      make_train_step)
+
+    cfg = RAFTStereoConfig(corr_implementation="pallas_alt",
+                           compute_dtype="bfloat16", remat=True,
+                           n_gru_layers=2, hidden_dims=(48, 48),
+                           corr_levels=2, corr_radius=3)
+    tcfg = TrainConfig(batch_size=1, train_iters=2, image_size=(32, 48))
+    model = RAFTStereo(cfg)
+    tx, sched = make_optimizer(tcfg)
+    state = create_train_state(model, jax.random.key(0), tx, (32, 48))
+    step = jax.jit(make_train_step(model, tx, tcfg, lr_schedule=sched))
+    rng = np.random.default_rng(0)
+    batch = (jnp.asarray(rng.integers(0, 255, (1, 32, 48, 3)).astype(np.float32)),
+             jnp.asarray(rng.integers(0, 255, (1, 32, 48, 3)).astype(np.float32)),
+             jnp.asarray(-np.abs(rng.normal(size=(1, 32, 48, 1))).astype(np.float32)),
+             jnp.ones((1, 32, 48), np.float32))
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+    # And with the fused encoder stage forced on via config (its backward
+    # is the XLA reference formulation — the other ill-typed-transpose
+    # site; the explicit override beats the train step's off-by-default).
+    cfg2 = RAFTStereoConfig(corr_implementation="pallas_alt",
+                            compute_dtype="bfloat16", remat=True,
+                            n_gru_layers=2, hidden_dims=(48, 48),
+                            corr_levels=2, corr_radius=3,
+                            fused_encoder=True)
+    model2 = RAFTStereo(cfg2)
+    state2 = create_train_state(model2, jax.random.key(0), tx, (32, 48))
+    step2 = jax.jit(make_train_step(model2, tx, tcfg, lr_schedule=sched))
+    state2, metrics2 = step2(state2, batch)
+    assert np.isfinite(float(metrics2["loss"]))
