@@ -17,6 +17,12 @@ import jax.numpy as jnp
 from .layers import ResidualBlock, conv, make_norm
 
 
+def _plain_stem(enc, x):
+    """The ordinary flax stem: conv1 -> norm1 -> relu -> layer1."""
+    x = nn.relu(enc.norm1(enc.conv1(x)))
+    return enc.layer1_1(enc.layer1_0(x))
+
+
 def _stem_layer1(enc, x):
     """conv1 + norm1 + relu + layer1, with the fused Pallas fast path on
     TPU.  ``x`` is the normalized input image.
@@ -31,8 +37,9 @@ def _stem_layer1(enc, x):
     directly.  Numerically pinned against this exact module path in
     tests/test_pallas_encoder.py; init always takes the plain path so the
     parameter tree is identical either way."""
-    from ..ops.pallas_encoder import (conv1_stem_layer1, stem_layer1,
-                                      use_fused_stem)
+    from ..ops.pallas_encoder import (bn_affine, bn_conv1_stem_layer1,
+                                      bn_stem_layer1, conv1_stem_layer1,
+                                      stem_layer1, use_fused_stem)
 
     stride = 1 + (enc.downsample > 2)
     oshape = (x.shape[0], -(-x.shape[1] // stride),
@@ -45,6 +52,14 @@ def _stem_layer1(enc, x):
             "c20": enc.layer1_1.conv1.variables["params"],
             "c21": enc.layer1_1.conv2.variables["params"],
         }
+        if enc.norm_fn == "batch":
+            # Frozen BN folds to constant prep affines (bn_affine).
+            affines = [
+                bn_affine(m.variables["params"], m.variables["batch_stats"])
+                for m in (enc.norm1, enc.layer1_0.norm1, enc.layer1_0.norm2,
+                          enc.layer1_1.norm1, enc.layer1_1.norm2)]
+        else:
+            affines = None
         # Pallas conv1 only at small per-shard image counts: measured
         # same-session A/B at flagship shapes — batch 1 (2 images)
         # 9.56 -> 9.84 pairs/sec, batch 2 a wash, batch 8 11.87 -> 12.31
@@ -57,13 +72,36 @@ def _stem_layer1(enc, x):
         shard = _stem_shard_mesh(oshape)
         local_imgs = x.shape[0] // (shard[1] if shard is not None else 1)
         local_h = oshape[1] // (shard[2] if shard is not None else 1)
-        if (stride == 1 and x.shape[-1] == 3 and local_imgs <= 4
-                and local_h >= 3):
-            return conv1_stem_layer1(x, enc.conv1.variables["params"],
-                                     params, enc.dtype)
+        # The stride-2 packed-fours conv1 kernel exists
+        # (pallas_encoder._stem_conv1_s2, tested) but measures a NET LOSS
+        # at realtime shapes (same-session: 98.8 vs 110.1 pairs/sec with
+        # the XLA stride-2 conv feeding the fused stage) — the
+        # parity-split row view costs more than the 11.8 TF/s XLA conv it
+        # replaces — so only stride 1 takes the Pallas conv1 path.
+        ok_geom = (x.shape[-1] == 3 and local_imgs <= 4 and local_h >= 3
+                   and stride == 1)
+        if ok_geom:
+            c1p = enc.conv1.variables["params"]
+            if affines is not None:
+                return bn_conv1_stem_layer1(x, c1p, params, affines,
+                                            enc.dtype, stride)
+            return conv1_stem_layer1(x, c1p, params, enc.dtype, stride)
+        if affines is not None:
+            # BN stage WITHOUT the Pallas conv1 re-pays the XLA-conv ->
+            # row-major boundary relayout and measures a net loss
+            # (same-session realtime: 101 vs 111.5 pairs/sec plain),
+            # unlike the instance stage whose XLA alternative is the
+            # 21 ms relayout storm.  Auto keeps the plain XLA stage here;
+            # an explicit True override still forces the fused form (the
+            # CPU equivalence tests and forced-path evaluations).
+            from ..ops.pallas_encoder import fused_stem_override
+            forced = (enc.fused_stem if enc.fused_stem is not None
+                      else fused_stem_override) is True
+            if forced:
+                return bn_stem_layer1(enc.conv1(x), params, affines)
+            return _plain_stem(enc, x)
         return stem_layer1(enc.conv1(x), params)
-    x = nn.relu(enc.norm1(enc.conv1(x)))
-    return enc.layer1_1(enc.layer1_0(x))
+    return _plain_stem(enc, x)
 
 
 class BasicEncoder(nn.Module):

@@ -117,20 +117,33 @@ def use_fused_stem(norm_fn: str, shape, override=None) -> bool:
     partitions with halo exchanges) must remain what they get.
 
     ``override`` (tri-state, from config.fused_encoder) wins over the
-    module-level ``fused_stem_override``, which wins over backend auto."""
-    ok = norm_fn == "instance" and shape[2] % 2 == 0
+    module-level ``fused_stem_override``, which wins over backend auto.
+    The auto path also gates on <= 4 images per shard: at batch 8 the XLA
+    stage's blocked lowering amortizes over the batch and the fused
+    pipeline measures a net loss (12.45 vs 12.32 pairs/sec same-session
+    at flagship b8; the conv1 kernel shows the same crossover).
+
+    ``batch`` norm also qualifies: frozen BatchNorm folds to a constant
+    per-channel affine, which the kernels' prep form relu(x*s + t)
+    represents exactly (bn_affine) — no stats kernels, no psum."""
+    ok = norm_fn in ("instance", "batch") and shape[2] % 2 == 0
     if not ok:
         return False
     ov = override if override is not None else fused_stem_override
-    if _stem_shard_mesh(shape) is not None:
-        return ov if ov is not None else jax.default_backend() == "tpu"
+    shard = _stem_shard_mesh(shape)
+    if shard is not None:
+        if ov is not None:
+            return ov
+        return (jax.default_backend() == "tpu"
+                and shape[0] // shard[1] <= 4)
     from ..parallel.context import active_corr_mesh
 
     if active_corr_mesh() is not None:
         return False  # mesh active but not partitionable (warned above)
     if ov is not None:
         return ov
-    return jax.default_backend() == "tpu" and len(jax.devices()) == 1
+    return (jax.default_backend() == "tpu" and len(jax.devices()) == 1
+            and shape[0] <= 4)
 
 
 # --------------------------------------------------------------- packing
@@ -190,11 +203,15 @@ def stats_from_packed(s1: jax.Array, s2: jax.Array, n: float
 
 # ---------------------------------------------------------------- kernels
 
-def _prep(x, m_ref, s_ref):
-    """Instance-norm apply + relu from packed stats refs."""
-    m = m_ref[...][:, :, None, :].astype(x.dtype)
+def _prep(x, s_ref, t_ref):
+    """Normalization apply + relu from packed AFFINE refs: relu(x*s + t).
+    Instance norm passes (rstd, -mean*rstd); frozen batch norm passes its
+    folded constants (gamma*rstd, beta - mean*gamma*rstd) — the affine
+    form also represents gamma == 0 channels exactly, which (x - m)*s
+    cannot."""
     s = s_ref[...][:, :, None, :].astype(x.dtype)
-    return jnp.maximum((x - m) * s, 0)
+    t = t_ref[...][:, :, None, :].astype(x.dtype)
+    return jnp.maximum(x * s + t, 0)
 
 
 def _edge_mask_halo(th, hv_ref):
@@ -250,13 +267,13 @@ def _conv_packed(t, halo, w_ref, bias_ref, wp):
     return y + bias_ref[...][:, :, None, :]
 
 
-def _enc_conv_kernel(x_ref, xh_ref, m_ref, s_ref, w_ref, b_ref, hv_ref,
-                     y_ref, s1_ref, s2_ref, *, wp):
-    """prep(x) -> packed conv -> raw y + packed output stats."""
-    t = _prep(x_ref[...], m_ref, s_ref)
-    th = _edge_mask_halo(_prep(xh_ref[...][:, 0], m_ref, s_ref), hv_ref)
-    y = _conv_packed(t, th, w_ref, b_ref, wp)
-    y_ref[...] = y.astype(y_ref.dtype)
+def _acc_stats(y, stat_refs):
+    """Accumulate packed fp32 (sum, sumsq) of the raw output — skipped
+    entirely for affine (frozen-BN) pipelines, whose constant prep needs
+    no statistics (stat_refs empty)."""
+    if not stat_refs:
+        return
+    s1_ref, s2_ref = stat_refs
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
@@ -267,36 +284,39 @@ def _enc_conv_kernel(x_ref, xh_ref, m_ref, s_ref, w_ref, b_ref, hv_ref,
     s2_ref[...] += jnp.sum(y * y, axis=(1, 2))[:, None, :]
 
 
-def _enc_conv_res_kernel(x_ref, xh_ref, m_ref, s_ref,
-                         r_ref, rh_ref, rm_ref, rs_ref,
-                         w_ref, b_ref, hv_ref, y_ref, s1_ref, s2_ref, *, wp):
+def _enc_conv_kernel(x_ref, xh_ref, s_ref, t_ref, w_ref, b_ref, hv_ref,
+                     y_ref, *stat_refs, wp):
+    """prep(x) -> packed conv -> raw y (+ packed output stats)."""
+    t = _prep(x_ref[...], s_ref, t_ref)
+    th = _edge_mask_halo(_prep(xh_ref[...][:, 0], s_ref, t_ref), hv_ref)
+    y = _conv_packed(t, th, w_ref, b_ref, wp)
+    y_ref[...] = y.astype(y_ref.dtype)
+    _acc_stats(y, stat_refs)
+
+
+def _enc_conv_res_kernel(x_ref, xh_ref, s_ref, t_ref,
+                         r_ref, rh_ref, rs_ref, rt_ref,
+                         w_ref, b_ref, hv_ref, y_ref, *stat_refs, wp):
     """Residual-block boundary: the conv input is
     relu( prep(res_raw) + prep(x_raw) ) — both tensors arrive RAW with
-    their stats and are normalized in-register."""
-    t = jnp.maximum(_prep(r_ref[...], rm_ref, rs_ref)
-                    + _prep(x_ref[...], m_ref, s_ref), 0)
+    their affines and are normalized in-register."""
+    t = jnp.maximum(_prep(r_ref[...], rs_ref, rt_ref)
+                    + _prep(x_ref[...], s_ref, t_ref), 0)
     th = _edge_mask_halo(
-        jnp.maximum(_prep(rh_ref[...][:, 0], rm_ref, rs_ref)
-                    + _prep(xh_ref[...][:, 0], m_ref, s_ref), 0), hv_ref)
+        jnp.maximum(_prep(rh_ref[...][:, 0], rs_ref, rt_ref)
+                    + _prep(xh_ref[...][:, 0], s_ref, t_ref), 0), hv_ref)
     y = _conv_packed(t, th, w_ref, b_ref, wp)
     y_ref[...] = y.astype(y_ref.dtype)
-
-    @pl.when(pl.program_id(1) == 0)
-    def _init():
-        s1_ref[...] = jnp.zeros_like(s1_ref[...])
-        s2_ref[...] = jnp.zeros_like(s2_ref[...])
-
-    s1_ref[...] += jnp.sum(y, axis=(1, 2))[:, None, :]
-    s2_ref[...] += jnp.sum(y * y, axis=(1, 2))[:, None, :]
+    _acc_stats(y, stat_refs)
 
 
-def _enc_finish_kernel(y1_ref, m1_ref, s1_ref, c11_ref, m11_ref, s11_ref,
-                       c21_ref, m21_ref, s21_ref, o_ref):
+def _enc_finish_kernel(y1_ref, s1_ref, t1_ref, c11_ref, s11_ref, t11_ref,
+                       c21_ref, s21_ref, t21_ref, o_ref):
     """t2 = relu( relu( t0 + u2 ) + v2 ): the stage output in the final
-    domain, from the three raw tensors + their stats."""
-    t0 = _prep(y1_ref[...], m1_ref, s1_ref)
-    u2 = _prep(c11_ref[...], m11_ref, s11_ref)
-    v2 = _prep(c21_ref[...], m21_ref, s21_ref)
+    domain, from the three raw tensors + their affines."""
+    t0 = _prep(y1_ref[...], s1_ref, t1_ref)
+    u2 = _prep(c11_ref[...], s11_ref, t11_ref)
+    v2 = _prep(c21_ref[...], s21_ref, t21_ref)
     o_ref[...] = jnp.maximum(jnp.maximum(t0 + u2, 0) + v2,
                              0).astype(o_ref.dtype)
 
@@ -327,13 +347,14 @@ def _default_hv(nblk: int) -> jax.Array:
 
 
 def _enc_conv(x, stats, w9, bias, res=None, res_stats=None,
-              hv=None, boundary=None, res_boundary=None):
-    """One fused prep+conv+stats call on packed arrays.
+              hv=None, boundary=None, res_boundary=None, want_stats=True):
+    """One fused prep+conv(+stats) call on packed arrays.
 
-    x: (B, H, Wp, C2) raw; stats: (mean, rstd) each (B, 1, C2) packed;
+    x: (B, H, Wp, C2) raw; stats: AFFINE (s, t) each (B, 1, C2) packed;
     w9: (9, C2, C2); bias: (1, 1, C2); hv: (H//r, 2) halo validity;
     boundary / res_boundary: neighbor edge rows under space sharding.
-    Returns (y_raw fp-of-x, (s1, s2))."""
+    ``want_stats=False`` (affine pipelines) skips the output-stats
+    accumulation entirely.  Returns (y_raw fp-of-x, (s1, s2) or None)."""
     b, h, wp, c2 = x.shape
     r = _row_block(h)
     grid = (b, h // r)
@@ -375,19 +396,23 @@ def _enc_conv(x, stats, w9, bias, res=None, res_stats=None,
                     row_spec(), halo_spec(), stat_spec(), stat_spec(),
                     wspec, bspec, hvspec]
 
-    y, s1, s2 = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(x.shape, x.dtype)]
+    out_specs = [row_spec()]
+    if want_stats:
+        out_shape += [jax.ShapeDtypeStruct((b, 1, c2), jnp.float32)] * 2
+        out_specs += [stat_spec(), stat_spec()]
+    out = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct(x.shape, x.dtype),
-                   jax.ShapeDtypeStruct((b, 1, c2), jnp.float32),
-                   jax.ShapeDtypeStruct((b, 1, c2), jnp.float32)),
+        out_shape=tuple(out_shape),
         grid=grid,
         in_specs=in_specs,
-        out_specs=(row_spec(),
-                   stat_spec(), stat_spec()),
+        out_specs=tuple(out_specs),
         interpret=_interpret(),
         compiler_params=_COMPILER_PARAMS,
     )(*operands)
-    return y, (s1, s2)
+    if want_stats:
+        return out[0], (out[1], out[2])
+    return out[0], None
 
 
 def _packed_stats(x):
@@ -414,14 +439,15 @@ def _packed_stats(x):
 
 
 def _expand_stats(s1, s2, n, axis_name=None):
-    """Packed sums -> packed (mean, rstd) duplicated over parities.
+    """Packed sums -> packed prep AFFINE (rstd, -mean*rstd) duplicated
+    over parities (the kernels apply relu(x*s + t)).
     ``axis_name``: psum the partial sums over that mesh axis first (space
     sharding — instance-norm statistics span the whole image height)."""
     if axis_name is not None:
         s1 = jax.lax.psum(s1, axis_name)
         s2 = jax.lax.psum(s2, axis_name)
     mean, rstd = stats_from_packed(s1, s2, n)
-    return pack_vec(mean), pack_vec(rstd)
+    return pack_vec(rstd), pack_vec(-mean * rstd)
 
 
 def fused_stem_layer1(y1_raw: jax.Array, params: dict, n=None,
@@ -476,9 +502,15 @@ def _shard_ctx(nblk: int, space_axis, space_size: int, rows: int = 1):
     return hv, exch
 
 
-def _stage_on_packed(xp, st1, params, n, space_axis=None, space_size=1):
+def _stage_on_packed(xp, st1, params, n, space_axis=None, space_size=1,
+                     affines=None):
     """The four fused convs + finish kernel, from the packed raw stage
-    input ``xp`` and its already-computed packed stats ``st1``."""
+    input ``xp`` and its prep affine ``st1``.
+
+    ``affines``: for affine norms (frozen batch norm) — a list of the four
+    remaining packed (s, t) prep affines [after c10, c11, c20, c21]; the
+    per-tensor statistics accumulated by the kernels are then ignored
+    (constant affines need no stats and no psum)."""
     dt = xp.dtype
     b, h, wp, c2 = xp.shape
     r = _row_block(h)
@@ -489,17 +521,28 @@ def _stage_on_packed(xp, st1, params, n, space_axis=None, space_size=1):
         return (pack_weights(params[name]["kernel"]).astype(dt),
                 pack_vec(params[name]["bias"]).astype(dt))
 
+    ws = affines is None
+
+    def nxt(sums, i):
+        if affines is not None:
+            return affines[i]
+        return _expand_stats(*sums, n, space_axis)
+
     xb = exch(xp)
-    c10, s10 = _enc_conv(xp, st1, *pw("c10"), hv=hv, boundary=xb)
-    st10 = _expand_stats(*s10, n, space_axis)
-    c11, s11 = _enc_conv(c10, st10, *pw("c11"), hv=hv, boundary=exch(c10))
-    st11 = _expand_stats(*s11, n, space_axis)
+    c10, s10 = _enc_conv(xp, st1, *pw("c10"), hv=hv, boundary=xb,
+                         want_stats=ws)
+    st10 = nxt(s10, 0)
+    c11, s11 = _enc_conv(c10, st10, *pw("c11"), hv=hv, boundary=exch(c10),
+                         want_stats=ws)
+    st11 = nxt(s11, 1)
     # block boundary: input of layer1_1.conv1 is relu(t0 + u2)
     c20, s20 = _enc_conv(c11, st11, *pw("c20"), res=xp, res_stats=st1,
-                         hv=hv, boundary=exch(c11), res_boundary=xb)
-    st20 = _expand_stats(*s20, n, space_axis)
-    c21, s21 = _enc_conv(c20, st20, *pw("c21"), hv=hv, boundary=exch(c20))
-    st21 = _expand_stats(*s21, n, space_axis)
+                         hv=hv, boundary=exch(c11), res_boundary=xb,
+                         want_stats=ws)
+    st20 = nxt(s20, 2)
+    c21, s21 = _enc_conv(c20, st20, *pw("c21"), hv=hv, boundary=exch(c20),
+                         want_stats=ws)
+    st21 = nxt(s21, 3)
 
     def row_spec():
         return pl.BlockSpec((1, r, wp, c2), lambda i, j: (i, j, 0, 0),
@@ -542,7 +585,7 @@ def pack_weights7(w: jax.Array) -> jax.Array:
     return out
 
 
-def _stem7_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, s1_ref, s2_ref, *,
+def _stem7_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, *stat_refs,
                   wp, rows):
     """7x7 stride-1 packed conv of the RAW input image tile + fp32 output
     stats (for norm1).  No prep/halo masking: the input is the [-1, 1]
@@ -573,14 +616,130 @@ def _stem7_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, s1_ref, s2_ref, *,
         y = shifted if y is None else y + shifted
     y = y + b_ref[...][:, :, None, :]
     y_ref[...] = y.astype(y_ref.dtype)
+    _acc_stats(y, stat_refs)
 
-    @pl.when(pl.program_id(1) == 0)
-    def _init():
-        s1_ref[...] = jnp.zeros_like(s1_ref[...])
-        s2_ref[...] = jnp.zeros_like(s2_ref[...])
 
-    s1_ref[...] += jnp.sum(y, axis=(1, 2))[:, None, :]
-    s2_ref[...] += jnp.sum(y * y, axis=(1, 2))[:, None, :]
+def pack_weights7s2(w: jax.Array) -> jax.Array:
+    """(7, 7, 3, 64) HWIO conv1 weights -> (7, 3, 12, 128) packed for
+    STRIDE 2: output pixel 2p+po reads input column 4p + u, u = 2*po + dx
+    in [-3, 5] -> packed-4 column p + dq, sub-position pi, with
+    dq = floor(u/4) in [-1, 1], pi = u mod 4."""
+    kh, kw, ci, co = w.shape
+    out = jnp.zeros((kh, 3, 4 * ci, 2 * co), w.dtype)
+    for po in range(2):
+        for dxi, dx in enumerate(range(-3, 4)):
+            u = 2 * po + dx
+            dq = u // 4
+            pi = u % 4
+            out = out.at[:, dq + 1,
+                         pi * ci:(pi + 1) * ci,
+                         po * co:(po + 1) * co].set(w[:, dxi])
+    return out
+
+
+def _stem7s2_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, *stat_refs,
+                    wq, rows):
+    """7x7 STRIDE-2 packed conv of the raw input image + fp32 output
+    stats.  x_ref: (1, 2R, Wq, 12) input rows for this block's R output
+    rows; xh_ref: (1, 5, Wq, 12) = 3 rows above + 2 below.  Output row r
+    (local) with tap dy' reads input full[2r + dy' + 3]; padding full to
+    an even row count and viewing it as (R+3, 2, ...) turns each dy' into
+    a CONTIGUOUS row slice at parity (dy'+3) % 2."""
+    t = x_ref[...]
+    th = xh_ref[...][:, 0]
+    full = jnp.concatenate(
+        [th[:, :3], t, th[:, 3:5],
+         jnp.zeros_like(th[:, :1])], axis=1)        # (1, 2R+6, Wq, 12)
+    view = full.reshape(1, rows + 3, 2, full.shape[2], full.shape[3])
+    w = w_ref[...]
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, wq, 1), 2)
+    y = None
+    for dqi in range(3):
+        u = None
+        for dyi in range(7):
+            e, par = divmod(dyi, 2)
+            m = jax.lax.dot_general(
+                view[:, e:e + rows, par], w[dyi, dqi],
+                (((3,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            u = m if u is None else u + m
+        o = dqi - 1
+        if o == 0:
+            shifted = u
+        else:
+            shifted = pltpu.roll(u, (-o) % wq, 2)
+            if o > 0:
+                shifted = jnp.where(col < wq - o, shifted, 0.0)
+            else:
+                shifted = jnp.where(col >= -o, shifted, 0.0)
+        y = shifted if y is None else y + shifted
+    y = y + b_ref[...][:, :, None, :]
+    y_ref[...] = y.astype(y_ref.dtype)
+    _acc_stats(y, stat_refs)
+
+
+def _halo_rows_s2(x: jax.Array, r: int, boundary=None) -> jax.Array:
+    """(B, H, Wq, C) input -> (B, Hout//r, 5, Wq, C): the 3 rows above and
+    2 below each 2r-input-row block (one block per r output rows)."""
+    b, h, wq, c = x.shape
+    nblk = (h // 2) // r
+    if boundary is None:
+        above = jnp.zeros((b, 3, wq, c), x.dtype)
+        below = jnp.zeros((b, 2, wq, c), x.dtype)
+    else:
+        above, below = boundary
+        below = below[:, :2]
+    span = 2 * r
+    xpad_t = jnp.concatenate([above, x[:, : (nblk - 1) * span]], axis=1)
+    xpad_b = jnp.concatenate([x[:, span:], below], axis=1)
+    tops = [xpad_t[:, k::span][:, :nblk] for k in range(3)]
+    bots = [xpad_b[:, k::span][:, :nblk] for k in range(2)]
+    return jnp.stack(tops + bots, axis=2)
+
+
+def _stem_conv1_s2(img, c1_params, dt, boundary=None, want_stats=True):
+    """Pallas stride-2 conv1: (B, H, W, 3) image -> packed raw conv1
+    output (B, H/2, W/4, 128) + packed fp32 output stats.  Requires
+    H % 2 == 0 and W % 4 == 0."""
+    b, h, w, ci = img.shape
+    xq = img.astype(dt).reshape(b, h, w // 4, 4 * ci)
+    r = _row_block(h // 2)
+    grid = (b, (h // 2) // r)
+    xh = _halo_rows_s2(xq, r, boundary)
+    w7 = pack_weights7s2(c1_params["kernel"]).astype(dt)
+    bias = pack_vec(c1_params["bias"]).astype(dt)[None, None, :]
+    co2 = w7.shape[-1]
+    wq = w // 4
+    c4 = 4 * ci
+
+    out_shape = [jax.ShapeDtypeStruct((b, h // 2, wq, co2), dt)]
+    if want_stats:
+        out_shape += [jax.ShapeDtypeStruct((b, 1, co2), jnp.float32)] * 2
+    out = pl.pallas_call(
+        functools.partial(_stem7s2_kernel, wq=wq, rows=r),
+        out_shape=tuple(out_shape),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 2 * r, wq, c4), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 5, wq, c4), lambda i, j: (i, j, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(w7.shape, lambda i, j: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, co2), lambda i, j: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=tuple(
+            [pl.BlockSpec((1, r, wq, co2), lambda i, j: (i, j, 0, 0),
+                          memory_space=pltpu.VMEM)]
+            + [pl.BlockSpec((1, 1, co2), lambda i, j: (i, 0, 0),
+                            memory_space=pltpu.VMEM)] * (2 * want_stats)),
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )(xq, xh, w7, bias)
+    if want_stats:
+        return out[0], (out[1], out[2])
+    return out[0], None
 
 
 def _halo_rows3(x: jax.Array, r: int, boundary=None) -> jax.Array:
@@ -600,7 +759,7 @@ def _halo_rows3(x: jax.Array, r: int, boundary=None) -> jax.Array:
     return jnp.stack(tops + bots, axis=2)
 
 
-def _stem_conv1(img, c1_params, dt, boundary=None):
+def _stem_conv1(img, c1_params, dt, boundary=None, want_stats=True):
     """Pallas conv1: (B, H, W, 3) [-1,1] image -> packed raw conv1 output
     (B, H, Wp, 128) + packed fp32 (sum, sumsq) output stats, one pass.
     Requires stride 1 (downsample <= 2) and W % 2 == 0."""
@@ -613,11 +772,12 @@ def _stem_conv1(img, c1_params, dt, boundary=None):
     bias = pack_vec(c1_params["bias"]).astype(dt)[None, None, :]
     co2 = w7.shape[-1]
 
-    y, s1, s2 = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b, h, wp, co2), dt)]
+    if want_stats:
+        out_shape += [jax.ShapeDtypeStruct((b, 1, co2), jnp.float32)] * 2
+    out = pl.pallas_call(
         functools.partial(_stem7_kernel, wp=wp, rows=r),
-        out_shape=(jax.ShapeDtypeStruct((b, h, wp, co2), dt),
-                   jax.ShapeDtypeStruct((b, 1, co2), jnp.float32),
-                   jax.ShapeDtypeStruct((b, 1, co2), jnp.float32)),
+        out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, r, wp, c2), lambda i, j: (i, j, 0, 0),
@@ -629,31 +789,50 @@ def _stem_conv1(img, c1_params, dt, boundary=None):
             pl.BlockSpec((1, 1, co2), lambda i, j: (0, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=(pl.BlockSpec((1, r, wp, co2), lambda i, j: (i, j, 0, 0),
-                                memory_space=pltpu.VMEM),
-                   pl.BlockSpec((1, 1, co2), lambda i, j: (i, 0, 0),
-                                memory_space=pltpu.VMEM),
-                   pl.BlockSpec((1, 1, co2), lambda i, j: (i, 0, 0),
-                                memory_space=pltpu.VMEM)),
+        out_specs=tuple(
+            [pl.BlockSpec((1, r, wp, co2), lambda i, j: (i, j, 0, 0),
+                          memory_space=pltpu.VMEM)]
+            + [pl.BlockSpec((1, 1, co2), lambda i, j: (i, 0, 0),
+                            memory_space=pltpu.VMEM)] * (2 * want_stats)),
         interpret=_interpret(),
         compiler_params=_COMPILER_PARAMS,
     )(xp, xh, w7, bias)
-    return y, (s1, s2)
+    if want_stats:
+        return out[0], (out[1], out[2])
+    return out[0], None
 
 
-def _fused_forward1(img, c1_params, params, dt):
-    """conv1 + stage, fused end to end; shard_map'd like _fused_forward."""
+def _stem_conv1_any(im, c1p, dt, stride, boundary, want_stats=True):
+    if stride == 2:
+        return _stem_conv1_s2(im, c1p, dt, boundary=boundary,
+                              want_stats=want_stats)
+    return _stem_conv1(im, c1p, dt, boundary=boundary,
+                       want_stats=want_stats)
+
+
+def _conv1_pack_for_halo(im, dt, stride):
+    """The packed view whose edge rows the space-sharding exchange
+    ships: pixel pairs for stride 1, packed fours for stride 2."""
+    if stride == 2:
+        b, h, w, ci = im.shape
+        return im.astype(dt).reshape(b, h, w // 4, 4 * ci)
+    return pack_view(im.astype(dt))
+
+
+def _fused_forward1(img, c1_params, params, dt, stride=1):
+    """conv1 + stage, fused end to end; shard_map'd like _fused_forward.
+    The stage's stats span the conv1 OUTPUT resolution (H/stride)."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
 
     def local(im, c1p, p, space_axis=None, space_size=1, n=None):
         if n is None:
-            n = float(im.shape[1] * im.shape[2])
+            n = float((im.shape[1] // stride) * (im.shape[2] // stride))
         _, exch3 = _shard_ctx(1, space_axis, space_size, rows=3)
-        imp = pack_view(im.astype(dt))
+        imp = _conv1_pack_for_halo(im, dt, stride)
         yb = exch3(imp) if space_axis is not None else None
-        yp, sums = _stem_conv1(im, c1p, dt, boundary=yb)
+        yp, sums = _stem_conv1_any(im, c1p, dt, stride, yb)
         st1 = _expand_stats(*sums, n, space_axis)
         return _stage_on_packed(yp, st1, p, n, space_axis, space_size)
 
@@ -661,7 +840,7 @@ def _fused_forward1(img, c1_params, params, dt):
     if shard is None:
         return local(img, c1_params, params)
     mesh, d, s = shard
-    n = float(img.shape[1] * img.shape[2])
+    n = float((img.shape[1] // stride) * (img.shape[2] // stride))
     spec = P(DATA_AXIS, SPACE_AXIS, None, None)
     fn = functools.partial(local, n=n,
                            space_axis=SPACE_AXIS if s > 1 else None,
@@ -671,41 +850,184 @@ def _fused_forward1(img, c1_params, params, dt):
                              img, c1_params, params)
 
 
-def _xla_conv1(img, c1_params, dt):
-    """Plain-XLA conv1 (7x7 stride-1 SAME) — backward linearization.
+def _xla_conv1(img, c1_params, dt, stride=1):
+    """Plain-XLA conv1 (7x7 SAME) — backward linearization.
     No preferred_element_type: a fp32-typed output from bf16 operands
     makes the conv transpose ill-typed (see PointwisePaddedConv), and this
     formulation exists exactly to be differentiated."""
     x = img.astype(dt)
     y = jax.lax.conv_general_dilated(
-        x, c1_params["kernel"].astype(dt), (1, 1), ((3, 3), (3, 3)),
+        x, c1_params["kernel"].astype(dt), (stride, stride),
+        ((3, 3), (3, 3)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     ) + c1_params["bias"].astype(dt)
     return y
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def conv1_stem_layer1(img, c1_params, params, dt=jnp.float32):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def conv1_stem_layer1(img, c1_params, params, dt=jnp.float32, stride=1):
     """Fused conv1 + norm1 + layer1 from the normalized input image.
     Forward is all-Pallas (one boundary: the image read); backward is the
     XLA reference formulation's VJP on global arrays."""
-    return _fused_forward1(img, c1_params, params, dt)
+    return _fused_forward1(img, c1_params, params, dt, stride)
 
 
-def _fwd1(img, c1_params, params, dt):
-    return _fused_forward1(img, c1_params, params, dt), (img, c1_params,
-                                                         params)
+def _fwd1(img, c1_params, params, dt, stride):
+    return (_fused_forward1(img, c1_params, params, dt, stride),
+            (img, c1_params, params))
 
 
-def _bwd1(dt, residuals, g):
+def _bwd1(dt, stride, residuals, g):
     img, c1_params, params = residuals
     _, vjp = jax.vjp(
-        lambda im, c1p, p: _xla_reference(_xla_conv1(im, c1p, dt), p),
+        lambda im, c1p, p: _xla_reference(
+            _xla_conv1(im, c1p, dt, stride), p),
         img, c1_params, params)
     return vjp(g)
 
 
 conv1_stem_layer1.defvjp(_fwd1, _bwd1)
+
+
+# --------------------------------------- affine-norm (frozen BN) pipeline
+
+def bn_affine(norm_params, norm_stats, eps: float = 1e-5):
+    """Frozen BatchNorm (use_running_average) folded to the kernels' prep
+    affine: relu(x*s + t) with s = gamma*rsqrt(var+eps),
+    t = beta - mean*s.  Exact for gamma == 0 channels too."""
+    s = norm_params["scale"].astype(jnp.float32) * jax.lax.rsqrt(
+        norm_stats["var"].astype(jnp.float32) + eps)
+    t = norm_params["bias"].astype(jnp.float32) - \
+        norm_stats["mean"].astype(jnp.float32) * s
+    return s, t
+
+
+def _pack_affines(affines, b, c2):
+    return [(jnp.broadcast_to(pack_vec(s)[None, None], (b, 1, c2)),
+             jnp.broadcast_to(pack_vec(t)[None, None], (b, 1, c2)))
+            for s, t in affines]
+
+
+def _xla_reference_affine(y1_raw, params, affines):
+    """Plain-XLA mirror of the affine-norm stage (oracle + backward)."""
+    def nr(x, a):
+        s, t = a
+        return jnp.maximum(x * s.astype(x.dtype) + t.astype(x.dtype), 0)
+
+    def conv(x, p):
+        return jax.lax.conv_general_dilated(
+            x, p["kernel"].astype(x.dtype), (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + p["bias"].astype(x.dtype)
+
+    t0 = nr(y1_raw, affines[0])
+    u2 = nr(conv(nr(conv(t0, params["c10"]), affines[1]), params["c11"]),
+            affines[2])
+    t1 = jnp.maximum(t0 + u2, 0)
+    v2 = nr(conv(nr(conv(t1, params["c20"]), affines[3]), params["c21"]),
+            affines[4])
+    return jnp.maximum(t1 + v2, 0)
+
+
+def _fused_forward_affine(y1_raw, params, affines):
+    """Affine-norm fused stage, shard_map'd over the active mesh when
+    partitionable.  No stats, no psum — constant affines replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
+
+    def local(y1, p, aff, space_axis=None, space_size=1):
+        xp = pack_view(y1)
+        pa = _pack_affines(aff, xp.shape[0], xp.shape[-1])
+        return _stage_on_packed(xp, pa[0], p, n=1.0, space_axis=space_axis,
+                                space_size=space_size, affines=pa[1:])
+
+    shard = _stem_shard_mesh(y1_raw.shape)
+    if shard is None:
+        return local(y1_raw, params, affines)
+    mesh, d, s = shard
+    spec = P(DATA_AXIS, SPACE_AXIS, None, None)
+    fn = functools.partial(local, space_axis=SPACE_AXIS if s > 1 else None,
+                           space_size=s)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, P(), P()),
+                         out_specs=spec, check_vma=False)(
+                             y1_raw, params, affines)
+
+
+@jax.custom_vjp
+def bn_stem_layer1(y1_raw, params, affines):
+    """Fused affine-norm stage from conv1's raw output (stride-2 conv1
+    configs); XLA-reference backward on global arrays.  ``affines``: five
+    UNPACKED per-channel (s, t) fp32 pairs — [norm1, l1_0.norm1,
+    l1_0.norm2, l1_1.norm1, l1_1.norm2] (see bn_affine) — through which
+    gradients flow to the BatchNorm scale/bias."""
+    return _fused_forward_affine(y1_raw, params, affines)
+
+
+def _fwd_bn(y1_raw, params, affines):
+    return _fused_forward_affine(y1_raw, params, affines), (y1_raw, params,
+                                                            affines)
+
+
+def _bwd_bn(residuals, g):
+    y1_raw, params, affines = residuals
+    _, vjp = jax.vjp(_xla_reference_affine, y1_raw, params, affines)
+    return vjp(g)
+
+
+bn_stem_layer1.defvjp(_fwd_bn, _bwd_bn)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def bn_conv1_stem_layer1(img, c1_params, params, affines, dt=jnp.float32,
+                         stride=1):
+    """Pallas conv1 + affine-norm stage."""
+    return _fused_forward1_affine(img, c1_params, params, affines, dt,
+                                  stride)
+
+
+def _fused_forward1_affine(img, c1_params, params, affines, dt, stride=1):
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
+
+    def local(im, c1p, p, aff, space_axis=None, space_size=1):
+        _, exch3 = _shard_ctx(1, space_axis, space_size, rows=3)
+        yb = (exch3(_conv1_pack_for_halo(im, dt, stride))
+              if space_axis is not None else None)
+        yp, _ = _stem_conv1_any(im, c1p, dt, stride, yb, want_stats=False)
+        pa = _pack_affines(aff, yp.shape[0], yp.shape[-1])
+        return _stage_on_packed(yp, pa[0], p, n=1.0, space_axis=space_axis,
+                                space_size=space_size, affines=pa[1:])
+
+    shard = _stem_shard_mesh(img.shape)
+    if shard is None:
+        return local(img, c1_params, params, affines)
+    mesh, d, s = shard
+    spec = P(DATA_AXIS, SPACE_AXIS, None, None)
+    fn = functools.partial(local, space_axis=SPACE_AXIS if s > 1 else None,
+                           space_size=s)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, P(), P(), P()),
+                         out_specs=spec, check_vma=False)(
+                             img, c1_params, params, affines)
+
+
+def _fwd1_bn(img, c1_params, params, affines, dt, stride):
+    return (_fused_forward1_affine(img, c1_params, params, affines, dt,
+                                   stride),
+            (img, c1_params, params, affines))
+
+
+def _bwd1_bn(dt, stride, residuals, g):
+    img, c1_params, params, affines = residuals
+    _, vjp = jax.vjp(
+        lambda im, c1p, p, aff: _xla_reference_affine(
+            _xla_conv1(im, c1p, dt, stride), p, aff),
+        img, c1_params, params, affines)
+    return vjp(g)
+
+
+bn_conv1_stem_layer1.defvjp(_fwd1_bn, _bwd1_bn)
 
 
 # ------------------------------------------------- reference + custom VJP

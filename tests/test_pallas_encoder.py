@@ -26,8 +26,9 @@ class TestPackedConv:
         B, H, W, C = 1, 8, 12, 8
         x = jnp.asarray(np.abs(rng.normal(size=(B, H, W, C))).astype(np.float32))
         w = jnp.asarray(rng.normal(size=(3, 3, C, C)).astype(np.float32)) * 0.2
-        ident = (jnp.zeros((B, 1, 2 * C), jnp.float32),
-                 jnp.ones((B, 1, 2 * C), jnp.float32))
+        # Identity prep affine: relu(x*1 + 0) (inputs are nonnegative).
+        ident = (jnp.ones((B, 1, 2 * C), jnp.float32),
+                 jnp.zeros((B, 1, 2 * C), jnp.float32))
         y, _ = pe._enc_conv(pe.pack_view(x), ident, pe.pack_weights(w),
                             pe.pack_vec(jnp.zeros((C,), jnp.float32)))
         want = jax.lax.conv_general_dilated(
@@ -79,11 +80,8 @@ class TestEncoderIntegration:
         x = jnp.asarray(rng.normal(size=(2, 32, 48, 3)).astype(np.float32))
         v = enc.init(jax.random.key(0), x)
         plain = enc.apply(v, x)
-        pe.fused_stem_override = True
-        try:
+        with pe.override_fused_stem(True):
             fused = enc.apply(v, x)
-        finally:
-            pe.fused_stem_override = None
         np.testing.assert_allclose(np.asarray(fused), np.asarray(plain),
                                    rtol=2e-3, atol=2e-3)
 
@@ -92,8 +90,14 @@ class TestEncoderIntegration:
         from raftstereo_tpu.parallel.context import use_corr_mesh
 
         shape = (8, 32, 64, 64)
+        # batch norm qualifies structurally (frozen BN folds to an
+        # affine), but 8 images trip the <=4-per-shard auto gate...
         assert not pe.use_fused_stem("batch", shape)
+        assert not pe.use_fused_stem("instance", shape)
+        # ...small batches pass it (on TPU; forced here via override).
+        assert pe.use_fused_stem("batch", (2, 32, 64, 64), override=True)
         assert not pe.use_fused_stem("instance", (8, 32, 63, 64))
+        assert not pe.use_fused_stem("group", shape, override=True)
         # Explicit override (config.fused_encoder) wins over backend auto.
         assert pe.use_fused_stem("instance", shape, override=True)
         assert not pe.use_fused_stem("instance", shape, override=False)
@@ -243,3 +247,135 @@ class TestStatsPrecisionEnvelope:
             want_rstd = 1.0 / np.sqrt(x64.var(axis=(1, 2)) + 1e-5)
             rel = np.abs(np.asarray(rstd)[:, 0] - want_rstd) / want_rstd
             assert rel.max() < tol, (ratio, rel.max())
+
+
+class TestBNAffineStage:
+    """Frozen-BatchNorm encoders through the fused pipeline: the norms
+    fold to constant prep affines (bn_affine) — no stats, no psum."""
+
+    def make(self, rng, C=8):
+        params = {k: {"kernel": jnp.asarray(
+                          rng.normal(size=(3, 3, C, C)).astype(np.float32)) * 0.2,
+                      "bias": jnp.asarray(
+                          rng.normal(size=(C,)).astype(np.float32)) * 0.1}
+                  for k in ("c10", "c11", "c20", "c21")}
+        affines = [(jnp.asarray(np.abs(rng.normal(size=(C,)) * 0.5 + 1)
+                                .astype(np.float32)),
+                    jnp.asarray(rng.normal(size=(C,)).astype(np.float32) * 0.3))
+                   for _ in range(5)]
+        # One dead-gamma channel: the affine form must represent s=0
+        # exactly (output = relu(t)).
+        s0, t0 = affines[1]
+        affines[1] = (s0.at[0].set(0.0), t0.at[0].set(0.7))
+        return params, affines
+
+    def test_matches_affine_reference(self, rng):
+        B, H, W, C = 2, 16, 24, 8
+        y1 = jnp.asarray(rng.normal(size=(B, H, W, C)).astype(np.float32))
+        params, affines = self.make(rng)
+        got = pe.bn_stem_layer1(y1, params, affines)
+        want = pe._xla_reference_affine(y1, params, affines)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_conv1_variant_and_gradients(self, rng):
+        B, H, W = 1, 16, 24
+        img = jnp.asarray(rng.normal(size=(B, H, W, 3)).astype(np.float32))
+        c1 = {"kernel": jnp.asarray(
+                  rng.normal(size=(7, 7, 3, 8)).astype(np.float32)) * 0.2,
+              "bias": jnp.zeros((8,), jnp.float32)}
+        params, affines = self.make(rng)
+        got = pe.bn_conv1_stem_layer1(img, c1, params, affines)
+        want = pe._xla_reference_affine(pe._xla_conv1(img, c1, jnp.float32),
+                                        params, affines)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+        # Gradients flow into the affines (BatchNorm scale/bias train).
+        f = lambda aff: (pe.bn_conv1_stem_layer1(img, c1, params, aff)
+                         ** 2).sum()
+        r = lambda aff: (pe._xla_reference_affine(
+            pe._xla_conv1(img, c1, jnp.float32), params, aff) ** 2).sum()
+        ga, gr = jax.grad(f)(affines), jax.grad(r)(affines)
+        for (a1, b1), (a2, b2) in zip(ga, gr):
+            np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
+                                       rtol=1e-3, atol=1e-4)
+            np.testing.assert_allclose(np.asarray(b1), np.asarray(b2),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_encoder_bn_fused_equals_plain(self, rng):
+        """MultiBasicEncoder-style BN trunk end-to-end: fused == plain,
+        with realistic (nonzero-mean) running statistics."""
+        from raftstereo_tpu.models.encoders import BasicEncoder
+
+        enc = BasicEncoder(output_dim=32, norm_fn="batch", downsample=2,
+                           dtype=jnp.float32)
+        x = jnp.asarray(rng.normal(size=(2, 32, 48, 3)).astype(np.float32))
+        v = enc.init(jax.random.key(0), x)
+        # Perturb running stats away from init (mean 0 / var 1) so the
+        # affine fold is exercised nontrivially.
+        import jax as _jax
+        bs = _jax.tree.map(lambda a: a + 0.3 * jnp.arange(a.size,
+                                                          dtype=a.dtype)
+                           .reshape(a.shape) / a.size, v["batch_stats"])
+        v = {"params": v["params"], "batch_stats": bs}
+        plain = enc.apply(v, x)
+        with pe.override_fused_stem(True):
+            fused = enc.apply(v, x)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(plain),
+                                   rtol=2e-3, atol=2e-3)
+
+
+class TestFusedConv1Stride2:
+    def test_stem_conv1_s2_matches_lax(self, rng):
+        B, H, W = 1, 24, 32   # H/2=12 output rows -> row block 4: halos
+        img = jnp.asarray(rng.normal(size=(B, H, W, 3)).astype(np.float32))
+        c1 = {"kernel": jnp.asarray(
+                  rng.normal(size=(7, 7, 3, 8)).astype(np.float32)) * 0.2,
+              "bias": jnp.asarray(
+                  rng.normal(size=(8,)).astype(np.float32)) * 0.1}
+        y, (s1, s2) = pe._stem_conv1_s2(img, c1, jnp.float32)
+        want = pe._xla_conv1(img, c1, jnp.float32, stride=2)
+        got = pe.unpack_view(y)
+        assert got.shape == want.shape, (got.shape, want.shape)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+        c = s1.shape[-1] // 2
+        t1 = np.asarray(s1[..., :c] + s1[..., c:]).ravel()
+        np.testing.assert_allclose(
+            t1, np.asarray(want.sum(axis=(1, 2))).ravel(), rtol=1e-4)
+
+    def test_conv1_s2_stage_and_gradients(self, rng):
+        img = jnp.asarray(rng.normal(size=(1, 24, 32, 3)).astype(np.float32))
+        c1 = {"kernel": jnp.asarray(
+                  rng.normal(size=(7, 7, 3, 8)).astype(np.float32)) * 0.2,
+              "bias": jnp.zeros((8,), jnp.float32)}
+        params = {k: {"kernel": jnp.asarray(
+                          rng.normal(size=(3, 3, 8, 8)).astype(np.float32)) * 0.2,
+                      "bias": jnp.zeros((8,), jnp.float32)}
+                  for k in ("c10", "c11", "c20", "c21")}
+        got = pe.conv1_stem_layer1(img, c1, params, jnp.float32, 2)
+        want = pe._xla_reference(pe._xla_conv1(img, c1, jnp.float32, 2),
+                                 params)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+        f = lambda im: (pe.conv1_stem_layer1(im, c1, params,
+                                             jnp.float32, 2) ** 2).sum()
+        r = lambda im: (pe._xla_reference(
+            pe._xla_conv1(im, c1, jnp.float32, 2), params) ** 2).sum()
+        np.testing.assert_allclose(np.asarray(jax.grad(f)(img)),
+                                   np.asarray(jax.grad(r)(img)),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_realtime_encoder_shape_bn(self, rng):
+        """MultiBasicEncoder trunk path (BN, downsample 3) end-to-end."""
+        from raftstereo_tpu.models.encoders import BasicEncoder
+
+        enc = BasicEncoder(output_dim=32, norm_fn="batch", downsample=3,
+                           dtype=jnp.float32)
+        x = jnp.asarray(rng.normal(size=(2, 32, 48, 3)).astype(np.float32))
+        v = enc.init(jax.random.key(0), x)
+        plain = enc.apply(v, x)
+        with pe.override_fused_stem(True):
+            fused = enc.apply(v, x)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(plain),
+                                   rtol=2e-3, atol=2e-3)
