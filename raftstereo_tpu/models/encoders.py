@@ -94,10 +94,8 @@ def _stem_layer1(enc, x):
             # 21 ms relayout storm.  Auto keeps the plain XLA stage here;
             # an explicit True override still forces the fused form (the
             # CPU equivalence tests and forced-path evaluations).
-            from ..ops.pallas_encoder import fused_stem_override
-            forced = (enc.fused_stem if enc.fused_stem is not None
-                      else fused_stem_override) is True
-            if forced:
+            from ..ops.pallas_encoder import fused_stem_forced
+            if fused_stem_forced(enc.fused_stem):
                 return bn_stem_layer1(enc.conv1(x), params, affines)
             return _plain_stem(enc, x)
         return stem_layer1(enc.conv1(x), params)

@@ -103,10 +103,19 @@ def _stem_shard_mesh(shape):
     return mesh, d, s
 
 
+def fused_stem_forced(override=None) -> bool:
+    """True iff the fused stage is EXPLICITLY forced on — the same
+    tri-state precedence use_fused_stem applies (per-model config override
+    wins over the module-level one).  Single source of truth for callers
+    that branch on forced-ness (encoders' BN-without-conv1 case)."""
+    ov = override if override is not None else fused_stem_override
+    return ov is True
+
+
 def use_fused_stem(norm_fn: str, shape, override=None) -> bool:
-    """Gate for the fused stage: instance norm, even width, TPU backend
-    (the kernels interpret on CPU for tests, but the plain XLA path is the
-    sane CPU default).
+    """Gate for the fused stage: instance or frozen-batch norm, even
+    width, TPU backend (the kernels interpret on CPU for tests, but the
+    plain XLA path is the sane CPU default).
 
     Sharding: a bare pallas_call cannot be SPMD-partitioned, so under an
     active corr mesh (the evaluator / train / dryrun paths) the stage runs
@@ -822,13 +831,9 @@ def _conv1_pack_for_halo(im, dt, stride):
 def _fused_forward1(img, c1_params, params, dt, stride=1):
     """conv1 + stage, fused end to end; shard_map'd like _fused_forward.
     The stage's stats span the conv1 OUTPUT resolution (H/stride)."""
-    from jax.sharding import PartitionSpec as P
+    n = float((img.shape[1] // stride) * (img.shape[2] // stride))
 
-    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
-
-    def local(im, c1p, p, space_axis=None, space_size=1, n=None):
-        if n is None:
-            n = float((im.shape[1] // stride) * (im.shape[2] // stride))
+    def local(im, c1p, p, space_axis=None, space_size=1):
         _, exch3 = _shard_ctx(1, space_axis, space_size, rows=3)
         imp = _conv1_pack_for_halo(im, dt, stride)
         yb = exch3(imp) if space_axis is not None else None
@@ -836,18 +841,7 @@ def _fused_forward1(img, c1_params, params, dt, stride=1):
         st1 = _expand_stats(*sums, n, space_axis)
         return _stage_on_packed(yp, st1, p, n, space_axis, space_size)
 
-    shard = _stem_shard_mesh(img.shape)
-    if shard is None:
-        return local(img, c1_params, params)
-    mesh, d, s = shard
-    n = float((img.shape[1] // stride) * (img.shape[2] // stride))
-    spec = P(DATA_AXIS, SPACE_AXIS, None, None)
-    fn = functools.partial(local, n=n,
-                           space_axis=SPACE_AXIS if s > 1 else None,
-                           space_size=s)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, P(), P()),
-                         out_specs=spec, check_vma=False)(
-                             img, c1_params, params)
+    return _shard_wrapped(local, img.shape, (img, c1_params, params))
 
 
 def _xla_conv1(img, c1_params, dt, stride=1):
@@ -930,28 +924,15 @@ def _xla_reference_affine(y1_raw, params, affines):
 
 
 def _fused_forward_affine(y1_raw, params, affines):
-    """Affine-norm fused stage, shard_map'd over the active mesh when
-    partitionable.  No stats, no psum — constant affines replicate."""
-    from jax.sharding import PartitionSpec as P
-
-    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
-
+    """Affine-norm fused stage over the active mesh.  No stats, no psum
+    — constant affines replicate."""
     def local(y1, p, aff, space_axis=None, space_size=1):
         xp = pack_view(y1)
         pa = _pack_affines(aff, xp.shape[0], xp.shape[-1])
         return _stage_on_packed(xp, pa[0], p, n=1.0, space_axis=space_axis,
                                 space_size=space_size, affines=pa[1:])
 
-    shard = _stem_shard_mesh(y1_raw.shape)
-    if shard is None:
-        return local(y1_raw, params, affines)
-    mesh, d, s = shard
-    spec = P(DATA_AXIS, SPACE_AXIS, None, None)
-    fn = functools.partial(local, space_axis=SPACE_AXIS if s > 1 else None,
-                           space_size=s)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, P(), P()),
-                         out_specs=spec, check_vma=False)(
-                             y1_raw, params, affines)
+    return _shard_wrapped(local, y1_raw.shape, (y1_raw, params, affines))
 
 
 @jax.custom_vjp
@@ -987,10 +968,6 @@ def bn_conv1_stem_layer1(img, c1_params, params, affines, dt=jnp.float32,
 
 
 def _fused_forward1_affine(img, c1_params, params, affines, dt, stride=1):
-    from jax.sharding import PartitionSpec as P
-
-    from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
-
     def local(im, c1p, p, aff, space_axis=None, space_size=1):
         _, exch3 = _shard_ctx(1, space_axis, space_size, rows=3)
         yb = (exch3(_conv1_pack_for_halo(im, dt, stride))
@@ -1000,16 +977,8 @@ def _fused_forward1_affine(img, c1_params, params, affines, dt, stride=1):
         return _stage_on_packed(yp, pa[0], p, n=1.0, space_axis=space_axis,
                                 space_size=space_size, affines=pa[1:])
 
-    shard = _stem_shard_mesh(img.shape)
-    if shard is None:
-        return local(img, c1_params, params, affines)
-    mesh, d, s = shard
-    spec = P(DATA_AXIS, SPACE_AXIS, None, None)
-    fn = functools.partial(local, space_axis=SPACE_AXIS if s > 1 else None,
-                           space_size=s)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, P(), P(), P()),
-                         out_specs=spec, check_vma=False)(
-                             img, c1_params, params, affines)
+    return _shard_wrapped(local, img.shape,
+                          (img, c1_params, params, affines))
 
 
 def _fwd1_bn(img, c1_params, params, affines, dt, stride):
@@ -1055,29 +1024,37 @@ def _xla_reference(y1_raw, params):
     return jnp.maximum(t1 + v2, 0)
 
 
-def _fused_forward(y1_raw, params):
-    """The fused pipeline, shard_map'd over the active (data, space) mesh
-    when one is set (parallel/context.py) and partitionable.
-
-    Batch sharding needs no communication (instance-norm stats are
-    per-image); space sharding adds a stats psum + 2 ppermute'd halo rows
-    per conv — both tiny next to the conv work.  The trace-time mesh
-    consult mirrors ops/corr.py's Pallas backends."""
+def _shard_wrapped(local, shape, operands):
+    """Run ``local(*operands, space_axis=..., space_size=...)`` inside
+    shard_map over the active (data, space) mesh when one is set
+    (parallel/context.py) and partitionable, else directly.  The FIRST
+    operand is batch/height-sharded; the rest replicate.  Single home for
+    the wrapper plumbing all four fused entry points share."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS, SPACE_AXIS
 
-    shard = _stem_shard_mesh(y1_raw.shape)
+    shard = _stem_shard_mesh(shape)
     if shard is None:
-        return fused_stem_layer1(y1_raw, params)
+        return local(*operands)
     mesh, d, s = shard
-    n = float(y1_raw.shape[1] * y1_raw.shape[2])
     spec = P(DATA_AXIS, SPACE_AXIS, None, None)
-    fn = functools.partial(fused_stem_layer1, n=n,
-                           space_axis=SPACE_AXIS if s > 1 else None,
+    fn = functools.partial(local, space_axis=SPACE_AXIS if s > 1 else None,
                            space_size=s)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, P()),
-                         out_specs=spec, check_vma=False)(y1_raw, params)
+    in_specs = (spec,) + (P(),) * (len(operands) - 1)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=spec,
+                         check_vma=False)(*operands)
+
+
+def _fused_forward(y1_raw, params):
+    """The fused pipeline over the active mesh.  Batch sharding needs no
+    communication (instance-norm stats are per-image); space sharding adds
+    a stats psum + 2 ppermute'd halo rows per conv — both tiny next to the
+    conv work.  The trace-time mesh consult mirrors ops/corr.py."""
+    n = float(y1_raw.shape[1] * y1_raw.shape[2])
+    return _shard_wrapped(
+        functools.partial(fused_stem_layer1, n=n),
+        y1_raw.shape, (y1_raw, params))
 
 
 @jax.custom_vjp
