@@ -47,15 +47,14 @@ def resize_bilinear_align_corners(x: jax.Array, out_hw: Tuple[int, int]) -> jax.
     oh, ow = out_hw
     if (h, w) == (oh, ow):
         return x
-    # Lerp in the INPUT dtype: fp32 inputs keep exact fp32 lerps (the
-    # eval-parity path), while bf16 inputs stay bf16 end to end — the
-    # fp32 upcast doubled the in-loop resizes' HBM traffic (profiled
-    # ~0.47 ms/iter at flagship batch 8) for weight precision the
-    # bf16-quantized operands cannot use.  Integer/other inputs lerp in
-    # fp32 as before.
+    # Lerp in the INPUT dtype for the two compute dtypes the model uses:
+    # fp32 inputs keep exact fp32 lerps (the eval-parity path), while
+    # bf16 inputs stay bf16 end to end — the fp32 upcast doubled the
+    # in-loop resizes' HBM traffic for weight precision the
+    # bf16-quantized operands cannot use.  Everything else (ints, fp16)
+    # lerps in fp32 as before.
     dtype = x.dtype
-    cdt = dtype if dtype in (jnp.float32, jnp.bfloat16,
-                             jnp.float16) else jnp.float32
+    cdt = dtype if dtype in (jnp.float32, jnp.bfloat16) else jnp.float32
     xf = x.astype(cdt)
     i0, i1, wh = _axis_resize_indices(h, oh)
     wh = wh.astype(cdt)

@@ -116,7 +116,7 @@ def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds,
 
 
 def _alt_pyr_radial_kernel(f1_ref, f2_ref, x_ref, out_ref, *, scale, bounds,
-                           radius, prec="highest"):
+                           radius, prec="highest", level_scales=None):
     """Model-pattern lookup: taps are x + k for k in [-radius, radius], so
     every tap of a level shares floor(x)/frac(x).  Instead of K dense hat
     sweeps (~6 VPU ops per column-visit), sweep K+1 integer WINDOWS
@@ -137,7 +137,11 @@ def _alt_pyr_radial_kernel(f1_ref, f2_ref, x_ref, out_ref, *, scale, bounds,
     cols = []
     for li, (off, w2p) in enumerate(bounds):
         ml = m[:, :, off:off + w2p]
-        xl = x[:, :, li]
+        # level_scales (static): x carries only the LEVEL-0 center and the
+        # per-level locals are derived in-register — the (B, H, W1, L)
+        # center tensor cost 28 us/iter of 24 GB/s loop fusion outside.
+        xl = (x[:, :, li] if level_scales is None
+              else x[:, :, 0] * level_scales[li])
         b0 = jnp.floor(xl)
         f = xl - b0                               # (R, blk)
         j = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2p), 2)
@@ -262,7 +266,8 @@ def pallas_alt_pyramid_radial_flat(f1flat: jax.Array, f2cat: jax.Array,
                                    radius: int,
                                    precision: str = "highest",
                                    out_dtype=jnp.float32,
-                                   out_channels: int = 0) -> jax.Array:
+                                   out_channels: int = 0,
+                                   level_scales: tuple = None) -> jax.Array:
     """Model-pattern variant of :func:`pallas_alt_pyramid_flat`: instead of
     explicit per-tap coordinates it takes the per-level LOCAL center
     ``x_levels`` (B, H, W1, L) and the static ``radius``, and resolves the
@@ -272,29 +277,39 @@ def pallas_alt_pyramid_radial_flat(f1flat: jax.Array, f2cat: jax.Array,
     (equivalence pinned in tests/test_pallas_alt.py).
 
     ``out_channels`` (when > L*K) zero-pads the channel axis in-kernel so
-    consumers read a lane-friendly width (see the kernel comment)."""
+    consumers read a lane-friendly width (see the kernel comment).
+
+    ``level_scales`` (static tuple of floats): when given, ``x_levels``
+    carries a SINGLE channel — the level-0 center — and each level's
+    local center is derived in-kernel as x * level_scales[l], removing
+    the per-level center tensor from HBM entirely (the model's pattern:
+    scales 2**-l)."""
     return _make_alt_pyr_radial(f1flat.shape, f2cat.shape, tuple(w2s),
                                 radius, f1flat.dtype.name, f2cat.dtype.name,
                                 precision, jnp.dtype(out_dtype).name,
-                                out_channels)(f1flat, f2cat, x_levels)
+                                out_channels,
+                                tuple(level_scales)
+                                if level_scales is not None
+                                else None)(f1flat, f2cat, x_levels)
 
 
 @functools.lru_cache(maxsize=None)
 def _make_alt_pyr_radial(f1flat_shape, f2cat_shape, w2s, radius, f1_dtype,
                          f2_dtype, precision="highest", out_dtype="float32",
-                         out_channels=0):
+                         out_channels=0, level_scales=None):
     bounds = bounds_from_widths(w2s)
     odt = jnp.dtype(out_dtype)
 
     @jax.custom_vjp
     def f(f1flat, f2cat, x):
         return _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
-                                        precision, odt, out_channels)
+                                        precision, odt, out_channels,
+                                        level_scales)
 
     def fwd(f1flat, f2cat, x):
         return _alt_pyr_radial_fwd_impl(
             f1flat, f2cat, x, bounds, radius, precision, odt,
-            out_channels), (f1flat, f2cat, x)
+            out_channels, level_scales), (f1flat, f2cat, x)
 
     def bwd(res, g):
         f1flat, f2cat, x = res
@@ -302,10 +317,14 @@ def _make_alt_pyr_radial(f1flat_shape, f2cat_shape, w2s, radius, f1_dtype,
         # radial pattern is just its special case, so materialize the taps
         # (a small XLA broadcast-add on the backward path only).  Channel
         # padding carries no gradient: slice the cotangent back to L*K.
-        lk = x.shape[-1] * (2 * radius + 1)
+        if level_scales is not None:
+            scales = jnp.asarray(level_scales, jnp.float32)
+            xl = x.astype(jnp.float32)[..., 0:1] * scales
+        else:
+            xl = x.astype(jnp.float32)
+        lk = xl.shape[-1] * (2 * radius + 1)
         offsets = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
-        taps = (x.astype(jnp.float32)[..., None] + offsets).reshape(
-            *x.shape[:-1], lk)
+        taps = (xl[..., None] + offsets).reshape(*xl.shape[:-1], lk)
         df1, df2 = _alt_pyr_bwd_impl(f1flat, f2cat, taps, g[..., :lk],
                                      bounds, precision)
         return (df1[:f1flat.shape[0]].astype(f1_dtype),
@@ -318,7 +337,7 @@ def _make_alt_pyr_radial(f1flat_shape, f2cat_shape, w2s, radius, f1_dtype,
 
 def _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
                              prec="highest", out_dtype=jnp.float32,
-                             out_channels=0):
+                             out_channels=0, level_scales=None):
     f1flat = _pad_rows(f1flat)  # no-ops for preflatten_* outputs
     f2cat = _pad_rows(f2cat)
     n, w1p, c = f1flat.shape
@@ -326,11 +345,13 @@ def _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
     t, blk = _pad_taps(x, n)
     scale = 1.0 / float(c) ** 0.5
     w2cat = f2cat.shape[1]
-    lk = max(nl * (2 * radius + 1), out_channels)
+    n_lvl = len(bounds) if level_scales is not None else nl
+    lk = max(n_lvl * (2 * radius + 1), out_channels)
     r = _BLOCK_ROWS
     out = pl.pallas_call(
         functools.partial(_alt_pyr_radial_kernel, scale=scale, bounds=bounds,
-                          radius=radius, prec=prec),
+                          radius=radius, prec=prec,
+                          level_scales=level_scales),
         out_shape=jax.ShapeDtypeStruct((n, w1p, lk), out_dtype),
         grid=(n // r, w1p // blk),
         in_specs=[

@@ -281,3 +281,44 @@ class TestRadialKernel:
         assert got.dtype == jnp.bfloat16
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(ref), rtol=1e-2, atol=1e-2)
+
+    def test_level_scales_matches_explicit_centers(self, fmaps, coords):
+        """The static level_scales path (single-channel level-0 center,
+        per-level locals derived in-kernel) must equal the explicit
+        per-level-centers path, gradients included."""
+        from raftstereo_tpu.ops.pallas_alt import (
+            pallas_alt_pyramid_radial_flat)
+        f1, f2 = fmaps
+        radius, levels = 4, 3
+        f1flat, f2cat, w2s = self._flats(f1, f2, levels)
+        x = jnp.asarray(coords)[..., 0]
+        scales = tuple(1.0 / 2.0 ** i for i in range(levels))
+        xl = jnp.stack([x * s for s in scales], axis=-1)
+        want = pallas_alt_pyramid_radial_flat(f1flat, f2cat, xl, w2s, radius)
+        got = pallas_alt_pyramid_radial_flat(f1flat, f2cat, x[..., None],
+                                             w2s, radius,
+                                             level_scales=scales)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+        # Gradients in the PRODUCTION configuration: level_scales is
+        # always combined with out_channels padding in the model
+        # (raft_stereo passes a lane-friendly width), so the bwd's
+        # lk-derivation + cotangent slice must be exercised with padded
+        # channels.
+        oc = 64
+
+        def loss_s(a, b):
+            return (pallas_alt_pyramid_radial_flat(
+                a, b, x[..., None], w2s, radius, level_scales=scales,
+                out_channels=oc) ** 2).sum()
+
+        def loss_e(a, b):
+            return (pallas_alt_pyramid_radial_flat(
+                a, b, xl, w2s, radius, out_channels=oc) ** 2).sum()
+
+        gs = jax.grad(loss_s, argnums=(0, 1))(f1flat, f2cat)
+        ge = jax.grad(loss_e, argnums=(0, 1))(f1flat, f2cat)
+        for a, b in zip(gs, ge):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
