@@ -72,14 +72,16 @@ def _stem_layer1(enc, x):
         shard = _stem_shard_mesh(oshape)
         local_imgs = x.shape[0] // (shard[1] if shard is not None else 1)
         local_h = oshape[1] // (shard[2] if shard is not None else 1)
-        # The stride-2 packed-fours conv1 kernel exists
-        # (pallas_encoder._stem_conv1_s2, tested) but measures a NET LOSS
-        # at realtime shapes (same-session: 98.8 vs 110.1 pairs/sec with
-        # the XLA stride-2 conv feeding the fused stage) — the
-        # parity-split row view costs more than the 11.8 TF/s XLA conv it
-        # replaces — so only stride 1 takes the Pallas conv1 path.
+        # Stride 2 (downsample 3 / realtime) uses the packed-fours kernel;
+        # it needs W % 4 == 0 and even H.  Both conv1 kernels pre-shift
+        # the narrow input and fold the column offsets into one dot per
+        # row tap — the first formulation rolled the 128-wide fp32
+        # accumulator per offset and measured a net LOSS; restructured,
+        # the stride-2 path flips to a +2.5-4% realtime win (alternating
+        # same-process A/B — the chip drifts, docs/perf_notes_r04.md).
         ok_geom = (x.shape[-1] == 3 and local_imgs <= 4 and local_h >= 3
-                   and stride == 1)
+                   and (stride == 1
+                        or (x.shape[1] % 2 == 0 and x.shape[2] % 4 == 0)))
         if ok_geom:
             c1p = enc.conv1.variables["params"]
             if affines is not None:

@@ -595,34 +595,43 @@ def pack_weights7(w: jax.Array) -> jax.Array:
 
 
 def _stem7_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, *stat_refs,
-                  wp, rows):
+                  rows):
     """7x7 stride-1 packed conv of the RAW input image tile + fp32 output
     stats (for norm1).  No prep/halo masking: the input is the [-1, 1]
-    image itself, so zero halo rows ARE the conv's zero padding."""
+    image itself, so zero halo rows ARE the conv's zero padding.
+
+    The 5 packed-column offsets are resolved by PRE-SHIFTING the
+    6-channel input (roll + zero-mask on 6 lanes) and concatenating into
+    one K=30 operand per dy tap — rolling/masking the 128-wide fp32
+    accumulator per offset instead (the first formulation) made the
+    whole kernel run at a ~38 GB/s effective write rate."""
     t = x_ref[...]                     # (1, R, Wp, 6)
     th = xh_ref[...][:, 0]             # (1, 6, Wp, 6): 3 above, 3 below
     full = jnp.concatenate([th[:, :3], t, th[:, 3:]], axis=1)
-    w = w_ref[...]
-    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, wp, 1), 2)
-    y = None
+    w = w_ref[...]                     # (7, 5, 6, 128)
+    zc = jnp.zeros_like(full[:, :, :2])
+    shifts = []
     for dpi in range(5):
-        u = None
-        for dyi in range(7):
-            m = jax.lax.dot_general(
-                full[:, dyi:dyi + rows], w[dyi, dpi],
-                (((3,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            u = m if u is None else u + m
         o = dpi - 2
         if o == 0:
-            shifted = u
+            shifts.append(full)
+        elif o > 0:
+            # xshift_o[p] = full[p + o], zero outside [0, wp); static
+            # sublane-dim slices (Mosaic cannot rotate bf16 sublanes).
+            shifts.append(jnp.concatenate(
+                [full[:, :, o:], zc[:, :, :o]], axis=2))
         else:
-            shifted = pltpu.roll(u, (-o) % wp, 2)
-            if o > 0:
-                shifted = jnp.where(col < wp - o, shifted, 0.0)
-            else:
-                shifted = jnp.where(col >= -o, shifted, 0.0)
-        y = shifted if y is None else y + shifted
+            shifts.append(jnp.concatenate(
+                [zc[:, :, :(-o)], full[:, :, :o]], axis=2))
+    xcat = jnp.concatenate(shifts, axis=-1)         # (1, R+6, Wp, 30)
+    wcat = w.reshape(7, 5 * w.shape[2], w.shape[3])
+    y = None
+    for dyi in range(7):
+        m = jax.lax.dot_general(
+            xcat[:, dyi:dyi + rows], wcat[dyi],
+            (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        y = m if y is None else y + m
     y = y + b_ref[...][:, :, None, :]
     y_ref[...] = y.astype(y_ref.dtype)
     _acc_stats(y, stat_refs)
@@ -647,7 +656,7 @@ def pack_weights7s2(w: jax.Array) -> jax.Array:
 
 
 def _stem7s2_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, *stat_refs,
-                    wq, rows):
+                    rows):
     """7x7 STRIDE-2 packed conv of the raw input image + fp32 output
     stats.  x_ref: (1, 2R, Wq, 12) input rows for this block's R output
     rows; xh_ref: (1, 5, Wq, 12) = 3 rows above + 2 below.  Output row r
@@ -659,29 +668,34 @@ def _stem7s2_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, *stat_refs,
     full = jnp.concatenate(
         [th[:, :3], t, th[:, 3:5],
          jnp.zeros_like(th[:, :1])], axis=1)        # (1, 2R+6, Wq, 12)
-    view = full.reshape(1, rows + 3, 2, full.shape[2], full.shape[3])
-    w = w_ref[...]
-    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, wq, 1), 2)
-    y = None
+    # Pre-shift the 12-channel input (static sublane-dim slices) and fold
+    # the 3 packed-column offsets into one K=36 operand per dy tap —
+    # same rationale as _stem7_kernel (rolling the 128-wide accumulator
+    # per offset dominated the kernel).
+    zc = jnp.zeros_like(full[:, :, :1])
+    shifts = []
     for dqi in range(3):
-        u = None
-        for dyi in range(7):
-            e, par = divmod(dyi, 2)
-            m = jax.lax.dot_general(
-                view[:, e:e + rows, par], w[dyi, dqi],
-                (((3,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            u = m if u is None else u + m
         o = dqi - 1
         if o == 0:
-            shifted = u
+            shifts.append(full)
+        elif o > 0:
+            shifts.append(jnp.concatenate(
+                [full[:, :, o:], zc[:, :, :o]], axis=2))
         else:
-            shifted = pltpu.roll(u, (-o) % wq, 2)
-            if o > 0:
-                shifted = jnp.where(col < wq - o, shifted, 0.0)
-            else:
-                shifted = jnp.where(col >= -o, shifted, 0.0)
-        y = shifted if y is None else y + shifted
+            shifts.append(jnp.concatenate(
+                [zc[:, :, :(-o)], full[:, :, :o]], axis=2))
+    xcat = jnp.concatenate(shifts, axis=-1)         # (1, 2R+6, Wq, 36)
+    view = xcat.reshape(1, rows + 3, 2, xcat.shape[2], xcat.shape[3])
+    w = w_ref[...]                                  # (7, 3, 12, 128)
+    wcat = w.reshape(7, 3 * w.shape[2], w.shape[3])  # dq-major, like xcat
+    y = None
+    for dyi in range(7):
+        e, par = divmod(dyi, 2)
+        m = jax.lax.dot_general(
+            view[:, e:e + rows, par], wcat[dyi],
+            (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        y = m if y is None else y + m
     y = y + b_ref[...][:, :, None, :]
     y_ref[...] = y.astype(y_ref.dtype)
     _acc_stats(y, stat_refs)
@@ -725,7 +739,7 @@ def _stem_conv1_s2(img, c1_params, dt, boundary=None, want_stats=True):
     if want_stats:
         out_shape += [jax.ShapeDtypeStruct((b, 1, co2), jnp.float32)] * 2
     out = pl.pallas_call(
-        functools.partial(_stem7s2_kernel, wq=wq, rows=r),
+        functools.partial(_stem7s2_kernel, rows=r),
         out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
@@ -785,7 +799,7 @@ def _stem_conv1(img, c1_params, dt, boundary=None, want_stats=True):
     if want_stats:
         out_shape += [jax.ShapeDtypeStruct((b, 1, co2), jnp.float32)] * 2
     out = pl.pallas_call(
-        functools.partial(_stem7_kernel, wp=wp, rows=r),
+        functools.partial(_stem7_kernel, rows=r),
         out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
