@@ -18,6 +18,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import RAFTStereoConfig
 from ..ops.image import avg_pool2x, resize_bilinear_align_corners
@@ -28,34 +29,46 @@ from .layers import conv, kaiming_out
 tap_head_override = None
 
 
-def _use_tap_head(batch: int) -> bool:
+def _use_tap_head() -> bool:
     """The tap-matmul form of the narrow 3x3 head conv is a TPU fix (N=2
     output channels waste the MXU's 128 N-lanes — measured 3.5 TF/s,
     costing as much as a 256->128 conv; docs/perf_notes_r03.md).  CPU/GPU
-    keep the plain conv, as do large batches: measured same-session A/B at
-    flagship shapes, batch 1 9.80 -> 10.45 pairs/sec (+6.6%), realtime
-    105.7 -> 108.7, but batch 8 11.87 -> 11.47 (the 9-slice shift-add
-    epilogue loses to the conv's batch amortization)."""
+    keep the plain conv.  The tap combination has two epilogues chosen by
+    per-shard batch inside tap_conv3x3 (both A/B-measured, the tap form
+    wins at every batch size with the right epilogue)."""
     if tap_head_override is not None:
         return tap_head_override
+    return jax.default_backend() == "tpu"
+
+
+def _local_batch(batch: int) -> int:
     from ..parallel.context import active_corr_mesh
     from ..parallel.mesh import DATA_AXIS
 
     mesh = active_corr_mesh()
-    if mesh is not None:  # gate on PER-SHARD batch, like the conv1 gate
+    if mesh is not None:  # per-shard batch, like the conv1 gate
         batch = max(1, batch // mesh.shape.get(DATA_AXIS, 1))
-    return jax.default_backend() == "tpu" and batch <= 2
+    return batch
 
 
 def tap_conv3x3(conv_mod, y):
     """A bound SAME-padded 3x3 nn.Conv with FEW output channels, computed
-    as one 1x1 matmul into kh*kw*co per-tap channels + a 9-slice shift-add.
+    as one 1x1 matmul into kh*kw*co per-tap channels + a tiny constant
+    SELECTOR conv that shifts-and-sums the taps.
 
     o[p] = sum_t K[t] . y[p + t - 1]  ==  sum_t z_t[p + t - 1] where
     z_t = y . K[t] is pointwise — so one (ci -> 9*co) matmul (padded to a
-    full MXU N-tile instead of 2/128 lanes) replaces the narrow conv, and
-    the taps are combined by 9 shifted adds of a (B, H, W, 9*co) tensor
-    that is ~28x smaller than the conv's input."""
+    full MXU N-tile instead of 2/128 lanes) replaces the narrow conv.
+    Two epilogues combine the taps, chosen by per-shard batch
+    (alternating same-process A/Bs, docs/perf_notes_r04.md):
+
+    * batch <= 2: 9 shifted adds of the 28x-smaller z (batch 1
+      9.80 -> 10.45 pairs/sec vs plain; realtime +2.8%; the selector
+      conv's launch overhead costs ~10% at realtime's tiny spatial);
+    * batch > 2: a 3x3 conv with CONSTANT block-identity weights
+      S[dy, dx, tin, c] = [tin == (dy*3+dx)*co + c] (batch 8
+      12.58/12.69 -> 12.71/12.90 vs plain; the co=2 strided slices of
+      the other epilogue are lane-hostile at batch amortization)."""
     _assert_default_conv_geometry(conv_mod)
     p = conv_mod.variables["params"]
     k = p["kernel"]
@@ -64,13 +77,25 @@ def tap_conv3x3(conv_mod, y):
     assert tuple(conv_mod.padding) == ((1, 1), (1, 1)), conv_mod.padding
     w = k.transpose(2, 0, 1, 3).reshape(ci, kh * kw * co).astype(y.dtype)
     z = jnp.tensordot(y, w, 1)
-    zp = jnp.pad(z, ((0, 0), (1, 1), (1, 1), (0, 0)))
-    h, wd = y.shape[1], y.shape[2]
-    o = None
+    if _local_batch(y.shape[0]) <= 2:
+        zp = jnp.pad(z, ((0, 0), (1, 1), (1, 1), (0, 0)))
+        h, wd = y.shape[1], y.shape[2]
+        o = None
+        for t in range(kh * kw):
+            dy, dx = divmod(t, kw)
+            s = zp[:, dy:dy + h, dx:dx + wd, t * co:(t + 1) * co]
+            o = s if o is None else o + s
+        return o + p["bias"].astype(y.dtype)
+    sel = np.zeros((kh, kw, kh * kw * co, co), np.float32)
     for t in range(kh * kw):
         dy, dx = divmod(t, kw)
-        s = zp[:, dy:dy + h, dx:dx + wd, t * co:(t + 1) * co]
-        o = s if o is None else o + s
+        for c in range(co):
+            # lax.conv is cross-correlation: tap (a, b) reads
+            # in[p + (a-1, b-1)], and o[p] needs z_t[p + (dy-1, dx-1)].
+            sel[dy, dx, t * co + c, c] = 1.0
+    o = jax.lax.conv_general_dilated(
+        z, jnp.asarray(sel, y.dtype), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
     return o + p["bias"].astype(y.dtype)
 
 
@@ -88,14 +113,14 @@ class FlowHead(nn.Module):
 
     def __call__(self, x):
         y = nn.relu(self.conv1(x))
-        if self.is_initializing() or not _use_tap_head(x.shape[0]):
+        if self.is_initializing() or not _use_tap_head():
             return self.conv2(y)
         return tap_conv3x3(self.conv2, y)
 
     def from_hidden(self, y):
         """Head output from an already-computed relu(conv1(x)) activation
         (the merged-head path in BasicMultiUpdateBlock)."""
-        if _use_tap_head(y.shape[0]):
+        if _use_tap_head():
             return tap_conv3x3(self.conv2, y)
         return self.conv2(y)
 

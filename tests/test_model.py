@@ -298,23 +298,27 @@ class TestHeadFastForms:
     plain formulations they replace."""
 
     def test_tap_conv3x3_matches_conv(self, rng):
+        # batch 2 exercises the shift-add epilogue, batch 4 the constant
+        # selector-conv epilogue (chosen inside tap_conv3x3).
         from raftstereo_tpu.models import update as upd
 
         head = upd.FlowHead(hidden_dim=32, output_dim=2)
-        x = jnp.asarray(rng.normal(size=(2, 12, 18, 16)).astype(np.float32))
-        v = head.init(jax.random.key(0), x)
-        upd.tap_head_override = False
-        try:
-            plain = head.apply(v, x)
-        finally:
-            upd.tap_head_override = None
-        upd.tap_head_override = True
-        try:
-            tap = head.apply(v, x)
-        finally:
-            upd.tap_head_override = None
-        np.testing.assert_allclose(np.asarray(tap), np.asarray(plain),
-                                   rtol=1e-5, atol=1e-6)
+        for b in (2, 4):
+            x = jnp.asarray(rng.normal(size=(b, 12, 18, 16))
+                            .astype(np.float32))
+            v = head.init(jax.random.key(0), x)
+            upd.tap_head_override = False
+            try:
+                plain = head.apply(v, x)
+            finally:
+                upd.tap_head_override = None
+            upd.tap_head_override = True
+            try:
+                tap = head.apply(v, x)
+            finally:
+                upd.tap_head_override = None
+            np.testing.assert_allclose(np.asarray(tap), np.asarray(plain),
+                                       rtol=1e-5, atol=1e-6)
 
     def test_train_mode_merged_head_matches_plain(self, default_model, rng):
         """Train-mode forward (merged head path) vs a manual per-iteration
