@@ -29,14 +29,12 @@ METRIC = "stereo-pairs/sec/chip @960x540, 32 GRU iters"
 
 
 def resolve_corr(corr: str) -> str:
-    """'auto' -> the fastest backend for the active platform: the on-demand
-    Pallas kernel on TPU (fastest measured AND O(H*W) memory), the XLA
-    gather path on anything else (the Pallas kernels are TPU-only)."""
-    import jax
+    """'auto' -> the fastest backend for the active platform (the package's
+    single resolver — ops/corr.py): the on-demand Pallas kernel on TPU
+    (fastest measured AND O(H*W) memory), the XLA gather path elsewhere."""
+    from raftstereo_tpu.ops.corr import resolve_implementation
 
-    if corr == "auto":
-        return "pallas_alt" if jax.default_backend() == "tpu" else "reg"
-    return corr
+    return resolve_implementation(corr)
 
 
 def measure_matmul_peak_tflops(reps: int = 2000, n: int = 4096) -> float:
@@ -385,7 +383,88 @@ def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
     return steps_per_sec, extras
 
 
-def bench_data(batch: int, num_workers: int) -> float:
+def bench_tiled(height: int, width: int, iters: int, corr: str,
+                compute_dtype: str, tile_batch: int,
+                tile_hw=(1056, 1568), overlap: int = 128,
+                margin: int = 512):
+    """BASELINE config #5: Middlebury-4K-scale tiled inference on the chip.
+
+    Runs a synthetic ``height x width`` pair (default 4000x6000 — the
+    Middlebury 4K shape, BASELINE.json:11) through eval/tiled.py with the
+    on-demand correlation backend: fixed-shape overlapping tiles, one
+    compiled program, host-side accumulation so peak HBM is
+    O(tile_batch x tile) regardless of image size.  The reference has no
+    tiling at all — its answer to large images is the slow ``alt`` path
+    plus downsampling (reference: README.md:111,121).
+
+    Returns (wall_s, extras): full-pair wall-clock of the SECOND (warm)
+    pass plus tile bookkeeping and the device's peak-HBM reading."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raftstereo_tpu.config import RAFTStereoConfig
+    from raftstereo_tpu.eval.tiled import plan_geometry, tiled_infer
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+
+    corr = resolve_corr(corr)
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+
+    rng = np.random.default_rng(0)
+    img1 = rng.integers(0, 255, (height, width, 3)).astype(np.float32)
+    img2 = rng.integers(0, 255, (height, width, 3)).astype(np.float32)
+
+    # The plan comes from the SAME helper tiled_infer executes
+    # (plan_geometry), so the reported tile count cannot drift from the run.
+    th, tw, ys, xs, _, _ = plan_geometry(height, width, tile_hw, overlap,
+                                         margin)
+    # ONE compile, reused for both the memory analysis and every tile
+    # dispatch (AOT executable passed as infer_fn — a second jit would
+    # recompile the identical program, minutes over the tunnel).
+    comp = jax.jit(
+        lambda v, a, b: model.forward(v, a, b, iters=iters,
+                                      test_mode=True)).lower(
+        variables,
+        jax.ShapeDtypeStruct((tile_batch, th, tw, 3), jnp.float32),
+        jax.ShapeDtypeStruct((tile_batch, th, tw, 3), jnp.float32),
+    ).compile()
+    # Peak device memory from XLA's own allocator analysis (the tunneled
+    # axon device returns None from memory_stats(), so runtime polling is
+    # unavailable): peak = args + outputs + temp — everything resident
+    # during a tile dispatch.
+    mem_gb = None
+    try:
+        ma = comp.memory_analysis()
+        mem_gb = round((ma.argument_size_in_bytes + ma.output_size_in_bytes
+                        + ma.temp_size_in_bytes) / 2**30, 3)
+    except Exception as e:
+        print(f"memory analysis unavailable: {e}", file=sys.stderr)
+    kw = dict(iters=iters, tile_hw=(th, tw), overlap=overlap,
+              disp_margin=margin, infer_fn=lambda v, a, b: comp(v, a, b),
+              tile_batch=tile_batch)
+    tiled_infer(model, variables, img1, img2, **kw)     # warm
+    t0 = time.perf_counter()
+    disp = tiled_infer(model, variables, img1, img2, **kw)
+    wall = time.perf_counter() - t0
+    assert disp.shape == (height, width) and np.isfinite(disp).all()
+
+    extras = {
+        "image": f"{width}x{height}",
+        "tiles": len(ys) * len(xs),
+        "tile_hw": [th, tw],
+        "tile_batch": tile_batch,
+        "wall_s": round(wall, 2),
+        "megapixels_per_sec": round(height * width / wall / 1e6, 2),
+        "peak_hbm_gb": mem_gb,
+    }
+    return 1.0 / wall, extras
+
+
+def bench_data(batch: int, num_workers: int,
+               device_photometric: bool = False) -> float:
     """Host data-pipeline throughput: KITTI-size decode + full sparse
     augmentation to the training crop, multiprocess workers, samples/sec.
     (KITTI is a sparse-GT dataset, so this exercises SparseFlowAugmentor.)
@@ -393,7 +472,12 @@ def bench_data(batch: int, num_workers: int) -> float:
     The number to beat is the train step's consumption rate (steps/sec x
     batch); the pipeline feeds the TPU (SURVEY.md §7 hard part 6 — the
     reference leans on torch DataLoader workers, core/stereo_datasets.py:311).
-    """
+
+    ``device_photometric`` measures the MITIGATED pipeline: photometric
+    jitter + eraser moved into the jitted train step (data/device_aug.py,
+    --device_photometric), so the host does decode + spatial-only
+    augmentation — what a real training host pays when the chip absorbs
+    the color work."""
     import shutil
     import tempfile
 
@@ -418,6 +502,9 @@ def bench_data(batch: int, num_workers: int) -> float:
             write_disp_kitti(os.path.join(
                 root, "training", "disp_occ_0", f"{i:06d}_10.png"), disp)
         ds = KITTI(aug_params={"crop_size": (320, 720)}, root=root) * 8
+        if device_photometric:
+            from raftstereo_tpu.data.datasets import take_photometric_params
+            take_photometric_params(ds)  # host: decode + spatial only
         loader = DataLoader(ds, batch_size=batch, num_workers=num_workers)
         n = 0
         it = iter(loader)
@@ -461,8 +548,10 @@ def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--height", type=int, default=540)
-    p.add_argument("--width", type=int, default=960)
+    p.add_argument("--height", type=int, default=None,
+                   help="image height (default 540; 4000 with --tiled)")
+    p.add_argument("--width", type=int, default=None,
+                   help="image width (default 960; 6000 with --tiled)")
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--iters", type=int, default=32)
     p.add_argument("--corr", default="auto",
@@ -496,19 +585,43 @@ def main() -> None:
                    help="measure training steps/sec (full fwd+bwd+update) "
                         "instead of inference; use with --height 320 "
                         "--width 720 --batch 8 for the reference recipe")
+    p.add_argument("--tiled", action="store_true",
+                   help="benchmark BASELINE config #5: tiled 4K inference "
+                        "(synthetic 6000x4000 pair through eval/tiled.py, "
+                        "on-demand corr, host-HBM streaming); --height/"
+                        "--width override the image shape")
+    p.add_argument("--tile_batch", type=int, default=4,
+                   help="tiles per device dispatch for --tiled (amortizes "
+                        "the ~190 ms tunnel dispatch; peak HBM is "
+                        "O(tile_batch x tile))")
     p.add_argument("--data", action="store_true",
                    help="measure host data-pipeline throughput (KITTI-size "
                         "decode + sparse augmentation, multiprocess workers) "
                         "in samples/sec")
     p.add_argument("--num_workers", type=int, default=None,
                    help="worker processes for --data (default: SLURM-aware)")
+    p.add_argument("--device_photometric", action="store_true",
+                   help="with --data: measure the mitigated host pipeline "
+                        "(photometric jitter + eraser moved on-device, "
+                        "host does decode + spatial aug only)")
     args = p.parse_args()
+    explicit_hw = args.height is not None or args.width is not None
+    # Defaults keyed on the mode, resolved only when the flag was NOT
+    # given — an explicit --height/--width always wins (also under --tiled,
+    # also with --quick).
+    if args.height is None:
+        args.height = 4000 if args.tiled else 540
+    if args.width is None:
+        args.width = 6000 if args.tiled else 960
 
     if args.data:
-        value = bench_data(args.batch, args.num_workers)
+        value = bench_data(args.batch, args.num_workers,
+                           args.device_photometric)
+        aug = ("spatial-only aug (photometric on device)"
+               if args.device_photometric else "sparse aug")
         print(json.dumps({
-            "metric": f"data-pipeline samples/sec, KITTI decode + sparse "
-                      f"aug to 320x720, batch {args.batch}",
+            "metric": f"data-pipeline samples/sec, KITTI decode + {aug} "
+                      f"to 320x720, batch {args.batch}",
             "value": round(value, 2),
             "unit": "samples/sec",
             "vs_baseline": 0.0,
@@ -525,6 +638,31 @@ def main() -> None:
     # through jax.config so `JAX_PLATFORMS=cpu python bench.py` works.
     from raftstereo_tpu.utils import apply_env_platform
     apply_env_platform()
+
+    if args.tiled:
+        h, w = args.height, args.width
+        tile_kw = {}
+        if args.quick:
+            # CPU-feasible geometry that still exercises multi-tile
+            # stitching, the batched dispatch, and the tail-group pad;
+            # an explicitly passed --height/--width still wins.
+            if not explicit_hw:
+                h, w = 288, 800
+            args.tile_batch = 2
+            tile_kw = dict(tile_hw=(256, 384), overlap=32, margin=64)
+        value, extras = bench_tiled(h, w, args.iters, args.corr,
+                                    args.compute_dtype, args.tile_batch,
+                                    **tile_kw)
+        record = {
+            "metric": f"tiled 4K pairs/sec @{w}x{h}, {args.iters} GRU "
+                      f"iters, host-HBM streaming",
+            "value": round(value, 4),
+            "unit": "pairs/sec",
+            "vs_baseline": 0.0,
+        }
+        record.update(extras)
+        print(json.dumps(record))
+        return
 
     if args.train:
         if args.realtime:

@@ -104,6 +104,30 @@ def _stem_layer1(enc, x):
     return _plain_stem(enc, x)
 
 
+def _trunk_layer2(enc, x):
+    """layer2 (two ResidualBlocks, first stride-2 + projection), with the
+    fused Pallas fast path on TPU: round-5 profiling puts ~15 ms of the
+    flagship fixed stage in XLA's layer2+ convs and their blocked-layout
+    relayouts (docs/perf_notes_r05.md); the fused stage keeps everything
+    row-major (ops/pallas_layer2.py).  Numerically pinned against this
+    exact module path in tests/test_pallas_layer2.py."""
+    from ..ops.pallas_layer2 import fused_layer2, use_fused_layer2
+
+    stride2 = 1 + (enc.downsample > 1)
+    if (not enc.is_initializing()
+            and use_fused_layer2(enc.norm_fn, stride2, x.shape,
+                                 override=enc.fused_stem)):
+        params = {
+            "c1": enc.layer2_0.conv1.variables["params"],
+            "c2": enc.layer2_0.conv2.variables["params"],
+            "proj": enc.layer2_0.downsample_conv.variables["params"],
+            "c3": enc.layer2_1.conv1.variables["params"],
+            "c4": enc.layer2_1.conv2.variables["params"],
+        }
+        return fused_layer2(x, params, enc.dtype)
+    return enc.layer2_1(enc.layer2_0(x))
+
+
 class BasicEncoder(nn.Module):
     """Residual trunk -> ``output_dim`` feature maps at 1/2^downsample res
     (reference: core/extractor.py:122-197).  The reference's list-input
@@ -132,8 +156,8 @@ class BasicEncoder(nn.Module):
 
     def __call__(self, x):
         x = _stem_layer1(self, x)
-        for blk in (self.layer2_0, self.layer2_1,
-                    self.layer3_0, self.layer3_1):
+        x = _trunk_layer2(self, x)
+        for blk in (self.layer3_0, self.layer3_1):
             x = blk(x)
         return self.conv2(x)
 
@@ -199,8 +223,8 @@ class MultiBasicEncoder(nn.Module):
 
     def __call__(self, x, dual_inp: bool = False, num_layers: int = 3):
         x = _stem_layer1(self, x)
-        for blk in (self.layer2_0, self.layer2_1,
-                    self.layer3_0, self.layer3_1):
+        x = _trunk_layer2(self, x)
+        for blk in (self.layer3_0, self.layer3_1):
             x = blk(x)
         trunk = None
         if dual_inp:

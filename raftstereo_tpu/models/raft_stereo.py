@@ -199,6 +199,22 @@ class RAFTStereo:
 
         corr_dtype = (jnp.bfloat16 if cfg.corr_dtype == "bfloat16"
                       else jnp.float32)
+        update_vars = self._split_vars(variables, "update")
+        # Test mode fuses the motion encoder's convc1 (1x1, cor_planes->64)
+        # into the lookup kernel as a relu epilogue: the separate conv
+        # re-read the correlation features at 75 GB/s (60 us/iter, round-5
+        # trace).  Training keeps the module conv — the fused path defines
+        # no VJP (gradients flow through convc1 the ordinary way).
+        from ..ops.corr import corr_epilogue_active
+        # bf16 compute only: the in-kernel bf16 dot reproduces the module
+        # conv BIT-EXACTLY (measured: max |disp| diff 0.0 over a 32-iter
+        # forward), while fp32's module conv runs at flax default precision
+        # — a different rounding than any Mosaic-loweable policy — and fp32
+        # is the certified-parity path, which must keep one numeric form.
+        use_epi = (test_mode and dtype == jnp.bfloat16
+                   and corr_epilogue_active(cfg.corr_implementation))
+        epi = (update_vars["params"]["encoder"]["convc1"] if use_epi
+               else None)
         # out_channels: the pallas_alt backend zero-pads the correlation
         # features to a lane-multiple-friendly width in-kernel (36 lanes
         # made the motion encoder's 1x1 conv fusion memory-bound); the
@@ -208,7 +224,8 @@ class RAFTStereo:
                                dtype=corr_dtype,
                                precision=cfg.corr_precision,
                                out_dtype=dtype,
-                               out_channels=-(-cfg.cor_planes // 64) * 64)
+                               out_channels=-(-cfg.cor_planes // 64) * 64,
+                               epilogue=epi)
 
         h0, w0 = net_list[0].shape[1:3]
         grid = coords_grid_x(b, h0, w0)
@@ -216,7 +233,6 @@ class RAFTStereo:
         if flow_init is not None:
             disp = disp + flow_init.astype(jnp.float32)
 
-        update_vars = self._split_vars(variables, "update")
         sf = cfg.slow_fast_gru
         n = cfg.n_gru_layers
 
@@ -241,7 +257,8 @@ class RAFTStereo:
             # cast, and the carry's HBM round trip).
             nets, mask, delta = self.update.apply(
                 update_vars, nets, zqr_list, corr, flow,
-                iter2=(n == 3), iter1=(n >= 2), with_mask=not test_mode)
+                iter2=(n == 3), iter1=(n >= 2), with_mask=not test_mode,
+                corr_preact=use_epi)
 
             d = d + delta[..., :1].astype(jnp.float32)
             if test_mode:

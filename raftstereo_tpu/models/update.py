@@ -93,9 +93,15 @@ def tap_conv3x3(conv_mod, y):
             # lax.conv is cross-correlation: tap (a, b) reads
             # in[p + (a-1, b-1)], and o[p] needs z_t[p + (dy-1, dx-1)].
             sel[dy, dx, t * co + c, c] = 1.0
+    # HIGHEST for fp32 inputs: the selector's weights are exact 0/1 and its
+    # output feeds the certified-parity delta-flow, so the default-precision
+    # bf16 pass would round the taps once more than the plain conv (the
+    # batch<=2 shift-add epilogue has no such extra rounding).  co=2 makes
+    # the fp32 multiply passes free; bf16 inputs keep the default.
+    prec = (jax.lax.Precision.HIGHEST if y.dtype == jnp.float32 else None)
     o = jax.lax.conv_general_dilated(
         z, jnp.asarray(sel, y.dtype), (1, 1), ((1, 1), (1, 1)),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=prec)
     return o + p["bias"].astype(y.dtype)
 
 
@@ -308,8 +314,12 @@ class BasicMotionEncoder(nn.Module):
         self.convf2 = conv(64, 3, dtype=self.dtype)
         self.conv = conv(128 - 2, 3, dtype=self.dtype)
 
-    def __call__(self, flow, corr):
-        cor = nn.relu(self.convc2(nn.relu(self.convc1(corr))))
+    def __call__(self, flow, corr, preact: bool = False):
+        # ``preact``: corr already IS relu(convc1(raw_corr)) — the
+        # pallas_alt lookup kernel's fused epilogue (ops/pallas_alt.py);
+        # convc1's parameters are consumed by the kernel, not here.
+        c1 = corr if preact else nn.relu(self.convc1(corr))
+        cor = nn.relu(self.convc2(c1))
         flo = nn.relu(self.convf2(nn.relu(self.convf1(flow))))
         out = nn.relu(self.conv(jnp.concatenate([cor, flo], axis=-1)))
         return jnp.concatenate([out, flow], axis=-1)
@@ -352,7 +362,8 @@ class BasicMultiUpdateBlock(nn.Module):
                  corr: Optional[jax.Array] = None,
                  flow: Optional[jax.Array] = None,
                  iter0: bool = True, iter1: bool = True, iter2: bool = True,
-                 update: bool = True, with_mask: bool = True):
+                 update: bool = True, with_mask: bool = True,
+                 corr_preact: bool = False):
         cfg = self.config
         n = cfg.n_gru_layers
         net = list(net)
@@ -366,7 +377,7 @@ class BasicMultiUpdateBlock(nn.Module):
             else:
                 net[1] = self.gru1(net[1], *inp[1], avg_pool2x(net[0]))
         if iter0:
-            motion_features = self.encoder(flow, corr)
+            motion_features = self.encoder(flow, corr, preact=corr_preact)
             if n > 1:
                 net[0] = self.gru0(net[0], *inp[0], motion_features,
                                    _interp_to(net[1], net[0]))

@@ -282,12 +282,20 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
                             dtype=jnp.float32,
                             precision: str = "highest",
                             out_dtype=jnp.float32,
-                            out_channels: int = 0) -> CorrFn:
+                            out_channels: int = 0,
+                            epilogue=None) -> CorrFn:
     """On-demand Pallas backend: O(H*W) HBM like ``alt``, but each W1-block's
     correlation rows are recomputed inside a TPU kernel (MXU matmul + hat
     reduction in VMEM).  Working form of the reference's dead ``alt_cuda``
-    backend (reference: core/corr.py:159-188 raises NotImplementedError)."""
-    from .pallas_alt import (pad_w2_lane, pallas_alt_pyramid_radial_flat,
+    backend (reference: core/corr.py:159-188 raises NotImplementedError).
+
+    ``epilogue``: the motion encoder's convc1 parameters
+    ({"kernel": (1, 1, L*K, Co), "bias": (Co,)}) — when given, the kernel
+    emits relu(corr @ W + b) directly (one fused pass; the separate 1x1
+    conv re-read the correlation features at 75 GB/s, 60 us/iter).
+    Inference-only: the caller gates it on test_mode (no VJP)."""
+    from .pallas_alt import (pad_w2_lane, pallas_alt_pyramid_radial_epi_flat,
+                             pallas_alt_pyramid_radial_flat,
                              preflatten_fmap1, preflatten_fmap2)
 
     # Flatten/pad ONCE so each corr_fn call touches only the taps (the f1
@@ -307,12 +315,24 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
         return (f1flat,) + tuple(f2p)
 
     scales = tuple(1.0 / 2.0 ** i for i in range(num_levels))
+    epi = None
+    if epilogue is not None:
+        # Prepared exactly as PointwisePaddedConv consumes them: compute
+        # dtype for the dot and the bias add (out_dtype IS the model
+        # compute dtype on this path).
+        epi = (epilogue["kernel"][0, 0].astype(out_dtype),
+               epilogue["bias"].reshape(1, 1, -1).astype(out_dtype))
 
     shard = _corr_shard_mesh(fmap1.shape[0], fmap1.shape[1])
     if shard is None:
         f1flat, *f2_pyramid = construct(fmap1, fmap2)
 
         def lookup_flat(f1, f2, xl, w2s):
+            if epi is not None:
+                return pallas_alt_pyramid_radial_epi_flat(
+                    f1, f2, xl, w2s, radius, epi[0], epi[1],
+                    precision=precision, out_dtype=out_dtype,
+                    level_scales=scales)
             return pallas_alt_pyramid_radial_flat(f1, f2, xl, w2s, radius,
                                                   precision=precision,
                                                   out_dtype=out_dtype,
@@ -328,6 +348,16 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
             check_vma=False)(fmap1, fmap2)
 
         def lookup_flat(f1, f2, xl, w2s):
+            from jax.sharding import PartitionSpec as P
+
+            if epi is not None:
+                return jax.shard_map(
+                    lambda a, b, t, w, bi: pallas_alt_pyramid_radial_epi_flat(
+                        a, b, t, w2s, radius, w, bi, precision=precision,
+                        out_dtype=out_dtype, level_scales=scales),
+                    mesh=mesh,
+                    in_specs=(flat_spec, flat_spec, row_spec, P(), P()),
+                    out_specs=row_spec, check_vma=False)(f1, f2, xl, *epi)
             return jax.shard_map(
                 lambda a, b, t: pallas_alt_pyramid_radial_flat(
                     a, b, t, w2s, radius, precision=precision,
@@ -351,10 +381,33 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
     return corr_fn
 
 
+# A/B toggle for the fused convc1 epilogue (scripts/ab_corr_epilogue.py
+# flips it in one process; tests pin the fused == unfused numerics).
+corr_epilogue_enabled = True
+
+
+def resolve_implementation(implementation: str) -> str:
+    """'auto' -> the fastest backend for the active platform.  The ONE
+    resolver — make_corr_fn, corr_epilogue_active, and bench.py must agree,
+    or the model could set corr_preact for a backend that ignores the
+    epilogue (skipping convc1 on raw features entirely)."""
+    if implementation == "auto":
+        return "pallas_alt" if jax.default_backend() == "tpu" else "reg"
+    return implementation
+
+
+def corr_epilogue_active(implementation: str) -> bool:
+    """Whether ``make_corr_fn`` would honor a convc1 ``epilogue`` for this
+    implementation — the model consults this to decide if the motion
+    encoder's convc1 is fused into the lookup kernel (pallas_alt only)."""
+    return (corr_epilogue_enabled
+            and resolve_implementation(implementation) == "pallas_alt")
+
+
 def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
                  num_levels: int, radius: int, dtype=jnp.float32,
                  precision: str = "highest", out_dtype=jnp.float32,
-                 out_channels: int = 0) -> CorrFn:
+                 out_channels: int = 0, epilogue=None) -> CorrFn:
     """Backend dispatch (reference: core/raft_stereo.py:90-100).
 
     ``auto`` resolves to the fastest backend for the active platform: the
@@ -371,9 +424,7 @@ def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
     zero-pad the channel axis in-kernel to a lane-friendly width; other
     backends return the natural width (consumers must accept both — the
     motion encoder's padded 1x1 conv does)."""
-    if implementation == "auto":
-        implementation = ("pallas_alt" if jax.default_backend() == "tpu"
-                          else "reg")
+    implementation = resolve_implementation(implementation)
     if implementation == "reg":
         fn = make_reg_corr_fn(fmap1, fmap2, num_levels, radius,
                               dtype=jnp.float32, precision=precision)
@@ -387,7 +438,8 @@ def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
         return make_pallas_alt_corr_fn(fmap1, fmap2, num_levels, radius,
                                        dtype=dtype, precision=precision,
                                        out_dtype=out_dtype,
-                                       out_channels=out_channels)
+                                       out_channels=out_channels,
+                                       epilogue=epilogue)
     else:
         raise ValueError(f"unknown corr implementation: {implementation}")
     if jnp.dtype(out_dtype) == jnp.float32:

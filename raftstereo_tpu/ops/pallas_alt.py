@@ -115,11 +115,13 @@ def _alt_pyr_fwd_kernel(f1_ref, f2_ref, taps_ref, out_ref, *, scale, bounds,
     out_ref[...] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
 
 
-def _alt_pyr_radial_kernel(f1_ref, f2_ref, x_ref, out_ref, *, scale, bounds,
-                           radius, prec="highest", level_scales=None):
-    """Model-pattern lookup: taps are x + k for k in [-radius, radius], so
-    every tap of a level shares floor(x)/frac(x).  Instead of K dense hat
-    sweeps (~6 VPU ops per column-visit), sweep K+1 integer WINDOWS
+def _radial_cols(f1_ref, f2_ref, x_ref, *, scale, bounds, radius, prec,
+                 level_scales):
+    """Shared core of the radial kernels: the per-tap column list.
+
+    Taps are x + k for k in [-radius, radius], so every tap of a level
+    shares floor(x)/frac(x).  Instead of K dense hat sweeps (~6 VPU ops
+    per column-visit), sweep K+1 integer WINDOWS
     win[d] = M[x1, floor(x)+d-radius] (~3 ops per visit: one shared integer
     offset, then compare + masked-accumulate per window) and lerp
     per-pixel:  out_k = (1-f)*win[k] + f*win[k+1].  Algebraically identical
@@ -150,6 +152,14 @@ def _alt_pyr_radial_kernel(f1_ref, f2_ref, x_ref, out_ref, *, scale, bounds,
                 for d in range(kk + 1)]           # each (R, blk)
         for ki in range(kk):
             cols.append(wins[ki] * (1.0 - f) + wins[ki + 1] * f)
+    return cols
+
+
+def _alt_pyr_radial_kernel(f1_ref, f2_ref, x_ref, out_ref, *, scale, bounds,
+                           radius, prec="highest", level_scales=None):
+    """Radial lookup emitting the raw correlation features."""
+    cols = _radial_cols(f1_ref, f2_ref, x_ref, scale=scale, bounds=bounds,
+                        radius=radius, prec=prec, level_scales=level_scales)
     # Zero channel padding up to the declared output width: a 36-lane
     # tensor makes the consuming 1x1 conv's fusion read at ~39 GB/s
     # (measured 60 us/iter); emitting a lane-friendly channel count is
@@ -157,6 +167,29 @@ def _alt_pyr_radial_kernel(f1_ref, f2_ref, x_ref, out_ref, *, scale, bounds,
     while len(cols) < out_ref.shape[-1]:
         cols.append(jnp.zeros_like(cols[0]))
     out_ref[...] = jnp.stack(cols, axis=-1).astype(out_ref.dtype)
+
+
+def _alt_pyr_radial_epi_kernel(f1_ref, f2_ref, x_ref, ew_ref, eb_ref,
+                               out_ref, *, scale, bounds, radius,
+                               prec="highest", level_scales=None):
+    """Radial lookup with the motion encoder's convc1 fused as an
+    epilogue: out = relu(cols @ W + b), the 1x1 (L*K -> 64) conv that
+    otherwise re-reads the correlation features from HBM at 75 GB/s
+    (60 us/iter, round-5 trace).  The dot runs in the consumer's compute
+    dtype exactly like the module path (PointwisePaddedConv casts its
+    input and kernel to the model dtype and adds bias in that dtype), so
+    the fused numerics mirror the unfused ones; inference-only (the
+    backward keeps the module conv — see make_pallas_alt_corr_fn)."""
+    cols = _radial_cols(f1_ref, f2_ref, x_ref, scale=scale, bounds=bounds,
+                        radius=radius, prec=prec, level_scales=level_scales)
+    ew = ew_ref[...]                               # (L*K, Co) compute dtype
+    z = jnp.stack(cols, axis=-1).astype(ew.dtype)  # (R, blk, L*K)
+    pp = (jax.lax.Precision.HIGHEST if ew.dtype == jnp.float32 else None)
+    y = jax.lax.dot_general(z, ew, (((2,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=pp)
+    y = y.astype(ew.dtype) + eb_ref[...].astype(ew.dtype)  # eb (1, 1, Co)
+    out_ref[...] = jnp.maximum(y, 0).astype(out_ref.dtype)
 
 
 def _alt_pyr_bwd_kernel(f1_ref, f2_ref, taps_ref, g_ref, df1_ref, df2_ref, *,
@@ -293,6 +326,24 @@ def pallas_alt_pyramid_radial_flat(f1flat: jax.Array, f2cat: jax.Array,
                                 else None)(f1flat, f2cat, x_levels)
 
 
+def pallas_alt_pyramid_radial_epi_flat(f1flat, f2cat, x_levels, w2s, radius,
+                                       ew, eb,
+                                       precision: str = "highest",
+                                       out_dtype=jnp.float32,
+                                       level_scales: tuple = None):
+    """Radial pyramid lookup with a fused 1x1-conv + relu epilogue
+    (the motion encoder's convc1): returns relu(corr @ ew + eb) directly,
+    (B, H, W1, Co).  ``ew`` is (L*K, Co) in the compute dtype, ``eb``
+    (1, 1, Co).  Inference-only — no VJP is defined (training keeps the
+    module conv; the gate lives in the model, models/raft_stereo.py)."""
+    bounds = bounds_from_widths(tuple(w2s))
+    return _alt_pyr_radial_fwd_impl(
+        f1flat, f2cat, x_levels, bounds, radius, precision,
+        jnp.dtype(out_dtype), 0,
+        tuple(level_scales) if level_scales is not None else None,
+        epilogue=(ew, eb))
+
+
 @functools.lru_cache(maxsize=None)
 def _make_alt_pyr_radial(f1flat_shape, f2cat_shape, w2s, radius, f1_dtype,
                          f2_dtype, precision="highest", out_dtype="float32",
@@ -337,7 +388,8 @@ def _make_alt_pyr_radial(f1flat_shape, f2cat_shape, w2s, radius, f1_dtype,
 
 def _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
                              prec="highest", out_dtype=jnp.float32,
-                             out_channels=0, level_scales=None):
+                             out_channels=0, level_scales=None,
+                             epilogue=None):
     f1flat = _pad_rows(f1flat)  # no-ops for preflatten_* outputs
     f2cat = _pad_rows(f2cat)
     n, w1p, c = f1flat.shape
@@ -348,25 +400,42 @@ def _alt_pyr_radial_fwd_impl(f1flat, f2cat, x, bounds, radius,
     n_lvl = len(bounds) if level_scales is not None else nl
     lk = max(n_lvl * (2 * radius + 1), out_channels)
     r = _BLOCK_ROWS
+    operands = [f1flat, f2cat, t]
+    in_specs = [
+        pl.BlockSpec((r, blk, c), lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((r, w2cat, c), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((r, blk, nl), lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    if epilogue is None:
+        kernel = functools.partial(
+            _alt_pyr_radial_kernel, scale=scale, bounds=bounds,
+            radius=radius, prec=prec, level_scales=level_scales)
+    else:
+        ew, eb = epilogue                         # (L*K, Co), (1, 1, Co)
+        lk = ew.shape[-1]
+        kernel = functools.partial(
+            _alt_pyr_radial_epi_kernel, scale=scale, bounds=bounds,
+            radius=radius, prec=prec, level_scales=level_scales)
+        operands += [ew, eb]
+        in_specs += [
+            pl.BlockSpec(ew.shape, lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(eb.shape, lambda i, j: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ]
     out = pl.pallas_call(
-        functools.partial(_alt_pyr_radial_kernel, scale=scale, bounds=bounds,
-                          radius=radius, prec=prec,
-                          level_scales=level_scales),
+        kernel,
         out_shape=jax.ShapeDtypeStruct((n, w1p, lk), out_dtype),
         grid=(n // r, w1p // blk),
-        in_specs=[
-            pl.BlockSpec((r, blk, c), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((r, w2cat, c), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((r, blk, nl), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((r, blk, lk), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
         compiler_params=_COMPILER_PARAMS,
-    )(f1flat, f2cat, t)
+    )(*operands)
     return out[:b * h, :w1].reshape(b, h, w1, lk)
 
 

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 from typing import Tuple
 
 import jax
@@ -53,12 +54,29 @@ from .pallas_norm import _row_block
 # None = auto (fused on TPU backends); True/False force — tests force True
 # to exercise the interpret-mode kernels on CPU, and config.fused_encoder
 # forwards a per-model override (so evaluations can pin one numeric path).
-fused_stem_override = None
+# Thread-local: the override scopes a TRACE, and concurrent tracing from
+# another thread must not see this thread's gate (the train step's
+# override_fused_stem(False) is load-bearing for training numerics).
+_tls = threading.local()
+
+# Conv1 dot structure: True folds the 7 dy row taps into the contraction
+# (one big-K dot, 2 nearly-full MXU K-passes) instead of 7 small-K dots
+# whose 30/36-deep contractions fill 23-28% of the MXU's 128 K-rows.
+# Measured (scripts/ab_conv1_bigk.py, alternating same-process pairs at
+# flagship b1): ratios 0.96 / 1.00 vs the 7-dot form — a wash; the r4
+# pre-shift restructure already brought the kernel to ~1.1 ms for 3
+# images (round-5 trace) and the operand concat eats the MXU saving.
+# Committed negative result; default stays on the simpler 7-dot form.
+_conv1_bigk = False
+
+
+def _get_override():
+    return getattr(_tls, "fused_stem_override", None)
 
 
 @contextlib.contextmanager
 def override_fused_stem(value):
-    """Trace-time scope for the module-level gate override.  The train
+    """Trace-time scope for the thread-local gate override.  The train
     step wraps its forward in override_fused_stem(False): the fused
     stage's backward is the XLA reference VJP, which re-runs the full XLA
     forward for linearization — so under differentiation the Pallas
@@ -67,20 +85,24 @@ def override_fused_stem(value):
     config.fused_encoder=True still wins over this scope (use_fused_stem
     checks the explicit override first), so the multichip dryrun and
     forced-path evaluations keep the stage under training."""
-    global fused_stem_override
-    prev = fused_stem_override
-    fused_stem_override = value
+    prev = _get_override()
+    _tls.fused_stem_override = value
     try:
         yield
     finally:
-        fused_stem_override = prev
+        _tls.fused_stem_override = prev
 
 
-def _stem_shard_mesh(shape):
+def _stem_shard_mesh(shape, warn: bool = False):
     """The active (data, space) mesh if the fused stage can partition over
     it via ``shard_map``: B divisible by ``data``, H by ``space`` with >= 2
     rows per shard (each conv needs one real halo row per boundary).
-    Returns (mesh, data, space) or None (plain single-device lowering)."""
+    Returns (mesh, data, space) or None (plain single-device lowering).
+
+    ``warn``: emit the partitionability warning — only use_fused_stem sets
+    it, and only when the gate would otherwise have TAKEN the fused stage
+    (a CPU/GPU multi-device run with an odd batch would otherwise get a
+    misleading RuntimeWarning on a path it never wanted)."""
     import warnings
 
     from ..parallel.context import active_corr_mesh
@@ -95,10 +117,11 @@ def _stem_shard_mesh(shape):
     if d * s == 1:
         return None
     if b % d or h % s or (h // s) < 2:
-        warnings.warn(
-            f"fused encoder stage cannot partition over the active mesh "
-            f"(batch {b} % data {d}, height {h} % space {s}); using the "
-            f"plain XLA stage", RuntimeWarning, stacklevel=3)
+        if warn:
+            warnings.warn(
+                f"fused encoder stage cannot partition over the active mesh "
+                f"(batch {b} % data {d}, height {h} % space {s}); using the "
+                f"plain XLA stage", RuntimeWarning, stacklevel=3)
         return None
     return mesh, d, s
 
@@ -108,7 +131,7 @@ def fused_stem_forced(override=None) -> bool:
     tri-state precedence use_fused_stem applies (per-model config override
     wins over the module-level one).  Single source of truth for callers
     that branch on forced-ness (encoders' BN-without-conv1 case)."""
-    ov = override if override is not None else fused_stem_override
+    ov = override if override is not None else _get_override()
     return ov is True
 
 
@@ -126,7 +149,7 @@ def use_fused_stem(norm_fn: str, shape, override=None) -> bool:
     partitions with halo exchanges) must remain what they get.
 
     ``override`` (tri-state, from config.fused_encoder) wins over the
-    module-level ``fused_stem_override``, which wins over backend auto.
+    thread-local ``override_fused_stem`` scope, which wins over backend auto.
     The auto path also gates on <= 4 images per shard: at batch 8 the XLA
     stage's blocked lowering amortizes over the batch and the fused
     pipeline measures a net loss (12.45 vs 12.32 pairs/sec same-session
@@ -138,8 +161,12 @@ def use_fused_stem(norm_fn: str, shape, override=None) -> bool:
     ok = norm_fn in ("instance", "batch") and shape[2] % 2 == 0
     if not ok:
         return False
-    ov = override if override is not None else fused_stem_override
-    shard = _stem_shard_mesh(shape)
+    ov = override if override is not None else _get_override()
+    # Warn about an unpartitionable mesh only if the gate would otherwise
+    # have taken the fused stage (explicit True, or TPU auto).
+    would_take = ov is True or (ov is None
+                                and jax.default_backend() == "tpu")
+    shard = _stem_shard_mesh(shape, warn=would_take)
     if shard is not None:
         if ov is not None:
             return ov
@@ -512,14 +539,20 @@ def _shard_ctx(nblk: int, space_axis, space_size: int, rows: int = 1):
 
 
 def _stage_on_packed(xp, st1, params, n, space_axis=None, space_size=1,
-                     affines=None):
+                     affines=None, want_residuals=False):
     """The four fused convs + finish kernel, from the packed raw stage
     input ``xp`` and its prep affine ``st1``.
 
     ``affines``: for affine norms (frozen batch norm) — a list of the four
     remaining packed (s, t) prep affines [after c10, c11, c20, c21]; the
     per-tensor statistics accumulated by the kernels are then ignored
-    (constant affines need no stats and no psum)."""
+    (constant affines need no stats and no psum).
+
+    ``want_residuals``: also return the four raw conv outputs (packed) and
+    the five prep affines — the backward's saved state.  The pipeline
+    materializes all of these in HBM anyway (each _enc_conv is its own
+    pallas_call), so saving them is free; the hand-written backward then
+    never re-runs a forward (see _stage_bwd_xla)."""
     dt = xp.dtype
     b, h, wp, c2 = xp.shape
     r = _row_block(h)
@@ -572,6 +605,9 @@ def _stage_on_packed(xp, st1, params, n, space_axis=None, space_size=1,
         interpret=_interpret(),
         compiler_params=_COMPILER_PARAMS,
     )(xp, *st1, c11, *st11, c21, *st21)
+    if want_residuals:
+        return (unpack_view(out), (c10, c11, c20, c21),
+                (st1, st10, st11, st20, st21))
     return unpack_view(out)
 
 
@@ -625,13 +661,25 @@ def _stem7_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, *stat_refs,
                 [zc[:, :, :(-o)], full[:, :, :o]], axis=2))
     xcat = jnp.concatenate(shifts, axis=-1)         # (1, R+6, Wp, 30)
     wcat = w.reshape(7, 5 * w.shape[2], w.shape[3])
-    y = None
-    for dyi in range(7):
-        m = jax.lax.dot_general(
-            xcat[:, dyi:dyi + rows], wcat[dyi],
+    if _conv1_bigk:
+        # Fold the 7 dy taps into the contraction too: ONE K=210 dot (2
+        # MXU K-passes at ~82% fill) instead of 7 K=30 dots (7 passes at
+        # 23% fill) — the dy row slices are free (dim 1 is neither lane
+        # nor sublane), so the operand build costs only the lane concat.
+        xbig = jnp.concatenate([xcat[:, dyi:dyi + rows] for dyi in range(7)],
+                               axis=-1)             # (1, R, Wp, 210)
+        y = jax.lax.dot_general(
+            xbig, wcat.reshape(7 * wcat.shape[1], wcat.shape[2]),
             (((3,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        y = m if y is None else y + m
+    else:
+        y = None
+        for dyi in range(7):
+            m = jax.lax.dot_general(
+                xcat[:, dyi:dyi + rows], wcat[dyi],
+                (((3,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            y = m if y is None else y + m
     y = y + b_ref[...][:, :, None, :]
     y_ref[...] = y.astype(y_ref.dtype)
     _acc_stats(y, stat_refs)
@@ -688,14 +736,25 @@ def _stem7s2_kernel(x_ref, xh_ref, w_ref, b_ref, y_ref, *stat_refs,
     view = xcat.reshape(1, rows + 3, 2, xcat.shape[2], xcat.shape[3])
     w = w_ref[...]                                  # (7, 3, 12, 128)
     wcat = w.reshape(7, 3 * w.shape[2], w.shape[3])  # dq-major, like xcat
-    y = None
-    for dyi in range(7):
-        e, par = divmod(dyi, 2)
-        m = jax.lax.dot_general(
-            view[:, e:e + rows, par], wcat[dyi],
+    if _conv1_bigk:
+        # Same dy-fold as _stem7_kernel: one K=252 dot (2 nearly-full
+        # K-passes) instead of 7 K=36 dots.
+        xbig = jnp.concatenate(
+            [view[:, dyi // 2:dyi // 2 + rows, dyi % 2]
+             for dyi in range(7)], axis=-1)          # (1, R, Wq, 252)
+        y = jax.lax.dot_general(
+            xbig, wcat.reshape(7 * wcat.shape[1], wcat.shape[2]),
             (((3,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        y = m if y is None else y + m
+    else:
+        y = None
+        for dyi in range(7):
+            e, par = divmod(dyi, 2)
+            m = jax.lax.dot_general(
+                view[:, e:e + rows, par], wcat[dyi],
+                (((3,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            y = m if y is None else y + m
     y = y + b_ref[...][:, :, None, :]
     y_ref[...] = y.astype(y_ref.dtype)
     _acc_stats(y, stat_refs)
@@ -842,9 +901,12 @@ def _conv1_pack_for_halo(im, dt, stride):
     return pack_view(im.astype(dt))
 
 
-def _fused_forward1(img, c1_params, params, dt, stride=1):
+def _fused_forward1(img, c1_params, params, dt, stride=1,
+                    want_residuals=False):
     """conv1 + stage, fused end to end; shard_map'd like _fused_forward.
-    The stage's stats span the conv1 OUTPUT resolution (H/stride)."""
+    The stage's stats span the conv1 OUTPUT resolution (H/stride).
+    ``want_residuals`` additionally returns conv1's packed raw output, the
+    stage raws, and the prep affines (the backward's saved state)."""
     n = float((img.shape[1] // stride) * (img.shape[2] // stride))
 
     def local(im, c1p, p, space_axis=None, space_size=1):
@@ -853,6 +915,10 @@ def _fused_forward1(img, c1_params, params, dt, stride=1):
         yb = exch3(imp) if space_axis is not None else None
         yp, sums = _stem_conv1_any(im, c1p, dt, stride, yb)
         st1 = _expand_stats(*sums, n, space_axis)
+        if want_residuals:
+            out, raws, affs = _stage_on_packed(
+                yp, st1, p, n, space_axis, space_size, want_residuals=True)
+            return out, yp, raws, affs
         return _stage_on_packed(yp, st1, p, n, space_axis, space_size)
 
     return _shard_wrapped(local, img.shape, (img, c1_params, params))
@@ -881,17 +947,16 @@ def conv1_stem_layer1(img, c1_params, params, dt=jnp.float32, stride=1):
 
 
 def _fwd1(img, c1_params, params, dt, stride):
-    return (_fused_forward1(img, c1_params, params, dt, stride),
-            (img, c1_params, params))
+    out, yp, raws, affs = _fused_forward1(img, c1_params, params, dt,
+                                          stride, want_residuals=True)
+    return out, (img, c1_params, params, yp, raws, affs)
 
 
 def _bwd1(dt, stride, residuals, g):
-    img, c1_params, params = residuals
-    _, vjp = jax.vjp(
-        lambda im, c1p, p: _xla_reference(
-            _xla_conv1(im, c1p, dt, stride), p),
-        img, c1_params, params)
-    return vjp(g)
+    img, c1_params, params, yp, raws, affs = residuals
+    dy1, dparams = _stage_bwd_xla(unpack_view(yp), raws, affs, params, g)
+    dimg, dc1 = _conv1_bwd(img, c1_params, dt, stride, dy1)
+    return dimg, dc1, dparams
 
 
 conv1_stem_layer1.defvjp(_fwd1, _bwd1)
@@ -937,12 +1002,18 @@ def _xla_reference_affine(y1_raw, params, affines):
     return jnp.maximum(t1 + v2, 0)
 
 
-def _fused_forward_affine(y1_raw, params, affines):
+def _fused_forward_affine(y1_raw, params, affines, want_residuals=False):
     """Affine-norm fused stage over the active mesh.  No stats, no psum
-    — constant affines replicate."""
+    — constant affines replicate.  ``want_residuals`` also returns the
+    four raw conv outputs (the affines are primals, not residuals)."""
     def local(y1, p, aff, space_axis=None, space_size=1):
         xp = pack_view(y1)
         pa = _pack_affines(aff, xp.shape[0], xp.shape[-1])
+        if want_residuals:
+            out, raws, _ = _stage_on_packed(
+                xp, pa[0], p, n=1.0, space_axis=space_axis,
+                space_size=space_size, affines=pa[1:], want_residuals=True)
+            return out, raws
         return _stage_on_packed(xp, pa[0], p, n=1.0, space_axis=space_axis,
                                 space_size=space_size, affines=pa[1:])
 
@@ -952,22 +1023,23 @@ def _fused_forward_affine(y1_raw, params, affines):
 @jax.custom_vjp
 def bn_stem_layer1(y1_raw, params, affines):
     """Fused affine-norm stage from conv1's raw output (stride-2 conv1
-    configs); XLA-reference backward on global arrays.  ``affines``: five
-    UNPACKED per-channel (s, t) fp32 pairs — [norm1, l1_0.norm1,
-    l1_0.norm2, l1_1.norm1, l1_1.norm2] (see bn_affine) — through which
-    gradients flow to the BatchNorm scale/bias."""
+    configs); hand-written backward from saved residuals
+    (_stage_bwd_xla_affine).  ``affines``: five UNPACKED per-channel
+    (s, t) fp32 pairs — [norm1, l1_0.norm1, l1_0.norm2, l1_1.norm1,
+    l1_1.norm2] (see bn_affine) — through which gradients flow to the
+    BatchNorm scale/bias."""
     return _fused_forward_affine(y1_raw, params, affines)
 
 
 def _fwd_bn(y1_raw, params, affines):
-    return _fused_forward_affine(y1_raw, params, affines), (y1_raw, params,
-                                                            affines)
+    out, raws = _fused_forward_affine(y1_raw, params, affines,
+                                      want_residuals=True)
+    return out, (y1_raw, params, affines, raws)
 
 
 def _bwd_bn(residuals, g):
-    y1_raw, params, affines = residuals
-    _, vjp = jax.vjp(_xla_reference_affine, y1_raw, params, affines)
-    return vjp(g)
+    y1_raw, params, affines, raws = residuals
+    return _stage_bwd_xla_affine(y1_raw, raws, params, affines, g)
 
 
 bn_stem_layer1.defvjp(_fwd_bn, _bwd_bn)
@@ -981,13 +1053,19 @@ def bn_conv1_stem_layer1(img, c1_params, params, affines, dt=jnp.float32,
                                   stride)
 
 
-def _fused_forward1_affine(img, c1_params, params, affines, dt, stride=1):
+def _fused_forward1_affine(img, c1_params, params, affines, dt, stride=1,
+                           want_residuals=False):
     def local(im, c1p, p, aff, space_axis=None, space_size=1):
         _, exch3 = _shard_ctx(1, space_axis, space_size, rows=3)
         yb = (exch3(_conv1_pack_for_halo(im, dt, stride))
               if space_axis is not None else None)
         yp, _ = _stem_conv1_any(im, c1p, dt, stride, yb, want_stats=False)
         pa = _pack_affines(aff, yp.shape[0], yp.shape[-1])
+        if want_residuals:
+            out, raws, _ = _stage_on_packed(
+                yp, pa[0], p, n=1.0, space_axis=space_axis,
+                space_size=space_size, affines=pa[1:], want_residuals=True)
+            return out, yp, raws
         return _stage_on_packed(yp, pa[0], p, n=1.0, space_axis=space_axis,
                                 space_size=space_size, affines=pa[1:])
 
@@ -996,21 +1074,303 @@ def _fused_forward1_affine(img, c1_params, params, affines, dt, stride=1):
 
 
 def _fwd1_bn(img, c1_params, params, affines, dt, stride):
-    return (_fused_forward1_affine(img, c1_params, params, affines, dt,
-                                   stride),
-            (img, c1_params, params, affines))
+    out, yp, raws = _fused_forward1_affine(img, c1_params, params, affines,
+                                           dt, stride, want_residuals=True)
+    return out, (img, c1_params, params, affines, yp, raws)
 
 
 def _bwd1_bn(dt, stride, residuals, g):
-    img, c1_params, params, affines = residuals
-    _, vjp = jax.vjp(
-        lambda im, c1p, p, aff: _xla_reference_affine(
-            _xla_conv1(im, c1p, dt, stride), p, aff),
-        img, c1_params, params, affines)
-    return vjp(g)
+    img, c1_params, params, affines, yp, raws = residuals
+    dy1, dparams, daff = _stage_bwd_xla_affine(unpack_view(yp), raws,
+                                               params, affines, g)
+    dimg, dc1 = _conv1_bwd(img, c1_params, dt, stride, dy1)
+    return dimg, dc1, dparams, daff
 
 
 bn_conv1_stem_layer1.defvjp(_fwd1_bn, _bwd1_bn)
+
+
+# --------------------------------------- backward from saved residuals
+#
+# The round-4 backward re-linearized the full XLA reference forward
+# (jax.vjp(_xla_reference, ...)), so training paid Pallas-fwd + XLA-fwd +
+# XLA-bwd and gated the stage off (-1.3% measured).  The Pallas pipeline
+# already materializes every backward residual in HBM — each _enc_conv is
+# its own pallas_call writing its raw output, and the prep affines carry
+# (mean, rstd) — so the backward below consumes THOSE and never re-runs a
+# forward: elementwise mask/activation recomputes, 8 transposed convs
+# (jax.linear_transpose — no primal evaluation), and the instance-norm
+# VJP's per-image reductions.  Reference analogue: the CUDA sampler's
+# dedicated backward kernel (/root/reference/sampler/sampler_kernel.cu:63-105)
+# rather than autodiff through a re-run forward.
+
+def _drelu(z):
+    """Derivative of jnp.maximum(z, 0) under JAX's tie convention (0.5 at
+    z == 0 — measured; exact zeros are COMMON here because both operands
+    of the residual adds are post-relu).  Emitted in z's dtype (0/0.5/1
+    are exact in bf16) so bf16 backward chains stay bf16."""
+    return jnp.where(z > 0, 1.0,
+                     jnp.where(z < 0, 0.0, 0.5)).astype(z.dtype)
+
+
+def _aff_stats(st):
+    """Packed prep affine (s, t) each (B, 1, 2C) -> broadcastable unpacked
+    (mean, rstd) (B, 1, 1, C) fp32.  s IS rstd (> 0 always: rsqrt of
+    var + 1e-5) and t = -mean * rstd, so the inversion is exact."""
+    s, t = st
+    c = s.shape[-1] // 2
+    rstd = s[..., :c].astype(jnp.float32)[:, :, None, :]
+    mean = -t[..., :c].astype(jnp.float32)[:, :, None, :] / rstd
+    return mean, rstd
+
+
+# Packed-domain reduction path for the backward's instance-norm means.
+# Module-level override for tests/A-B: None = auto (TPU, no active mesh,
+# even W), True/False force.
+_bwd_packed_sums = None
+
+
+def _dual_sum_kernel(u_ref, v_ref, s1_ref, s2_ref):
+    """Accumulate per-(image, packed-channel) fp32 (sum(u), sum(u*v)) —
+    the two reductions of the instance-norm VJP, computed layout-preserving
+    like the forward's stats kernels (same accumulation pattern as
+    pallas_norm._in_stats_kernel)."""
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref[...])
+        s2_ref[...] = jnp.zeros_like(s2_ref[...])
+
+    u = u_ref[...].astype(jnp.float32)          # in-register upcast: fp32
+    v = v_ref[...].astype(jnp.float32)          # accumulation, any input dt
+    s1_ref[...] += jnp.sum(u, axis=(1, 2))[:, None, :]
+    s2_ref[...] += jnp.sum(u * v, axis=(1, 2))[:, None, :]
+
+
+def _in_bwd_means(u, xhat):
+    """(mean_HW(u), mean_HW(u * xhat)) as (B, 1, 1, C) fp32.
+
+    On single-device TPU these run as ONE layout-preserving Pallas kernel
+    over the packed row-major view: a plain XLA cross-(H,W) reduce of a
+    conv-adjacent tensor forces full-tensor blocked<->row-major relayouts,
+    and NO XLA-side formulation escapes that (measured exhaustively,
+    docs/perf_notes_r03.md) — the exact storm that motivated this module.
+    Under an active mesh the XLA form stays: the backward runs on GLOBAL
+    arrays that GSPMD partitions, where a bare pallas_call cannot."""
+    from ..parallel.context import active_corr_mesh
+
+    use_packed = _bwd_packed_sums
+    if use_packed is None:
+        use_packed = (jax.default_backend() == "tpu"
+                      and active_corr_mesh() is None
+                      and u.shape[2] % 2 == 0)
+    if not use_packed:
+        # dtype=f32: fp32 accumulation without materializing fp32 copies.
+        return (jnp.mean(u, axis=(1, 2), keepdims=True, dtype=jnp.float32),
+                jnp.mean(u * xhat, axis=(1, 2), keepdims=True,
+                         dtype=jnp.float32))
+    # Operands stay in their storage dtype (bf16 under training) — the
+    # kernel upcasts in-register; .astype(f32) here would MATERIALIZE a
+    # ~1 GB fp32 copy per tensor at recipe shapes (measured: HBM OOM).
+    up = pack_view(u)
+    vp = pack_view(xhat)
+    b, h, wp, c2 = up.shape
+    r = _row_block(h)
+    s1, s2 = pl.pallas_call(
+        _dual_sum_kernel,
+        out_shape=(jax.ShapeDtypeStruct((b, 1, c2), jnp.float32),
+                   jax.ShapeDtypeStruct((b, 1, c2), jnp.float32)),
+        grid=(b, h // r),
+        in_specs=[pl.BlockSpec((1, r, wp, c2), lambda i, j: (i, j, 0, 0),
+                               memory_space=pltpu.VMEM)] * 2,
+        out_specs=(pl.BlockSpec((1, 1, c2), lambda i, j: (i, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 1, c2), lambda i, j: (i, 0, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=_interpret(),
+        compiler_params=_COMPILER_PARAMS,
+    )(up, vp)
+    n = float(u.shape[1] * u.shape[2])
+    c = c2 // 2
+    m1 = (s1[..., :c] + s1[..., c:])[:, :, None, :] / n
+    m2 = (s2[..., :c] + s2[..., c:])[:, :, None, :] / n
+    return m1, m2
+
+
+def _in_bwd(xhat, rstd, u):
+    """VJP of x -> xhat = (x - mean(x)) * rstd(x) through the per-image
+    statistics: dx = rstd * (u - mean_HW(u) - xhat * mean_HW(u * xhat)),
+    exact including the 1e-5 epsilon (xhat carries it).  The large
+    tensors stay in their storage dtype (bf16 under training — the
+    reference backward rounds comparably); only the means are fp32."""
+    mu, mux = _in_bwd_means(u, xhat)
+    dt = u.dtype
+    return rstd.astype(dt) * (u - mu.astype(dt) - xhat * mux.astype(dt))
+
+
+def _conv_bwd(t, kernel, dy):
+    """(dt, dkernel, dbias) of y = conv3x3_same(t, kernel) + bias via
+    linear transposition — unlike jax.vjp, never evaluates the primal."""
+    def conv_in(a):
+        return jax.lax.conv_general_dilated(
+            a, kernel, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def conv_k(k):
+        return jax.lax.conv_general_dilated(
+            t, k, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    dt = jax.linear_transpose(conv_in, t)(dy)[0]
+    dk = jax.linear_transpose(conv_k, kernel)(dy)[0]
+    return dt, dk, dy.sum((0, 1, 2), dtype=jnp.float32)
+
+
+def _stage_bwd_xla(y1_raw, raws, affs, params, g):
+    """Hand-written backward of the instance-norm stage from saved
+    residuals.  Returns (dy1_raw, dparams).  Mask/activation recomputes
+    are elementwise (XLA fuses them) and STAY in the storage dtype —
+    fp32 upcasts here materialize ~1 GB per tensor at recipe shapes
+    (measured HBM OOM); the reference backward rounds in bf16 the same
+    way.  The tiny per-image statistics are fp32 throughout."""
+    cdt = y1_raw.dtype
+    c10, c11, c20, c21 = [unpack_view(r) for r in raws]
+    y1 = y1_raw
+
+    def nh(c, st):
+        m, r = st
+        return (c - m.astype(cdt)) * r.astype(cdt)
+
+    stats = [_aff_stats(a) for a in affs]
+    r1, r10, r11, r20, r21 = [s[1] for s in stats]
+
+    x0 = nh(y1, stats[0])
+    t0 = jnp.maximum(x0, 0)
+    x10 = nh(c10, stats[1])
+    t10 = jnp.maximum(x10, 0)
+    x11 = nh(c11, stats[2])
+    u2 = jnp.maximum(x11, 0)
+    z1 = t0 + u2
+    t1 = jnp.maximum(z1, 0)
+    x20 = nh(c20, stats[3])
+    t20 = jnp.maximum(x20, 0)
+    x21 = nh(c21, stats[4])
+    v2 = jnp.maximum(x21, 0)
+
+    def kp(name):
+        return params[name]["kernel"].astype(cdt)
+
+    go = g.astype(cdt) * _drelu(t1 + v2)
+    dc21 = _in_bwd(x21, r21, go * _drelu(x21))
+    dt20, dk21, db21 = _conv_bwd(t20, kp("c21"), dc21)
+    dc20 = _in_bwd(x20, r20, dt20 * _drelu(x20))
+    dt1c, dk20, db20 = _conv_bwd(t1, kp("c20"), dc20)
+    dz1 = (go + dt1c) * _drelu(z1)
+    dc11 = _in_bwd(x11, r11, dz1 * _drelu(x11))
+    dt10, dk11, db11 = _conv_bwd(t10, kp("c11"), dc11)
+    dc10 = _in_bwd(x10, r10, dt10 * _drelu(x10))
+    dt0c, dk10, db10 = _conv_bwd(t0, kp("c10"), dc10)
+    dy1 = _in_bwd(x0, r1, (dz1 + dt0c) * _drelu(x0))
+
+    def dparam(name, dk, db):
+        p = params[name]
+        return {"kernel": dk.astype(p["kernel"].dtype),
+                "bias": db.astype(p["bias"].dtype)}
+
+    dparams = {"c10": dparam("c10", dk10, db10),
+               "c11": dparam("c11", dk11, db11),
+               "c20": dparam("c20", dk20, db20),
+               "c21": dparam("c21", dk21, db21)}
+    return dy1.astype(y1_raw.dtype), dparams
+
+
+def _stage_bwd_xla_affine(y1_raw, raws, params, affines, g):
+    """Backward of the affine-norm (frozen BN) stage from saved residuals.
+    Returns (dy1_raw, dparams, daffines) — gradients flow into the folded
+    BatchNorm scale/bias pairs like the reference backward."""
+    cdt = y1_raw.dtype
+    c10, c11, c20, c21 = [unpack_view(r) for r in raws]
+    y1 = y1_raw
+    aff = [(s.astype(cdt), t.astype(cdt)) for s, t in affines]
+
+    def pre(c, i):
+        s, t = aff[i]
+        return c * s + t
+
+    z0 = pre(y1, 0)
+    t0 = jnp.maximum(z0, 0)
+    z10 = pre(c10, 1)
+    t10 = jnp.maximum(z10, 0)
+    z11 = pre(c11, 2)
+    u2 = jnp.maximum(z11, 0)
+    z1 = t0 + u2
+    t1 = jnp.maximum(z1, 0)
+    z20 = pre(c20, 3)
+    t20 = jnp.maximum(z20, 0)
+    z21 = pre(c21, 4)
+    v2 = jnp.maximum(z21, 0)
+
+    daff = [None] * 5
+
+    def aff_bwd(dact, z, c, i):
+        u = dact * _drelu(z)
+        s, _ = aff[i]
+        # fp32 accumulation via the reduce dtype — no fp32 materialization.
+        daff[i] = ((u * c).sum((0, 1, 2), dtype=jnp.float32)
+                   .astype(affines[i][0].dtype),
+                   u.sum((0, 1, 2), dtype=jnp.float32)
+                   .astype(affines[i][1].dtype))
+        return u * s
+
+    def kp(name):
+        return params[name]["kernel"].astype(cdt)
+
+    go = g.astype(cdt) * _drelu(t1 + v2)
+    dc21 = aff_bwd(go, z21, c21, 4)
+    dt20, dk21, db21 = _conv_bwd(t20, kp("c21"), dc21)
+    dc20 = aff_bwd(dt20, z20, c20, 3)
+    dt1c, dk20, db20 = _conv_bwd(t1, kp("c20"), dc20)
+    dz1 = (go + dt1c) * _drelu(z1)
+    dc11 = aff_bwd(dz1, z11, c11, 2)
+    dt10, dk11, db11 = _conv_bwd(t10, kp("c11"), dc11)
+    dc10 = aff_bwd(dt10, z10, c10, 1)
+    dt0c, dk10, db10 = _conv_bwd(t0, kp("c10"), dc10)
+    dy1 = aff_bwd(dz1 + dt0c, z0, y1, 0)
+
+    def dparam(name, dk, db):
+        p = params[name]
+        return {"kernel": dk.astype(p["kernel"].dtype),
+                "bias": db.astype(p["bias"].dtype)}
+
+    dparams = {"c10": dparam("c10", dk10, db10),
+               "c11": dparam("c11", dk11, db11),
+               "c20": dparam("c20", dk20, db20),
+               "c21": dparam("c21", dk21, db21)}
+    return (dy1.astype(y1_raw.dtype), dparams,
+            [tuple(d) for d in daff])
+
+
+def _conv1_bwd(img, c1_params, dt, stride, dy1):
+    """(dimg, dc1_params) of the 7x7 stem conv via linear transposition
+    (the astype casts transpose to casts back, so cotangent dtypes match
+    the primals')."""
+    k = c1_params["kernel"]
+
+    def f_im(im):
+        return jax.lax.conv_general_dilated(
+            im.astype(dt), k.astype(dt), (stride, stride), ((3, 3), (3, 3)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def f_k(kk):
+        return jax.lax.conv_general_dilated(
+            img.astype(dt), kk.astype(dt), (stride, stride),
+            ((3, 3), (3, 3)), dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    g = dy1.astype(dt)
+    dimg = jax.linear_transpose(f_im, img)(g)[0]
+    dk = jax.linear_transpose(f_k, k)(g)[0]
+    db = (dy1.sum((0, 1, 2), dtype=jnp.float32)
+          .astype(c1_params["bias"].dtype))
+    return dimg, {"kernel": dk, "bias": db}
 
 
 # ------------------------------------------------- reference + custom VJP
@@ -1053,11 +1413,19 @@ def _shard_wrapped(local, shape, operands):
         return local(*operands)
     mesh, d, s = shard
     spec = P(DATA_AXIS, SPACE_AXIS, None, None)
+    # Residual-returning locals produce a pytree mixing (B, H, Wp, C2)
+    # tensors (shard like the input) and (B, 1, 2C) prep affines (psum'd
+    # inside, so replicated over space: shard over data only).  The output
+    # structure comes from an eval_shape of the UNSHARDED local — identical
+    # pytree, zero compute.
+    stat = P(DATA_AXIS, None, None)
+    outs = jax.eval_shape(lambda *a: local(*a), *operands)
+    out_specs = jax.tree.map(lambda l: spec if l.ndim == 4 else stat, outs)
     fn = functools.partial(local, space_axis=SPACE_AXIS if s > 1 else None,
                            space_size=s)
     in_specs = (spec,) + (P(),) * (len(operands) - 1)
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=spec,
-                         check_vma=False)(*operands)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(*operands)
 
 
 def _fused_forward(y1_raw, params):
@@ -1071,23 +1439,38 @@ def _fused_forward(y1_raw, params):
         y1_raw.shape, (y1_raw, params))
 
 
+def _fused_forward_res(y1_raw, params):
+    """_fused_forward that also returns the backward residuals (raw conv
+    outputs + all five prep affines) as global arrays."""
+    n = float(y1_raw.shape[1] * y1_raw.shape[2])
+
+    def local(y1, p, space_axis=None, space_size=1):
+        xp = pack_view(y1)
+        st1 = _expand_stats(*_packed_stats(xp), n, space_axis)
+        return _stage_on_packed(xp, st1, p, n, space_axis, space_size,
+                                want_residuals=True)
+
+    return _shard_wrapped(local, y1_raw.shape, (y1_raw, params))
+
+
 @jax.custom_vjp
 def stem_layer1(y1_raw: jax.Array, params: dict) -> jax.Array:
-    """Fused forward; XLA-reference backward (see module docstring).
-    The backward runs on the GLOBAL arrays as plain XLA ops, so under a
-    mesh GSPMD partitions it (conv halo exchanges included) without any
-    manual collectives."""
+    """Fused forward; hand-written backward from the forward's saved
+    residuals (_stage_bwd_xla — no forward re-linearization).  The
+    backward runs on the GLOBAL arrays as plain XLA ops, so under a mesh
+    GSPMD partitions it (conv halo exchanges included) without any manual
+    collectives."""
     return _fused_forward(y1_raw, params)
 
 
 def _fwd(y1_raw, params):
-    return _fused_forward(y1_raw, params), (y1_raw, params)
+    out, raws, affs = _fused_forward_res(y1_raw, params)
+    return out, (y1_raw, raws, affs, params)
 
 
 def _bwd(residuals, g):
-    y1_raw, params = residuals
-    _, vjp = jax.vjp(_xla_reference, y1_raw, params)
-    return vjp(g)
+    y1_raw, raws, affs, params = residuals
+    return _stage_bwd_xla(y1_raw, raws, affs, params, g)
 
 
 stem_layer1.defvjp(_fwd, _bwd)

@@ -39,13 +39,20 @@ def make_train_step(model, tx, cfg: TrainConfig, lr_schedule=None,
         variables = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats
-        # Fused-encoder stage off under differentiation by default: its
-        # backward (XLA reference VJP) re-runs the full XLA forward for
-        # linearization, a measured net loss in training (see
-        # pallas_encoder.override_fused_stem).  config.fused_encoder=True
-        # still forces it on.
-        from ..ops.pallas_encoder import override_fused_stem
-        with override_fused_stem(False):
+        # No trace-time STEM override here any more (round 5): the fused
+        # encoder's backward now consumes the forward's saved residuals
+        # (pallas_encoder._stage_bwd_xla) instead of re-linearizing the
+        # XLA forward, and measures >= plain under training at the
+        # per-shard batches where the auto gate engages it (b1 320x720:
+        # 5.806 vs 5.777 steps/sec; at the reference recipe's 16
+        # images/shard the gate declines — the Pallas FORWARD loses to
+        # XLA's batch-amortized blocked lowering there, 1.205 vs 1.297,
+        # same crossover as inference).  The LAYER2 stage still gates off
+        # under differentiation — its backward re-linearizes the XLA
+        # layer2 (the pattern that was a measured training loss on the
+        # stem).  config.fused_encoder=True still forces both.
+        from ..ops.pallas_layer2 import override_fused_layer2
+        with override_fused_layer2(False):
             preds = model.forward(variables, img1, img2,
                                   iters=cfg.train_iters)
         return sequence_loss(preds, disp_gt, valid,

@@ -1,0 +1,102 @@
+"""A/B the conv1 kernels' dot structure (VERDICT r4 item 1, conv1 part):
+7 per-dy-tap dots (K=30/36, 23-28% MXU K-fill) vs ONE dy-folded big-K dot
+(K=210/252, 2 nearly-full K-passes).  Alternating same-process pairs —
+the chip drifts within a process (docs/perf_notes_r04.md), so the valid
+readout is the per-pair delta, not single shots.
+
+Usage: python scripts/ab_conv1_bigk.py [--realtime] [--reps 10] [--pairs 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--height", type=int, default=540)
+    p.add_argument("--width", type=int, default=960)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--iters", type=int, default=32)
+    p.add_argument("--reps", type=int, default=10)
+    p.add_argument("--pairs", type=int, default=2,
+                   help="off/on alternations")
+    p.add_argument("--realtime", action="store_true")
+    args = p.parse_args()
+
+    from raftstereo_tpu.utils import apply_env_platform
+    apply_env_platform()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raftstereo_tpu.config import RAFTStereoConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.ops import pallas_encoder
+    from raftstereo_tpu.ops.image import InputPadder
+
+    model_kw = {}
+    if args.realtime:
+        model_kw = dict(shared_backbone=True, n_downsample=3, n_gru_layers=2,
+                        hidden_dims=(128, 128), slow_fast_gru=True)
+        args.iters = 7
+    cfg = RAFTStereoConfig(corr_implementation="pallas_alt",
+                           compute_dtype="bfloat16", **model_kw)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.integers(
+        0, 255, (args.batch, args.height, args.width, 3)).astype(np.float32))
+    img2 = jnp.asarray(rng.integers(
+        0, 255, (args.batch, args.height, args.width, 3)).astype(np.float32))
+    padder = InputPadder(img1.shape, divis_by=32)
+    img1, img2 = padder.pad(img1, img2)
+
+    def make_fn():
+        # The toggle is read at TRACE time, so each setting gets its own jit.
+        def run_reps(v, a, b, n):
+            def body(i, acc):
+                lo, up = model.forward(v, a + i.astype(a.dtype) * 0, b,
+                                       iters=args.iters, test_mode=True)
+                return acc + up.sum().astype(jnp.float32)
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+        return jax.jit(run_reps, static_argnums=(3,))
+
+    fns = {}
+    disps = {}
+    for flag in (False, True):
+        pallas_encoder._conv1_bigk = flag
+        fns[flag] = make_fn()
+        float(fns[flag](variables, img1, img2, args.reps))  # compile + warm
+        one = jax.jit(lambda v, a, b: model.forward(
+            v, a, b, iters=args.iters, test_mode=True))
+        disps[flag] = np.asarray(one(variables, img1, img2)[1])
+
+    dev = float(np.abs(disps[True] - disps[False]).max())
+    print(f"max |disp_bigk - disp_7dot| = {dev:.3e} px", flush=True)
+
+    results = {False: [], True: []}
+    for _ in range(args.pairs):
+        for flag in (False, True):
+            t0 = time.perf_counter()
+            float(fns[flag](variables, img1, img2, args.reps))
+            dt = time.perf_counter() - t0
+            pps = args.batch * args.reps / dt
+            results[flag].append(pps)
+            print(f"bigk={flag}: {pps:8.3f} pairs/sec", flush=True)
+
+    for flag in (False, True):
+        print(f"bigk={flag}: {[round(x, 2) for x in results[flag]]}")
+    deltas = [b / a for a, b in zip(results[False], results[True])]
+    print(f"per-pair bigk/7dot ratios: {[round(d, 4) for d in deltas]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -179,7 +179,16 @@ def main():
     med_ours = median(d_ours[-10:])
     med_ctl = median(d_ctl[-10:])
     late_bound = args.envelope_factor * med_ctl + 1e-3
-    ok = worst_early <= args.tol_rel_early and med_ours <= late_bound
+    # Coarse ABSOLUTE loss-regime check alongside the relative envelope:
+    # the Lyapunov control decorrelates by construction, so the envelope
+    # alone could pass a grossly divergent trajectory; requiring the final
+    # median losses to agree within a few x keeps that failure mode gated.
+    fin_ours = median(ours_losses[-10:])
+    fin_ref = median(ref["losses"][-10:])
+    regime_ok = (fin_ours <= 4.0 * fin_ref + 1e-6
+                 and fin_ref <= 4.0 * fin_ours + 1e-6)
+    ok = (worst_early <= args.tol_rel_early and med_ours <= late_bound
+          and regime_ok)
 
     md = ["# Two-stack training parity",
           "",
@@ -216,6 +225,12 @@ def main():
            f"change, i.e. the late-step difference is the system's "
            f"chaotic noise floor, not a cross-stack bias.",
            "",
+           f"Loss-regime check (absolute backstop — the relative envelope "
+           f"cannot pass a grossly divergent trajectory): median final-10 "
+           f"losses ours **{fin_ours:.6f}** vs reference "
+           f"**{fin_ref:.6f}**, required within 4x either way: "
+           f"**{'OK' if regime_ok else 'VIOLATED'}**.",
+           "",
            f"**{'PASS' if ok else 'FAIL'}** — pins gradients, optimizer "
            f"moments, LR schedule, and clipping across the two stacks "
            f"(reference loop: train_stereo.py:162-200)."]
@@ -227,7 +242,10 @@ def main():
                    "worst_early": worst_early,
                    "med_last10_ours": med_ours,
                    "med_last10_control": med_ctl,
-                   "late_bound": late_bound}, f, indent=1)
+                   "late_bound": late_bound,
+                   "final_loss_ours": fin_ours,
+                   "final_loss_ref": fin_ref,
+                   "regime_ok": regime_ok}, f, indent=1)
     print("\n".join(md))
     sys.exit(0 if ok else 1)
 

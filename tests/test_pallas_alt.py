@@ -322,3 +322,56 @@ class TestRadialKernel:
         for a, b in zip(gs, ge):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
+
+
+class TestEpilogue:
+    """The fused convc1 epilogue (relu(corr @ W + b) in-kernel) must match
+    the module path: lookup -> 1x1 conv -> relu."""
+
+    def test_matches_module_path(self, fmaps, coords):
+        from raftstereo_tpu.ops.corr import make_pallas_alt_corr_fn
+
+        f1, f2 = fmaps
+        rng = np.random.default_rng(7)
+        lk = 4 * 9
+        co = 64
+        epi = {"kernel": jnp.asarray(
+                   rng.normal(size=(1, 1, lk, co)).astype(np.float32)) * 0.2,
+               "bias": jnp.asarray(
+                   rng.normal(size=(co,)).astype(np.float32)) * 0.1}
+        plain = make_pallas_alt_corr_fn(f1, f2, 4, 4)(coords)
+        fused = make_pallas_alt_corr_fn(f1, f2, 4, 4, epilogue=epi)(coords)
+        want = jax.nn.relu(
+            jnp.tensordot(plain[..., :lk], epi["kernel"][0, 0], 1)
+            + epi["bias"])
+        assert fused.shape == want.shape
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_model_forward_epilogue_matches(self, rng):
+        """Whole-model test-mode forward with the epilogue gate on vs off
+        (explicit pallas_alt on CPU exercises the interpret kernels)."""
+        from raftstereo_tpu.config import RAFTStereoConfig
+        from raftstereo_tpu.models.raft_stereo import RAFTStereo
+        from raftstereo_tpu.ops import corr as corr_mod
+
+        # bf16 compute: the epilogue gate requires it (fp32 keeps the
+        # certified module-conv numerics; models/raft_stereo.py).
+        cfg = RAFTStereoConfig(corr_implementation="pallas_alt",
+                               compute_dtype="bfloat16")
+        model = RAFTStereo(cfg)
+        v = model.init(jax.random.key(0), (64, 96))
+        img1 = jnp.asarray(rng.integers(0, 255, (1, 64, 96, 3))
+                           .astype(np.float32))
+        img2 = jnp.asarray(rng.integers(0, 255, (1, 64, 96, 3))
+                           .astype(np.float32))
+        prev = corr_mod.corr_epilogue_enabled
+        try:
+            corr_mod.corr_epilogue_enabled = False
+            _, up_off = model.forward(v, img1, img2, iters=3, test_mode=True)
+            corr_mod.corr_epilogue_enabled = True
+            _, up_on = model.forward(v, img1, img2, iters=3, test_mode=True)
+        finally:
+            corr_mod.corr_epilogue_enabled = prev
+        np.testing.assert_allclose(np.asarray(up_on), np.asarray(up_off),
+                                   rtol=1e-4, atol=1e-4)

@@ -379,3 +379,104 @@ class TestFusedConv1Stride2:
             fused = enc.apply(v, x)
         np.testing.assert_allclose(np.asarray(fused), np.asarray(plain),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestParamGradients:
+    """Parameter gradients (kernel AND nonzero bias) of the hand-written
+    saved-residual backward (_stage_bwd_xla / _stage_bwd_xla_affine /
+    _conv1_bwd) vs the reference formulation's autodiff — the input-grad
+    tests above cannot catch a swapped dkernel, a dropped _drelu on a
+    param branch, or a mistransposed weight-grad conv."""
+
+    def params(self, rng, C=8):
+        return {k: {"kernel": jnp.asarray(
+                        rng.normal(size=(3, 3, C, C)).astype(np.float32)) * 0.2,
+                    "bias": jnp.asarray(
+                        rng.normal(size=(C,)).astype(np.float32)) * 0.1}
+                for k in ("c10", "c11", "c20", "c21")}
+
+    def assert_tree_close(self, got, want, rtol=1e-3):
+        # atol keyed to the gradient tree's scale: the instance-norm stage
+        # is shift-invariant, so conv BIAS grads are analytically zero and
+        # their computed values are fp cancellation noise (~1e-9 of the
+        # kernel-grad scale) that differs between formulations; a bug this
+        # suite exists to catch (swapped dkernels, dropped relu mask,
+        # mistransposed conv) shifts leaves at the tree's own magnitude.
+        leaves_w = jax.tree.leaves(want)
+        scale = max(float(np.abs(np.asarray(w)).max()) for w in leaves_w)
+        atol = 1e-4 * (1.0 + scale)
+        for g, w in zip(jax.tree.leaves(got), leaves_w):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=rtol, atol=atol)
+
+    def test_stage_param_grads(self, rng):
+        y1 = jnp.asarray(rng.normal(size=(2, 16, 24, 8))
+                         .astype(np.float32)) * 2 + 0.3
+        params = self.params(rng)
+        f = lambda p: (pe.stem_layer1(y1, p) ** 2).sum()
+        r = lambda p: (pe._xla_reference(y1, p) ** 2).sum()
+        self.assert_tree_close(jax.grad(f)(params), jax.grad(r)(params))
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_conv1_stage_param_grads(self, rng, stride):
+        img = jnp.asarray(rng.normal(size=(1, 16, 32, 3)).astype(np.float32))
+        c1 = {"kernel": jnp.asarray(
+                  rng.normal(size=(7, 7, 3, 8)).astype(np.float32)) * 0.2,
+              "bias": jnp.asarray(
+                  rng.normal(size=(8,)).astype(np.float32)) * 0.1}
+        params = self.params(rng)
+        f = lambda c, p: (pe.conv1_stem_layer1(img, c, p, jnp.float32,
+                                               stride) ** 2).sum()
+        r = lambda c, p: (pe._xla_reference(
+            pe._xla_conv1(img, c, jnp.float32, stride), p) ** 2).sum()
+        got = jax.grad(f, argnums=(0, 1))(c1, params)
+        want = jax.grad(r, argnums=(0, 1))(c1, params)
+        self.assert_tree_close(got, want)
+
+    def test_bn_stage_param_grads(self, rng):
+        y1 = jnp.asarray(rng.normal(size=(2, 16, 24, 8)).astype(np.float32))
+        params = self.params(rng)
+        affines = [(jnp.asarray(np.abs(rng.normal(size=(8,)) * 0.5 + 1)
+                                .astype(np.float32)),
+                    jnp.asarray(rng.normal(size=(8,)).astype(np.float32)
+                                * 0.3))
+                   for _ in range(5)]
+        f = lambda p: (pe.bn_stem_layer1(y1, p, affines) ** 2).sum()
+        r = lambda p: (pe._xla_reference_affine(y1, p, affines) ** 2).sum()
+        self.assert_tree_close(jax.grad(f)(params), jax.grad(r)(params))
+
+    def test_bn_conv1_param_grads(self, rng):
+        img = jnp.asarray(rng.normal(size=(1, 16, 24, 3)).astype(np.float32))
+        c1 = {"kernel": jnp.asarray(
+                  rng.normal(size=(7, 7, 3, 8)).astype(np.float32)) * 0.2,
+              "bias": jnp.asarray(
+                  rng.normal(size=(8,)).astype(np.float32)) * 0.1}
+        params = self.params(rng)
+        affines = [(jnp.asarray(np.abs(rng.normal(size=(8,)) * 0.5 + 1)
+                                .astype(np.float32)),
+                    jnp.asarray(rng.normal(size=(8,)).astype(np.float32)
+                                * 0.3))
+                   for _ in range(5)]
+        f = lambda c, p: (pe.bn_conv1_stem_layer1(img, c, p, affines,
+                                                  jnp.float32) ** 2).sum()
+        r = lambda c, p: (pe._xla_reference_affine(
+            pe._xla_conv1(img, c, jnp.float32), p, affines) ** 2).sum()
+        got = jax.grad(f, argnums=(0, 1))(c1, params)
+        want = jax.grad(r, argnums=(0, 1))(c1, params)
+        self.assert_tree_close(got, want)
+
+    def test_packed_sum_backward_matches_xla(self, rng):
+        """The Pallas dual-sum path of the IN backward (single-device TPU
+        form, forced here in interpret mode) == the XLA mean form."""
+        y1 = jnp.asarray(rng.normal(size=(2, 16, 24, 8))
+                         .astype(np.float32)) * 2 + 0.3
+        params = self.params(rng)
+        f = lambda p: (pe.stem_layer1(y1, p) ** 2).sum()
+        prev = pe._bwd_packed_sums
+        try:
+            pe._bwd_packed_sums = True
+            got = jax.grad(f)(params)
+        finally:
+            pe._bwd_packed_sums = prev
+        r = lambda p: (pe._xla_reference(y1, p) ** 2).sum()
+        self.assert_tree_close(got, jax.grad(r)(params))
