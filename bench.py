@@ -397,8 +397,9 @@ def bench_tiled(height: int, width: int, iters: int, corr: str,
     tiling at all — its answer to large images is the slow ``alt`` path
     plus downsampling (reference: README.md:111,121).
 
-    Returns (wall_s, extras): full-pair wall-clock of the SECOND (warm)
-    pass plus tile bookkeeping and the device's peak-HBM reading."""
+    Returns (pairs_per_sec, extras): the rate 1/wall of the SECOND (warm)
+    full-pair pass, plus tile bookkeeping (including the raw ``wall_s``)
+    and the device's peak-HBM reading."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -590,8 +591,9 @@ def main() -> None:
                         "(synthetic 6000x4000 pair through eval/tiled.py, "
                         "on-demand corr, host-HBM streaming); --height/"
                         "--width override the image shape")
-    p.add_argument("--tile_batch", type=int, default=4,
-                   help="tiles per device dispatch for --tiled (amortizes "
+    p.add_argument("--tile_batch", type=int, default=None,
+                   help="tiles per device dispatch for --tiled, default 4 "
+                        "(2 under --quick); amortizes "
                         "the ~190 ms tunnel dispatch; peak HBM is "
                         "O(tile_batch x tile))")
     p.add_argument("--data", action="store_true",
@@ -648,8 +650,11 @@ def main() -> None:
             # an explicitly passed --height/--width still wins.
             if not explicit_hw:
                 h, w = 288, 800
-            args.tile_batch = 2
+            if args.tile_batch is None:
+                args.tile_batch = 2
             tile_kw = dict(tile_hw=(256, 384), overlap=32, margin=64)
+        if args.tile_batch is None:
+            args.tile_batch = 4
         value, extras = bench_tiled(h, w, args.iters, args.corr,
                                     args.compute_dtype, args.tile_batch,
                                     **tile_kw)

@@ -21,7 +21,7 @@ from .codecs import write_pfm
 from .png16 import write_png16
 
 __all__ = [
-    "make_synthetic_kitti", "make_synthetic_eth3d",
+    "make_synthetic_kitti", "make_learnable_kitti", "make_synthetic_eth3d",
     "make_synthetic_middlebury", "make_synthetic_things_test",
     "make_synthetic_sl", "ShiftStereoDataset",
 ]
@@ -85,6 +85,40 @@ def make_synthetic_kitti(root, n=6, hw=(120, 160), rng=None):
             Image.fromarray(img).save(
                 join(root, "training", cam, f"{i:06d}_10.png"))
         disp = (rng.uniform(1, 60, (h, w)) * 256).astype(np.uint16)
+        write_png16(join(root, "training", "disp_occ_0", f"{i:06d}_10.png"),
+                    disp)
+
+
+def make_learnable_kitti(root, n=48, hw=(352, 744), max_disp=24, rng=None):
+    """KITTI-2015-layout tree whose pairs are actually LEARNABLE: smooth
+    textures with a constant integer shift per image, ground truth = the
+    shift (the on-disk twin of :class:`ShiftStereoDataset`, same
+    ``right(y) = left(y + d)`` convention).
+
+    The plain :func:`make_synthetic_kitti` writes independent random images
+    — fine for layout/reader tests, useless for a training run whose loss
+    curve should DECREASE.  This builder feeds the long-horizon chip
+    training demonstration (scripts/longrun_tpu.py): training on it through
+    the full KITTI adapter + sparse-augmentor path drives EPE toward zero,
+    so the recorded curve proves optimization health, not just throughput.
+    """
+    rng = rng or np.random.default_rng(0)
+    root = str(root)
+    h, w = hw
+    os.makedirs(join(root, "training", "image_2"))
+    os.makedirs(join(root, "training", "image_3"))
+    os.makedirs(join(root, "training", "disp_occ_0"))
+    for i in range(n):
+        d = int(rng.integers(4, max_disp + 1))
+        low = rng.uniform(0, 255, (h // 4 + 1, (w + d) // 4 + 2, 3))
+        tex = np.kron(low, np.ones((4, 4, 1)))[:h, :w + d]
+        left = tex[:, :w].astype(np.uint8)
+        right = tex[:, d:d + w].astype(np.uint8)
+        Image.fromarray(left).save(
+            join(root, "training", "image_2", f"{i:06d}_10.png"))
+        Image.fromarray(right).save(
+            join(root, "training", "image_3", f"{i:06d}_10.png"))
+        disp = np.full((h, w), d * 256, np.uint16)  # KITTI 16-bit: px * 256
         write_png16(join(root, "training", "disp_occ_0", f"{i:06d}_10.png"),
                     disp)
 

@@ -70,27 +70,42 @@ _tls = threading.local()
 _conv1_bigk = False
 
 
-def _get_override():
-    return getattr(_tls, "fused_stem_override", None)
+def make_override_scope(tls, attr):
+    """(getter, contextmanager) pair over a thread-local override slot.
+
+    Shared scaffolding for the fused-stage gates (stem here, layer2 in
+    pallas_layer2): the override scopes a TRACE, and concurrent tracing
+    from another thread must not see this thread's gate — so the slot
+    lives in a ``threading.local``, and the scope restores the previous
+    value on exit (nesting-safe)."""
+    def get():
+        return getattr(tls, attr, None)
+
+    @contextlib.contextmanager
+    def scope(value):
+        prev = get()
+        setattr(tls, attr, value)
+        try:
+            yield
+        finally:
+            setattr(tls, attr, prev)
+
+    return get, scope
 
 
-@contextlib.contextmanager
+_get_override, _stem_scope = make_override_scope(_tls, "fused_stem_override")
+
+
 def override_fused_stem(value):
-    """Trace-time scope for the thread-local gate override.  The train
-    step wraps its forward in override_fused_stem(False): the fused
-    stage's backward is the XLA reference VJP, which re-runs the full XLA
-    forward for linearization — so under differentiation the Pallas
-    forward's saving is paid back with interest (measured: reference
-    recipe 1.264 -> 1.247 steps/sec with the stage on).  A per-model
-    config.fused_encoder=True still wins over this scope (use_fused_stem
-    checks the explicit override first), so the multichip dryrun and
-    forced-path evaluations keep the stage under training."""
-    prev = _get_override()
-    _tls.fused_stem_override = value
-    try:
-        yield
-    finally:
-        _tls.fused_stem_override = prev
+    """Trace-time scope for the thread-local stem-gate override.  Since
+    round 5 the train step no longer forces this off — the stage's
+    backward consumes the forward's saved residuals (_stage_bwd_xla)
+    instead of re-linearizing the XLA forward, and measures >= plain at
+    the per-shard batches where the auto gate engages (train/step.py).
+    Tests force True to pin the interpret-mode kernels on CPU; a
+    per-model config.fused_encoder still wins over this scope
+    (use_fused_stem checks the explicit override first)."""
+    return _stem_scope(value)
 
 
 def _stem_shard_mesh(shape, warn: bool = False):

@@ -40,12 +40,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-import contextlib
 import threading
 
 from .pallas_corr import _COMPILER_PARAMS, _interpret
 from .pallas_norm import _row_block
-from .pallas_encoder import pack_view
+from .pallas_encoder import make_override_scope, pack_view
 
 # A/B toggle (scripts/ab_layer2.py flips it in one process).
 _fused_layer2_enabled = True
@@ -57,19 +56,12 @@ _fused_layer2_enabled = True
 # config.fused_encoder still wins over the scope.
 _tls = threading.local()
 
-
-def _get_l2_override():
-    return getattr(_tls, "override", None)
-
-
-@contextlib.contextmanager
-def override_fused_layer2(value):
-    prev = _get_l2_override()
-    _tls.override = value
-    try:
-        yield
-    finally:
-        _tls.override = prev
+# Same trace-scope mechanism as the stem gate — one shared implementation
+# (pallas_encoder.make_override_scope) so a fix to one cannot desync the
+# other.  The train step holds this one at False (the layer2 backward
+# still re-linearizes the XLA stage, a measured training loss).
+_get_l2_override, override_fused_layer2 = make_override_scope(
+    _tls, "fused_layer2_override")
 
 
 # ------------------------------------------------------------- weights
@@ -467,7 +459,7 @@ def use_fused_layer2(norm_fn, stride, shape, override=None) -> bool:
         return False
     if norm_fn != "instance" or stride != 2 or shape[2] % 2:
         return False
-    if shape[1] % 2 or (shape[1] // 2) % _row_block(shape[1] // 2):
+    if shape[1] % 2:
         return False
     from ..parallel.context import active_corr_mesh
 

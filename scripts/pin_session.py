@@ -1,0 +1,106 @@
+"""Pin the round's headline bench numbers into BENCH_SESSION_r{N}.json.
+
+Runs every headline config through bench.py in ONE process each (fresh
+interpreter per config so no config contaminates another's compile cache /
+HBM), collects the JSON lines, and writes the session file the judge reads
+next to BENCH_r{N}.json.  MFU accounting is ON for every config (VERDICT r4
+item 5 — round 4 only carried it for b1 and train).
+
+Usage: python scripts/pin_session.py [--round 5] [--skip tiled,data] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = [
+    ("flagship_b1", ["--batch", "1"]),
+    ("flagship_b8", ["--batch", "8"]),
+    ("realtime", ["--realtime"]),
+    ("train", ["--train", "--height", "320", "--width", "720",
+               "--batch", "8", "--iters", "16"]),
+    ("tiled_4k", ["--tiled"]),
+    ("data_host", ["--data", "--batch", "8"]),
+    ("data_host_mitigated", ["--data", "--batch", "8",
+                             "--device_photometric"]),
+]
+
+ROUND3 = {  # previous-round values for the vs-last-round column
+    "flagship_b1": 11.199, "flagship_b8": 12.757, "realtime": 112.64,
+    "train": 1.2659,
+}
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--round", type=int, default=5)
+    p.add_argument("--skip", default="",
+                   help="comma-separated config names to skip")
+    p.add_argument("--only", default="",
+                   help="comma-separated config names to run (overrides)")
+    p.add_argument("--quick", action="store_true",
+                   help="pass --quick to every bench invocation (CPU dev)")
+    args = p.parse_args()
+    skip = set(filter(None, args.skip.split(",")))
+    only = set(filter(None, args.only.split(",")))
+
+    out_path = os.path.join(REPO, f"BENCH_SESSION_r{args.round:02d}.json")
+    existing = {}
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            existing = {c.get("config"): c
+                        for c in json.load(f).get("configs", [])}
+
+    configs = []
+    for name, extra in CONFIGS:
+        if name in skip or (only and name not in only):
+            if name in existing:
+                configs.append(existing[name])   # keep the previous pin
+            continue
+        cmd = [sys.executable, "bench.py"] + extra
+        if args.quick:
+            cmd.append("--quick")
+        print(f"=== {name}: {' '.join(cmd)}", flush=True)
+        t0 = time.time()
+        res = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+        wall = time.time() - t0
+        line = res.stdout.strip().splitlines()[-1] if res.stdout.strip() else ""
+        if res.returncode != 0 or not line.startswith("{"):
+            print(f"--- {name} FAILED (rc={res.returncode}):\n{res.stderr[-2000:]}",
+                  flush=True)
+            continue
+        rec = json.loads(line)
+        rec["config"] = name
+        rec["bench_wall_s"] = round(wall, 1)
+        if name in ROUND3:
+            rec["round4"] = ROUND3[name]
+        print(f"--- {name}: {rec.get('value')} {rec.get('unit')} "
+              f"(mfu={rec.get('mfu_vs_measured_peak')}) [{wall:.0f}s]",
+              flush=True)
+        configs.append(rec)
+
+    session = {
+        "session": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        "note": "Headline configs measured on the axon-tunneled TPU v5e, "
+                "bench.py on-device-reps protocol, fresh interpreter per "
+                "config; MFU accounting on for every throughput config "
+                "(VERDICT r4 item 5). Inter-process variance on the shared "
+                "tunneled chip is up to ~10% (docs/perf_notes_r04.md); gate "
+                "decisions rest on same-process A/Bs, these numbers are the "
+                "protocol record.",
+        "configs": configs,
+    }
+    with open(out_path, "w") as f:
+        json.dump(session, f, indent=1)
+    print(f"wrote {out_path} ({len(configs)} configs)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
