@@ -56,6 +56,10 @@ def train_cmd(args, data_root):
         "--corr_implementation", args.corr,
         "--device_photometric",
         "--nan_policy", "abort",
+        # Elastic recovery: the tunneled chip's remote-compile endpoint
+        # drops connections under load; a restart resumes from the latest
+        # checkpoint (or step 0) instead of failing the whole horizon.
+        "--max_restarts", "3",
         "--lr", str(args.lr),
     ]
 

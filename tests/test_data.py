@@ -229,6 +229,25 @@ class TestDatasets:
         # stereo convention: flow = -disparity <= 0 where valid
         assert (flow[valid > 0.5] <= 0).all()
 
+    def test_learnable_kitti_shift_convention(self, tmp_path, rng):
+        """The long-horizon training tree (scripts/longrun_tpu.py) must be
+        geometrically exact: right(x) = left(x + d), flow = -d, dense
+        valid — otherwise the committed loss curve's descent means
+        nothing."""
+        from raftstereo_tpu.data.synthetic import make_learnable_kitti
+        make_learnable_kitti(tmp_path, n=2, hw=(120, 180), max_disp=12,
+                             rng=rng)
+        ds = KITTI(aug_params=None, root=str(tmp_path))
+        assert len(ds) == 2
+        for i in range(2):
+            _, img1, img2, flow, valid = ds[i]
+            d = -flow[0, 0, 0]
+            assert 4 <= d <= 12 and d == int(d)
+            np.testing.assert_array_equal(flow[..., 0], -d)
+            assert (valid > 0.5).all()
+            di = int(d)
+            np.testing.assert_array_equal(img1[:, di:], img2[:, :-di])
+
     def test_mul_replication(self, tmp_path, rng):
         make_synthetic_kitti(tmp_path, rng=rng)
         ds = KITTI(aug_params=None, root=str(tmp_path))
