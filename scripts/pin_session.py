@@ -32,7 +32,7 @@ CONFIGS = [
                              "--device_photometric"]),
 ]
 
-ROUND3 = {  # previous-round values for the vs-last-round column
+PREV_ROUND = {  # previous-round (r4) values for the vs-last-round column
     "flagship_b1": 11.199, "flagship_b8": 12.757, "realtime": 112.64,
     "train": 1.2659,
 }
@@ -79,8 +79,8 @@ def main():
         rec = json.loads(line)
         rec["config"] = name
         rec["bench_wall_s"] = round(wall, 1)
-        if name in ROUND3:
-            rec["round4"] = ROUND3[name]
+        if name in PREV_ROUND:
+            rec["round4"] = PREV_ROUND[name]
         print(f"--- {name}: {rec.get('value')} {rec.get('unit')} "
               f"(mfu={rec.get('mfu_vs_measured_peak')}) [{wall:.0f}s]",
               flush=True)
