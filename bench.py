@@ -385,7 +385,7 @@ def bench_train(height: int, width: int, batch: int, iters: int, corr: str,
 
 def bench_tiled(height: int, width: int, iters: int, corr: str,
                 compute_dtype: str, tile_batch: int,
-                tile_hw=(1056, 1568), overlap: int = 128,
+                tile_hw=(1536, 1568), overlap: int = 128,
                 margin: int = 512):
     """BASELINE config #5: Middlebury-4K-scale tiled inference on the chip.
 
@@ -592,7 +592,7 @@ def main() -> None:
                         "on-demand corr, host-HBM streaming); --height/"
                         "--width override the image shape")
     p.add_argument("--tile_batch", type=int, default=None,
-                   help="tiles per device dispatch for --tiled, default 4 "
+                   help="tiles per device dispatch for --tiled, default 2 "
                         "(2 under --quick); amortizes "
                         "the ~190 ms tunnel dispatch; peak HBM is "
                         "O(tile_batch x tile))")
@@ -654,7 +654,11 @@ def main() -> None:
                 args.tile_batch = 2
             tile_kw = dict(tile_hw=(256, 384), overlap=32, margin=64)
         if args.tile_batch is None:
-            args.tile_batch = 4
+            # 2 tiles/dispatch = 4 images: the fused-encoder gate's
+            # crossover (<= 4 images/shard) — tb=3 measured 10% slower
+            # because the 6-image dispatch pushes the encoder back to
+            # XLA (docs/perf_notes_r05.md, tiled geometry sweep).
+            args.tile_batch = 2
         value, extras = bench_tiled(h, w, args.iters, args.corr,
                                     args.compute_dtype, args.tile_batch,
                                     **tile_kw)
