@@ -111,7 +111,8 @@ def _trunk_layer2(enc, x):
     relayouts (docs/perf_notes_r05.md); the fused stage keeps everything
     row-major (ops/pallas_layer2.py).  Numerically pinned against this
     exact module path in tests/test_pallas_layer2.py."""
-    from ..ops.pallas_layer2 import fused_layer2, use_fused_layer2
+    from ..ops.pallas_layer2 import (fused_layer2, fused_layer2_bn,
+                                     use_fused_layer2)
 
     stride2 = 1 + (enc.downsample > 1)
     if (not enc.is_initializing()
@@ -124,6 +125,21 @@ def _trunk_layer2(enc, x):
             "c3": enc.layer2_1.conv1.variables["params"],
             "c4": enc.layer2_1.conv2.variables["params"],
         }
+        if enc.norm_fn == "batch":
+            # Frozen BN folds to constant prep affines, exactly like the
+            # stem stage (pallas_encoder.bn_affine); stage order:
+            # norm1, projection norm, norm2, layer2_1.norm1/norm2.
+            from ..ops import pallas_layer2 as _pl2
+            from ..ops.pallas_encoder import bn_affine, fused_stem_forced
+            if not (_pl2._fused_layer2_bn_enabled
+                    or fused_stem_forced(enc.fused_stem)):
+                return enc.layer2_1(enc.layer2_0(x))
+            affines = [
+                bn_affine(m.variables["params"], m.variables["batch_stats"])
+                for m in (enc.layer2_0.norm1, enc.layer2_0.downsample_norm,
+                          enc.layer2_0.norm2, enc.layer2_1.norm1,
+                          enc.layer2_1.norm2)]
+            return fused_layer2_bn(x, params, affines, enc.dtype)
         return fused_layer2(x, params, enc.dtype)
     return enc.layer2_1(enc.layer2_0(x))
 

@@ -63,6 +63,12 @@ _tls = threading.local()
 _get_l2_override, override_fused_layer2 = make_override_scope(
     _tls, "fused_layer2_override")
 
+# Sub-gate for the frozen-BN (constant-affine) variant on top of the main
+# layer2 gate: lets the batch-norm branch (context encoder / realtime
+# trunk) be A/B'd and, if need be, shipped independently of the
+# instance-norm stage (scripts/ab_layer2_bn.py).
+_fused_layer2_bn_enabled = True
+
 
 # ------------------------------------------------------------- weights
 
@@ -369,31 +375,48 @@ def _params_of(params, key):
     return params[key]["kernel"], params[key]["bias"]
 
 
-def _fused_layer2_fwd(t_in, params, dt):
+def _fused_layer2_fwd(t_in, params, dt, affines=None):
     """t_in: (B, H, W, 64) stage activation.  params keys: c1 (3,3,64,96
     stride-2), proj (1x1: (64, 96)), c2, c3, c4 (3,3,96,96).
-    Returns (B, H/2, W/2, 96)."""
+    Returns (B, H/2, W/2, 96).
+
+    ``affines``: None for instance norm (per-image stats computed by the
+    kernels' fused accumulators), or 5 constant (s, t) pairs — folded
+    frozen-BatchNorm affines (pallas_encoder.bn_affine) in stage order
+    (norm1, projection norm, norm2, layer2_1.norm1, layer2_1.norm2);
+    the kernels' prep form relu(x*s + t) expresses both exactly."""
     xp = pack_view(t_in)
+    b = t_in.shape[0]
     n = float(t_in.shape[1] // 2 * (t_in.shape[2] // 2))
+
+    def aff(stats_pair, i):
+        if affines is None:
+            return _flat_affine(*stats_pair, n)
+        s, t = affines[i]
+        return (jnp.broadcast_to(s.astype(jnp.float32)[None, None],
+                                 (b, 1, s.shape[-1])),
+                jnp.broadcast_to(t.astype(jnp.float32)[None, None],
+                                 (b, 1, t.shape[-1])))
+
     k1, b1 = _params_of(params, "c1")
     kp, bp = _params_of(params, "proj")
     c1, p, s1a, s1b, spa, spb = _l2_entry(
         xp, pack_weights3s2(k1).astype(dt), b1.astype(dt),
         kp.reshape(kp.shape[-2:]).astype(dt), bp.astype(dt), dt)
-    a1 = _flat_affine(s1a, s1b, n)
-    ap = _flat_affine(spa, spb, n)
+    a1 = aff((s1a, s1b), 0)
+    ap = aff((spa, spb), 1)
     k2, b2 = _params_of(params, "c2")
     c2, s2a, s2b = _l2_conv(c1, a1, pack_weights3(k2).astype(dt),
                             b2.astype(dt), dt)
-    a2 = _flat_affine(s2a, s2b, n)
+    a2 = aff((s2a, s2b), 2)
     k3, b3 = _params_of(params, "c3")
     c3, s3a, s3b = _l2_conv(c2, a2, pack_weights3(k3).astype(dt),
                             b3.astype(dt), dt, res=p, res_aff=ap)
-    a3 = _flat_affine(s3a, s3b, n)
+    a3 = aff((s3a, s3b), 3)
     k4, b4 = _params_of(params, "c4")
     c4, s4a, s4b = _l2_conv(c3, a3, pack_weights3(k4).astype(dt),
                             b4.astype(dt), dt)
-    a4 = _flat_affine(s4a, s4b, n)
+    a4 = aff((s4a, s4b), 4)
     return _l2_finish(p, ap, c2, a2, c4, a4, dt)
 
 
@@ -423,6 +446,31 @@ def _xla_layer2_reference(t_in, params):
     return jnp.maximum(out0 + y4, 0)
 
 
+def _xla_layer2_reference_affine(t_in, params, affines):
+    """Plain-XLA mirror of the frozen-BN (constant-affine) stage."""
+    def nr(x, i, relu=True):
+        s, t = affines[i]
+        y = x * s.astype(x.dtype) + t.astype(x.dtype)
+        return jnp.maximum(y, 0) if relu else y
+
+    def conv(x, k, b, stride=1):
+        pad = 1 if k.shape[0] == 3 else 0
+        return jax.lax.conv_general_dilated(
+            x, k.astype(x.dtype), (stride, stride),
+            ((pad, pad), (pad, pad)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + b.astype(x.dtype)
+
+    c1 = conv(t_in, *_params_of(params, "c1"), stride=2)
+    u2 = nr(conv(nr(c1, 0), *_params_of(params, "c2")), 2)
+    pn = nr(conv(t_in, *_params_of(params, "proj"), stride=2), 1,
+            relu=False)
+    out0 = jnp.maximum(pn + u2, 0)
+    c3 = conv(out0, *_params_of(params, "c3"))
+    y4 = nr(conv(nr(c3, 3), *_params_of(params, "c4")), 4)
+    return jnp.maximum(out0 + y4, 0)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def fused_layer2(t_in, params, dt=jnp.float32):
     """Fused forward; XLA-reference backward (inference-first — the gate
@@ -443,9 +491,35 @@ def _bwd_l2(dt, residuals, g):
 fused_layer2.defvjp(_fwd_l2, _bwd_l2)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer2_bn(t_in, params, affines, dt=jnp.float32):
+    """Frozen-BatchNorm layer2 stage: the same Pallas pipeline with the
+    five norm affines constant (pallas_encoder.bn_affine) instead of
+    in-kernel instance stats.  Covers the context encoder's layer2 (the
+    reference's cnet uses batch norm, core/extractor.py:199-300) and the
+    realtime config's shared trunk.  Fused forward; XLA-reference
+    backward (training keeps the plain XLA stage via the gate)."""
+    return _fused_layer2_fwd(t_in, params, dt, affines=affines)
+
+
+def _fwd_l2_bn(t_in, params, affines, dt):
+    return (_fused_layer2_fwd(t_in, params, dt, affines=affines),
+            (t_in, params, affines))
+
+
+def _bwd_l2_bn(dt, residuals, g):
+    t_in, params, affines = residuals
+    _, vjp = jax.vjp(_xla_layer2_reference_affine, t_in, params, affines)
+    return vjp(g)
+
+
+fused_layer2_bn.defvjp(_fwd_l2_bn, _bwd_l2_bn)
+
+
 def use_fused_layer2(norm_fn, stride, shape, override=None) -> bool:
-    """Gate: instance norm, stride-2 layer2, even W, no active mesh
-    (shard plumbing not built), single-device TPU unless forced.
+    """Gate: instance or frozen-batch norm, stride-2 layer2, even W, no
+    active mesh (shard plumbing not built), single-device TPU unless
+    forced.
 
     Precedence mirrors use_fused_stem: ``override`` (per-model
     config.fused_encoder) > the override_fused_layer2 thread-local scope
@@ -457,7 +531,7 @@ def use_fused_layer2(norm_fn, stride, shape, override=None) -> bool:
     explicit shardings must keep the plain XLA stage."""
     if not _fused_layer2_enabled:
         return False
-    if norm_fn != "instance" or stride != 2 or shape[2] % 2:
+    if norm_fn not in ("instance", "batch") or stride != 2 or shape[2] % 2:
         return False
     if shape[1] % 2:
         return False
