@@ -320,7 +320,21 @@ class BasicMotionEncoder(nn.Module):
         # convc1's parameters are consumed by the kernel, not here.
         c1 = corr if preact else nn.relu(self.convc1(corr))
         cor = nn.relu(self.convc2(c1))
-        flo = nn.relu(self.convf2(nn.relu(self.convf1(flow))))
+        if self.is_initializing() or self.dtype != jnp.bfloat16:
+            f1 = self.convf1(flow)
+        else:
+            # Stereo flow's y channel is STRUCTURALLY zero — the model
+            # builds flow = [d, 0] every iteration (raft_stereo.py step;
+            # delta y is zeroed, flow_init folds into the 1-channel d) —
+            # so the kernel's y input-slice only ever multiplies zeros.
+            # Contract only the x slice: algebraically exact (the dropped
+            # products are exact fp zeros; convf1's K halves 98 -> 49,
+            # +0.5-0.7% b1 x3 alternating), but the compiled contraction
+            # ORDER differs, so outputs shift at rounding level — gated to
+            # bf16 compute; fp32 keeps the certified-parity conv form
+            # (same policy as the corr epilogue, ops/pallas_alt.py).
+            f1 = _sliced_conv(self.convf1, flow[..., :1], 0, 1)
+        flo = nn.relu(self.convf2(nn.relu(f1)))
         out = nn.relu(self.conv(jnp.concatenate([cor, flo], axis=-1)))
         return jnp.concatenate([out, flow], axis=-1)
 
