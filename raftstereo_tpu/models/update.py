@@ -335,6 +335,11 @@ class BasicMotionEncoder(nn.Module):
             # (same policy as the corr epilogue, ops/pallas_alt.py).
             f1 = _sliced_conv(self.convf1, flow[..., :1], 0, 1)
         flo = nn.relu(self.convf2(nn.relu(f1)))
+        # The [cor, flo] concat feeding self.conv measured FREE here —
+        # slicing it like the GRU gates was a wash (alternating b1 pairs
+        # 1.00/0.999; XLA fuses this concat into the conv read, unlike
+        # the GRU's carry concats) — committed negative, keep the
+        # reference form.
         out = nn.relu(self.conv(jnp.concatenate([cor, flo], axis=-1)))
         return jnp.concatenate([out, flow], axis=-1)
 
