@@ -519,6 +519,52 @@ def bench_data(batch: int, num_workers: int,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_serve(height: int, width: int, iters: int, max_batch: int,
+                requests: int, concurrency: int, corr: str,
+                compute_dtype: str, quick: bool):
+    """Serving-path smoke benchmark: spin the HTTP server up in-process,
+    drive closed-loop traffic through the real wire format via the load-gen
+    client, and report achieved pairs/sec + p99 latency.  Exercises the
+    whole subsystem — bucketed compile cache, micro-batcher, admission
+    control, metrics — not just the forward (docs/serving.md)."""
+    import threading
+
+    from raftstereo_tpu.config import RAFTStereoConfig, ServeConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.serve import (build_server, run_load,
+                                      synthetic_pair_pool)
+
+    import jax
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        # CPU-feasible model, same shrink as the test suite's tiny configs.
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype, **model_kw)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+    serve_cfg = ServeConfig(
+        port=0, buckets=((height, width),), max_batch_size=max_batch,
+        max_wait_ms=5.0, queue_limit=max(4 * max_batch, 16),
+        # quick: one warmup compile, not two — degradation has its own test.
+        iters=iters, degraded_iters=iters if quick else max(1, iters // 2),
+        degrade_queue_depth=max(4 * max_batch, 16))
+    server = build_server(model, variables, serve_cfg)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        stats = run_load(serve_cfg.host, server.port,
+                         synthetic_pair_pool(height, width),
+                         requests=requests, concurrency=concurrency)
+    finally:
+        server.close()
+        thread.join(10)
+    return stats
+
+
 def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
                            reps: int) -> float:
     """Run the reference PyTorch model (random weights) on CPU at the same
@@ -553,11 +599,17 @@ def main() -> None:
                    help="image height (default 540; 4000 with --tiled)")
     p.add_argument("--width", type=int, default=None,
                    help="image width (default 960; 6000 with --tiled)")
-    p.add_argument("--batch", type=int, default=1)
-    p.add_argument("--iters", type=int, default=32)
+    p.add_argument("--batch", type=int, default=None,
+                   help="batch size (default 1; with --serve: "
+                        "max_batch_size, default 8)")
+    p.add_argument("--iters", type=int, default=None,
+                   help="GRU iterations (default 32; --quick lowers it "
+                        "only when not given explicitly)")
     p.add_argument("--corr", default="auto",
                    choices=["auto", "reg", "alt", "pallas", "pallas_alt"])
-    p.add_argument("--reps", type=int, default=20)
+    p.add_argument("--reps", type=int, default=None,
+                   help="timed repeats (default 20; 3 under --quick "
+                        "unless given explicitly)")
     p.add_argument("--compute_dtype", default="bfloat16",
                    choices=["float32", "bfloat16"])
     p.add_argument("--corr_dtype", default="float32",
@@ -596,6 +648,14 @@ def main() -> None:
                         "(2 under --quick); amortizes "
                         "the ~190 ms tunnel dispatch; peak HBM is "
                         "O(tile_batch x tile))")
+    p.add_argument("--serve", action="store_true",
+                   help="benchmark the serving subsystem end to end: "
+                        "in-process HTTP server + closed-loop load-gen "
+                        "client; reports achieved pairs/sec and p99 "
+                        "latency (--reps = request count, --batch = "
+                        "max_batch_size)")
+    p.add_argument("--serve_concurrency", type=int, default=4,
+                   help="closed-loop load-gen workers for --serve")
     p.add_argument("--data", action="store_true",
                    help="measure host data-pipeline throughput (KITTI-size "
                         "decode + sparse augmentation, multiprocess workers) "
@@ -608,6 +668,14 @@ def main() -> None:
                         "host does decode + spatial aug only)")
     args = p.parse_args()
     explicit_hw = args.height is not None or args.width is not None
+    explicit_iters = args.iters is not None
+    explicit_reps = args.reps is not None
+    if args.iters is None:
+        args.iters = 32
+    if args.reps is None:
+        args.reps = 20
+    if args.batch is None and not args.serve:
+        args.batch = 1  # --serve resolves its own default (8; 4 in --quick)
     # Defaults keyed on the mode, resolved only when the flag was NOT
     # given — an explicit --height/--width always wins (also under --tiled,
     # also with --quick).
@@ -631,15 +699,55 @@ def main() -> None:
         return
 
     if args.quick:
-        args.height, args.width, args.iters, args.reps = 256, 320, 8, 3
-    if args.realtime:
-        args.iters = 7
+        # Honor the contract stated above: an explicitly given flag wins
+        # even under --quick (the old unconditional clobber silently
+        # benchmarked 256x320/8 iters whatever the user asked for).
+        if not explicit_hw:
+            args.height, args.width = 256, 320
+        if not explicit_iters:
+            args.iters = 8
+        if not explicit_reps:
+            args.reps = 3
+    if args.realtime and not explicit_iters:
+        args.iters = 7  # the reference's realtime protocol iteration count
 
     # The image's site hook imports jax at interpreter startup, freezing the
     # platform before JAX_PLATFORMS from the shell can apply — push it
     # through jax.config so `JAX_PLATFORMS=cpu python bench.py` works.
     from raftstereo_tpu.utils import apply_env_platform
     apply_env_platform()
+
+    if args.serve:
+        h, w = args.height, args.width
+        # None = flag not given (an explicit --batch 1 means max_batch 1:
+        # the no-batching baseline for quantifying the batcher's gain).
+        batch = args.batch if args.batch is not None else 8
+        requests = args.reps
+        if args.quick:
+            # Tiny model + shape; still crosses the full HTTP + batcher
+            # path with enough requests to coalesce real batches.
+            if not explicit_hw:
+                h, w = 64, 96
+            batch = args.batch if args.batch is not None else 4
+            requests = max(args.reps, 12)
+            if not explicit_iters:
+                args.iters = min(args.iters, 4)  # keep the smoke fast
+        stats = bench_serve(h, w, args.iters, batch, requests,
+                            args.serve_concurrency, args.corr,
+                            args.compute_dtype, quick=args.quick)
+        record = {
+            "metric": f"serve pairs/sec @{w}x{h}, {args.iters} GRU iters, "
+                      f"max_batch {batch}, dynamic batching over HTTP",
+            "value": stats.get("pairs_per_sec", 0.0),
+            "unit": "pairs/sec",
+            "vs_baseline": 0.0,
+        }
+        for k in ("p50_ms", "p99_ms", "ok", "shed", "timeout", "error",
+                  "wall_s", "concurrency"):
+            if k in stats:
+                record[k] = stats[k]
+        print(json.dumps(record))
+        return
 
     if args.tiled:
         h, w = args.height, args.width

@@ -4,5 +4,7 @@
 * ``python -m raftstereo_tpu.cli.train``     — training loop
 * ``python -m raftstereo_tpu.cli.evaluate``  — benchmark validation
 * ``python -m raftstereo_tpu.cli.demo``      — disparity inference + viz
+* ``python -m raftstereo_tpu.cli.serve``     — dynamic-batching HTTP serving
+  (+ ``--loadgen`` traffic driver; docs/serving.md)
 * ``python -m raftstereo_tpu.cli.sl_smoke``  — structured-light data check
 """

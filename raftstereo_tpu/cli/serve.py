@@ -1,0 +1,107 @@
+"""Serving entry point: dynamic-batching stereo inference over HTTP.
+
+Serve (blocks until Ctrl-C):
+
+    python -m raftstereo_tpu.cli.serve --restore_ckpt models/sceneflow.pth \
+        --port 8080 --buckets 540x960 --max_batch_size 8
+
+Load-generate against a running server (synthetic traffic):
+
+    python -m raftstereo_tpu.cli.serve --loadgen --port 8080 \
+        --requests 64 --concurrency 4 --image_size 540x960
+
+Endpoints, wire format and the metrics reference live in docs/serving.md.
+All model flags (``add_model_args``) and serving knobs (``add_serve_args``)
+come from the shared typed configs in config.py — no fresh argparse block.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+import numpy as np
+
+from ..config import (_parse_bucket, add_model_args, add_serve_args,
+                      model_config_from_args, serve_config_from_args)
+from .common import load_variables, setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", default=None,
+                   help=".pth or Orbax weights to serve")
+    p.add_argument("--loadgen", action="store_true",
+                   help="run the load generator against --host/--port "
+                        "instead of serving")
+    g = p.add_argument_group("loadgen")
+    g.add_argument("--requests", type=int, default=64)
+    g.add_argument("--concurrency", type=int, default=4)
+    g.add_argument("--open_rate", type=float, default=None,
+                   help="open-loop arrival rate in requests/sec "
+                        "(default: closed loop)")
+    g.add_argument("--image_size", type=_parse_bucket, default=(540, 960),
+                   metavar="HxW", help="synthetic request image shape")
+    g.add_argument("--request_iters", type=int, default=None,
+                   help="explicit per-request GRU iterations; must be one "
+                        "of the server's configured levels (--serve_iters "
+                        "or --degraded_iters). default: server-adaptive")
+    add_serve_args(p)
+    add_model_args(p)
+    return p
+
+
+def run_loadgen(args) -> int:
+    from ..serve import run_load, synthetic_pair_pool
+
+    h, w = args.image_size
+    stats = run_load(
+        args.host, args.port,
+        synthetic_pair_pool(h, w, n=min(8, args.requests)),
+        requests=args.requests, concurrency=args.concurrency,
+        mode="open" if args.open_rate else "closed", rate=args.open_rate,
+        iters=args.request_iters)
+    print(json.dumps(stats))
+    return 0
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    if args.loadgen:
+        return run_loadgen(args)
+
+    import jax
+
+    from ..models import RAFTStereo
+    from ..serve import build_server
+
+    config = model_config_from_args(args)
+    serve_cfg = serve_config_from_args(args)
+    model = RAFTStereo(config)
+    if args.restore_ckpt:
+        variables = load_variables(args.restore_ckpt, config, model)
+        logger.info("Loaded checkpoint %s", args.restore_ckpt)
+    else:
+        variables = model.init(jax.random.key(0))
+        logger.warning("No --restore_ckpt: serving RANDOM weights")
+
+    server = build_server(model, variables, serve_cfg)
+    print(json.dumps({"serving": f"http://{serve_cfg.host}:{server.port}",
+                      "endpoints": ["/predict", "/metrics", "/healthz"]}),
+          flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,6 +153,146 @@ class TrainConfig:
             object.__setattr__(self, "saturation_range", tuple(self.saturation_range))
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving-layer parameters (serve/): dynamic micro-batching, the
+    shape-bucketed compile cache, admission control and graceful
+    degradation.  Consumed by ``python -m raftstereo_tpu.cli.serve`` and by
+    ``bench.py --serve``; frozen + hashable like the other configs."""
+
+    host: str = "127.0.0.1"
+    port: int = 8080  # 0 = ephemeral (tests/bench bind a free port)
+
+    # Shape policy, shared bitwise with the Evaluator via
+    # ops/image.BucketPadder: align to divis_by, round up to bucket_multiple.
+    divis_by: int = 32
+    bucket_multiple: int = 64
+    # Image shapes (H, W) whose buckets are compiled at startup so the first
+    # real request in each never pays an XLA compile.
+    buckets: Tuple[Tuple[int, int], ...] = ((540, 960),)
+    warmup: bool = True
+
+    # Dynamic micro-batching: a batch closes at max_batch_size or when the
+    # oldest member has waited max_wait_ms, whichever comes first.  Every
+    # dispatched batch is zero-padded to max_batch_size so each shape bucket
+    # compiles exactly once.
+    max_batch_size: int = 8
+    max_wait_ms: float = 5.0
+
+    # Robustness: bounded queue (admission control sheds above the limit),
+    # per-request timeout, and load-adaptive GRU-iteration reduction once
+    # the queue backlog crosses degrade_queue_depth.
+    queue_limit: int = 64
+    request_timeout_ms: float = 30000.0
+    iters: int = 32
+    degraded_iters: int = 16
+    degrade_queue_depth: int = 16
+
+    # Request-size admission caps (each compile and each oversized tensor
+    # costs everyone queued behind it): reject bodies above max_body_mb
+    # (413) and images with a side above max_image_dim (400) before any
+    # decode/allocation.  The body default is sized to what max_image_dim
+    # actually needs (a 2048^2 fp32 pair is ~134 MB base64), not beyond
+    # it.  cold_buckets=False additionally rejects shapes whose bucket
+    # was not warmed at startup (400) — the production setting; True
+    # compiles on demand (development, tests).
+    max_body_mb: float = 160.0
+    max_image_dim: int = 2048
+    cold_buckets: bool = True
+
+    def __post_init__(self):
+        if isinstance(self.buckets, list):
+            object.__setattr__(
+                self, "buckets", tuple(tuple(b) for b in self.buckets))
+        # Degradation can only reduce work: a degraded_iters above iters
+        # (e.g. the default 16 with --serve_iters 8) clamps down rather
+        # than rejecting the config.
+        if self.degraded_iters > self.iters:
+            object.__setattr__(self, "degraded_iters", self.iters)
+        assert self.max_batch_size >= 1, self.max_batch_size
+        assert self.queue_limit >= self.max_batch_size, (
+            f"queue_limit {self.queue_limit} < max_batch_size "
+            f"{self.max_batch_size}: no full batch could ever form")
+        assert self.iters >= 1 and self.degraded_iters >= 1, (
+            self.iters, self.degraded_iters)
+        assert self.max_wait_ms >= 0, self.max_wait_ms
+        assert self.divis_by >= 1 and self.bucket_multiple >= 1
+        assert self.max_body_mb > 0 and self.max_image_dim >= 1
+
+
+def _parse_bucket(text: str) -> Tuple[int, int]:
+    try:
+        h, w = (int(v) for v in text.lower().split("x"))
+        return h, w
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"bucket {text!r} is not HxW (e.g. 540x960)")
+
+
+def add_serve_args(parser: argparse.ArgumentParser) -> None:
+    d = ServeConfig()
+    g = parser.add_argument_group("serve")
+    g.add_argument("--host", default=d.host)
+    g.add_argument("--port", type=int, default=d.port,
+                   help="0 binds an ephemeral port")
+    g.add_argument("--divis_by", type=int, default=d.divis_by)
+    g.add_argument("--bucket_multiple", type=int, default=d.bucket_multiple,
+                   help="round padded shapes up to this grid so "
+                        "near-identical sizes share one compile")
+    g.add_argument("--buckets", nargs="+", type=_parse_bucket,
+                   default=list(d.buckets), metavar="HxW",
+                   help="image shapes warmed at startup (e.g. 540x960)")
+    g.add_argument("--no_warmup", action="store_true",
+                   help="skip startup compilation of --buckets")
+    g.add_argument("--max_batch_size", type=int, default=d.max_batch_size)
+    g.add_argument("--max_wait_ms", type=float, default=d.max_wait_ms,
+                   help="batching deadline: max time the oldest queued "
+                        "request waits for a batch to fill")
+    g.add_argument("--queue_limit", type=int, default=d.queue_limit,
+                   help="admission control: requests beyond this backlog "
+                        "are shed with an 'overloaded' response")
+    g.add_argument("--request_timeout_ms", type=float,
+                   default=d.request_timeout_ms)
+    g.add_argument("--serve_iters", type=int, default=d.iters,
+                   help="GRU iterations per request under normal load")
+    g.add_argument("--degraded_iters", type=int, default=d.degraded_iters,
+                   help="reduced GRU iterations once the queue backlog "
+                        "crosses --degrade_queue_depth (graceful "
+                        "degradation; RAFT-Stereo quality falls smoothly "
+                        "with iteration count)")
+    g.add_argument("--degrade_queue_depth", type=int,
+                   default=d.degrade_queue_depth)
+    g.add_argument("--max_body_mb", type=float, default=d.max_body_mb,
+                   help="reject request bodies above this size (HTTP 413)")
+    g.add_argument("--max_image_dim", type=int, default=d.max_image_dim,
+                   help="reject images with a side above this (HTTP 400)")
+    g.add_argument("--no_cold_buckets", action="store_true",
+                   help="reject shapes whose bucket was not warmed at "
+                        "startup instead of compiling on demand (recommended "
+                        "in production: a compile stalls everyone queued)")
+
+
+def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
+    return ServeConfig(
+        host=args.host,
+        port=args.port,
+        divis_by=args.divis_by,
+        bucket_multiple=args.bucket_multiple,
+        buckets=tuple(tuple(b) for b in args.buckets),
+        warmup=not args.no_warmup,
+        max_batch_size=args.max_batch_size,
+        max_wait_ms=args.max_wait_ms,
+        queue_limit=args.queue_limit,
+        request_timeout_ms=args.request_timeout_ms,
+        iters=args.serve_iters,
+        degraded_iters=args.degraded_iters,
+        degrade_queue_depth=args.degrade_queue_depth,
+        max_body_mb=args.max_body_mb,
+        max_image_dim=args.max_image_dim,
+        cold_buckets=not args.no_cold_buckets,
+    )
+
+
 # ---------------------------------------------------------------------------
 # CLI plumbing: one flag set, shared by every entry point.
 # ---------------------------------------------------------------------------

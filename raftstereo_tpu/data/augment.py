@@ -42,8 +42,11 @@ def resize_bilinear(arr: np.ndarray, fx: float, fy: float) -> np.ndarray:
     # In-place accumulation on the fancy-index copies: same arithmetic as
     # t0*(1-w) + t1*w with half the full-size temporaries (this runs per
     # sample on the host; the loader is CPU-bound, SURVEY.md §7 part 6).
-    trail = [None] * (arr.ndim - 2)
-    wy_b, wx_b = wy[:, None, *trail], wx[None, :, *trail]
+    # Tuple indices, not `wy[:, None, *trail]`: starred expressions inside a
+    # subscript need python >= 3.11, and this must import on 3.10.
+    trail = (None,) * (arr.ndim - 2)
+    wy_b = wy[(slice(None), None) + trail]
+    wx_b = wx[(None, slice(None)) + trail]
     t = a[y1]
     t -= a[y0]
     t *= wy_b

@@ -1,11 +1,13 @@
 """Compiled inference runner for evaluation and demo.
 
-Wraps the model's test-mode forward behind an ``InputPadder``; ``jax.jit``
-caches one executable per distinct padded shape, so a dataset with varying
-image sizes (e.g. ETH3D) compiles once per shape instead of per image
-(SURVEY.md §7 hard-part 4: dynamic shapes vs XLA recompilation).
-``bucket_multiple`` optionally rounds the padded shape up to a coarser grid
-to share compiles across near-identical sizes.
+Wraps the model's test-mode forward behind the shared pad-and-bucket shape
+policy (``ops/image.BucketPadder``); ``jax.jit`` caches one executable per
+distinct padded shape, so a dataset with varying image sizes (e.g. ETH3D)
+compiles once per shape instead of per image (SURVEY.md §7 hard-part 4:
+dynamic shapes vs XLA recompilation).  ``bucket_multiple`` optionally rounds
+the padded shape up to a coarser grid to share compiles across
+near-identical sizes — the same policy the serving engine
+(serve/engine.py) uses, so their outputs agree bitwise.
 
 Replaces the per-image boilerplate of the reference evaluators
 (reference: evaluate_stereo.py:28-36,70-83): pad -> forward(test_mode) ->
@@ -18,13 +20,14 @@ returns at enqueue time, and only a host fetch proves execution finished
 from __future__ import annotations
 
 import time
-from typing import Optional, Set, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.image import InputPadder, replicate_pad
+from ..ops.image import BucketPadder
+from ..utils.profiling import LatencyHistogram
 
 
 class Evaluator:
@@ -37,17 +40,26 @@ class Evaluator:
     ``last_runtime`` is the wall-clock of the latest call (forward + host
     fetch); ``last_included_compile`` flags calls whose padded shape had not
     been executed before, i.e. whose runtime contains an XLA compile — FPS
-    protocols should drop those samples.
+    protocols should drop those samples.  ``cache_stats`` aggregates the
+    same signal (compile-cache hits/misses over the Evaluator's lifetime),
+    and ``latency`` accumulates per-call runtimes in a fixed-bucket
+    histogram with p50/p90/p99 summaries.
     """
 
     def __init__(self, model, variables, iters: int = 32,
                  divis_by: int = 32, bucket_multiple: Optional[int] = None,
-                 mesh=None):
+                 batch_pad: Optional[int] = None, mesh=None):
         self.model = model
         self.variables = variables
         self.iters = iters
         self.divis_by = divis_by
         self.bucket_multiple = bucket_multiple
+        # Serving-parity mode: zero-pad the batch axis to this size so the
+        # pair executes at the serving engine's padded-batch program shape
+        # (serve/engine.py pads every batch to max_batch_size).  XLA tiles
+        # reductions differently per program shape, so only identical
+        # shapes guarantee bitwise-identical per-sample results.
+        self.batch_pad = batch_pad
         self._fn = model.jitted_infer(iters=iters)
         # Optional multi-chip spatial parallelism: shard image height over
         # the mesh's 'space' axis so ONE pair uses several chips' HBM/FLOPs
@@ -75,37 +87,44 @@ class Evaluator:
             # the mesh explicitly.
             self.variables = jax.device_put(self.variables, replicated(mesh))
         self.compiled_shapes: Set[Tuple[int, int]] = set()
+        self.cache_hits: int = 0
+        self.cache_misses: int = 0
+        self.latency = LatencyHistogram()
         self.last_runtime: float = float("nan")
         self.last_included_compile: bool = True
+
+    @property
+    def cache_stats(self) -> Dict[str, int]:
+        """Compile-cache counters: one miss per padded shape ever executed."""
+        return {"hits": self.cache_hits, "misses": self.cache_misses,
+                "shapes": len(self.compiled_shapes)}
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray) -> np.ndarray:
         if image1.ndim == 3:
             image1, image2 = image1[None], image2[None]
         assert image1.shape[0] == 1, (
             f"Evaluator is single-pair; got batch {image1.shape[0]}")
-        padder = InputPadder(image1.shape, divis_by=self.divis_by)
+        padder = BucketPadder(image1.shape, divis_by=self.divis_by,
+                              bucket_multiple=self.bucket_multiple)
         i1, i2 = padder.pad(jnp.asarray(image1), jnp.asarray(image2))
-        extra_h = extra_w = 0
-        if self.bucket_multiple:
-            m = self.bucket_multiple
-            ph, pw = i1.shape[1:3]
-            extra_h, extra_w = (-ph) % m, (-pw) % m
-            if extra_h or extra_w:
-                i1 = replicate_pad(i1, (0, extra_w, 0, extra_h))
-                i2 = replicate_pad(i2, (0, extra_w, 0, extra_h))
+        if self.batch_pad and self.batch_pad > 1:
+            rows = ((0, self.batch_pad - 1), (0, 0), (0, 0), (0, 0))
+            i1, i2 = jnp.pad(i1, rows), jnp.pad(i2, rows)
         if self._in_sharding is not None:
             i1 = jax.device_put(i1, self._in_sharding)
             i2 = jax.device_put(i2, self._in_sharding)
         shape = tuple(i1.shape[1:3])
         self.last_included_compile = shape not in self.compiled_shapes
+        if self.last_included_compile:
+            self.cache_misses += 1
+        else:
+            self.cache_hits += 1
         start = time.perf_counter()
         from ..parallel.context import use_corr_mesh
         with use_corr_mesh(self._mesh):  # lets Pallas backends shard_map
             _, flow_up = self._fn(self.variables, i1, i2)
         flow_up = np.asarray(flow_up, np.float32)  # host fetch = completion
         self.last_runtime = time.perf_counter() - start
+        self.latency.observe(self.last_runtime)
         self.compiled_shapes.add(shape)
-        if extra_h or extra_w:
-            flow_up = flow_up[:, :flow_up.shape[1] - extra_h,
-                              :flow_up.shape[2] - extra_w]
         return padder.unpad(flow_up)[0, ..., 0]

@@ -16,7 +16,7 @@ they replace so that converted checkpoints reproduce the same numerics:
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -196,6 +196,53 @@ class InputPadder:
         l, r, t, b = self._pad
         ht, wd = x.shape[1:3]
         return x[:, t:ht - b, l:wd - r, :]
+
+
+class BucketPadder:
+    """Single source of truth for the pad-and-bucket shape policy shared by
+    the eval runner (eval/runner.py) and the serving engine (serve/engine.py).
+
+    Two stages: ``InputPadder`` alignment to ``divis_by`` first (same split
+    policy as the reference), then an optional round-up of the padded shape
+    to the coarser ``bucket_multiple`` grid with edge-replicate rows/columns
+    on the bottom/right, so near-identical image sizes share one compiled
+    executable.  Callers that agree on (divis_by, bucket_multiple, mode)
+    produce bitwise-identical padded tensors — the property the serve layer's
+    batched outputs == single-image Evaluator outputs test rests on.
+
+    ``dims`` may be (H, W), (H, W, C) or (B, H, W, C).
+    """
+
+    def __init__(self, dims: Sequence[int], divis_by: int = 32,
+                 bucket_multiple: Optional[int] = None, mode: str = "sintel"):
+        if len(dims) == 3:
+            hw: Sequence[int] = dims[:2]
+        elif len(dims) == 4:
+            hw = dims[1:3]
+        else:
+            hw = dims
+        self._padder = InputPadder(hw, mode=mode, divis_by=divis_by)
+        ph, pw = self._padder.padded_hw
+        m = bucket_multiple or 1
+        self.extra_h = (-ph) % m
+        self.extra_w = (-pw) % m
+        self.bucket_hw: Tuple[int, int] = (ph + self.extra_h,
+                                           pw + self.extra_w)
+
+    def pad(self, *inputs: jax.Array):
+        out = self._padder.pad(*inputs)
+        if len(inputs) == 1:
+            out = [out]
+        if self.extra_h or self.extra_w:
+            out = [replicate_pad(x, (0, self.extra_w, 0, self.extra_h))
+                   for x in out]
+        return out if len(out) > 1 else out[0]
+
+    def unpad(self, x: jax.Array) -> jax.Array:
+        if self.extra_h or self.extra_w:
+            x = x[:, :x.shape[1] - self.extra_h,
+                  :x.shape[2] - self.extra_w, :]
+        return self._padder.unpad(x)
 
 
 def coords_grid_x(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:

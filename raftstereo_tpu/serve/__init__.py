@@ -1,0 +1,49 @@
+"""Dynamic-batching inference serving subsystem (docs/serving.md).
+
+Layers, bottom-up:
+
+* ``engine.BatchEngine``    — shape-bucketed, padded-batch compile cache
+                              around the test-mode forward, with startup
+                              warmup (shares ``ops/image.BucketPadder``
+                              with the Evaluator, bitwise).
+* ``batcher.DynamicBatcher``— deadline-aware micro-batching, bounded-queue
+                              admission control, per-request timeouts, and
+                              load-adaptive GRU-iteration degradation.
+* ``metrics``               — counters / gauges / latency histograms with
+                              Prometheus text exposition.
+* ``server.StereoServer``   — stdlib HTTP front-end: ``/predict``,
+                              ``/metrics``, ``/healthz``.
+* ``client``                — blocking client + closed/open-loop load
+                              generator.
+
+Entry point: ``python -m raftstereo_tpu.cli.serve``; smoke benchmark:
+``python bench.py --serve --quick``.
+"""
+
+from .batcher import (  # noqa: F401
+    DynamicBatcher,
+    Future,
+    Overloaded,
+    RequestTimedOut,
+    ServeResult,
+    ShuttingDown,
+)
+from .client import (  # noqa: F401
+    ServeClient,
+    ServeError,
+    run_load,
+    synthetic_pair_pool,
+)
+from .engine import BatchEngine  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    ServeMetrics,
+)
+from .server import (  # noqa: F401
+    StereoServer,
+    build_server,
+    decode_array,
+    encode_array,
+)

@@ -1,0 +1,211 @@
+"""Client + load generator for the serving endpoint (stdlib http.client).
+
+``ServeClient`` is a thin blocking client for one connection (keep-alive);
+``run_load`` drives closed- or open-loop traffic against a server and
+reports achieved throughput and latency percentiles:
+
+* closed loop — ``concurrency`` workers each keep exactly one request in
+  flight (classic saturation measurement: throughput at offered
+  concurrency);
+* open loop — requests fire on a fixed ``rate`` schedule regardless of
+  completions (arrival-process realism: queueing delay and shedding show up
+  instead of being absorbed by client backpressure).  The schedule is only
+  honored while a worker is free: size ``concurrency`` >= rate x expected
+  p99 latency, and check ``send_lag_p99_ms`` in the stats — when it grows,
+  the workers fell behind and the run degraded toward closed loop.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.profiling import LatencyHistogram
+from .server import decode_array, encode_array
+
+__all__ = ["ServeClient", "ServeError", "run_load", "synthetic_pair_pool"]
+
+
+def synthetic_pair_pool(height: int, width: int, n: int = 4, seed: int = 0):
+    """``make_pair`` callable over a pool of ``n`` pre-generated random
+    pairs — request cost stays in the server, not in host-side RNG.
+    Shared by ``cli.serve --loadgen`` and ``bench.py --serve`` so the two
+    load paths drive identical synthetic traffic."""
+    rng = np.random.default_rng(seed)
+    pool = [(rng.integers(0, 255, (height, width, 3)).astype(np.float32),
+             rng.integers(0, 255, (height, width, 3)).astype(np.float32))
+            for _ in range(max(n, 1))]
+    return lambda i: pool[i % len(pool)]
+
+
+class ServeError(RuntimeError):
+    """Non-200 reply; ``status`` and the decoded error payload attached."""
+
+    def __init__(self, status: int, payload: Dict):
+        super().__init__(f"HTTP {status}: {payload.get('error', payload)}")
+        self.status = status
+        self.payload = payload
+
+
+class ServeClient:
+    """Blocking client over one keep-alive connection (not thread-safe —
+    load-gen workers each own one)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self._conn = http.client.HTTPConnection(host, port, timeout=timeout)
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def _request(self, method: str, path: str,
+                 body: Optional[bytes] = None) -> Tuple[int, bytes]:
+        headers = {"Content-Type": "application/json"} if body else {}
+        try:
+            self._conn.request(method, path, body=body, headers=headers)
+        except (http.client.HTTPException, ConnectionError, OSError):
+            # Send-side failure (typically a stale keep-alive the server
+            # closed while idle): the request never reached the server, so
+            # one reconnect + resend is safe even for POST.
+            self._conn.close()
+            self._conn.request(method, path, body=body, headers=headers)
+        try:
+            resp = self._conn.getresponse()
+            return resp.status, resp.read()
+        except socket.timeout:
+            # Never resend on a response timeout — for /predict the server
+            # may still be computing; a retry would run inference twice
+            # and silently double the effective client timeout.
+            self._conn.close()
+            raise
+        except (http.client.HTTPException, ConnectionError, OSError):
+            if method != "GET":
+                self._conn.close()
+                raise  # non-idempotent: the server may have processed it
+            self._conn.close()
+            self._conn.request(method, path, body=body, headers=headers)
+            resp = self._conn.getresponse()
+            return resp.status, resp.read()
+
+    def predict(self, left: np.ndarray, right: np.ndarray,
+                iters: Optional[int] = None
+                ) -> Tuple[np.ndarray, Dict]:
+        """One stereo pair -> ((H, W) disparity, meta dict).
+
+        Raises ``ServeError`` on any non-200 status (503 = shed / 504 =
+        timeout are expected under overload; callers count them).
+        """
+        payload = {"left": encode_array(np.asarray(left, np.float32)),
+                   "right": encode_array(np.asarray(right, np.float32))}
+        if iters is not None:
+            payload["iters"] = int(iters)
+        status, body = self._request("POST", "/predict",
+                                     json.dumps(payload).encode())
+        data = json.loads(body)
+        if status != 200:
+            raise ServeError(status, data)
+        return decode_array(data["disparity"]), data["meta"]
+
+    def healthz(self) -> Dict:
+        status, body = self._request("GET", "/healthz")
+        if status != 200:
+            raise ServeError(status, json.loads(body))
+        return json.loads(body)
+
+    def metrics_text(self) -> str:
+        status, body = self._request("GET", "/metrics")
+        if status != 200:
+            raise ServeError(status, json.loads(body))
+        return body.decode()
+
+
+def run_load(host: str, port: int,
+             make_pair: Callable[[int], Tuple[np.ndarray, np.ndarray]],
+             requests: int = 64, concurrency: int = 4,
+             mode: str = "closed", rate: Optional[float] = None,
+             iters: Optional[int] = None,
+             timeout: float = 120.0) -> Dict:
+    """Drive ``requests`` pairs at the server; returns a stats dict.
+
+    ``make_pair(i)`` supplies the i-th request's images (mix shapes to
+    exercise several compile buckets).  ``mode='open'`` requires ``rate``
+    (requests/sec): send times are fixed at ``i / rate`` from start,
+    regardless of completions.
+    """
+    assert mode in ("closed", "open"), mode
+    if mode == "open" and not rate:
+        raise ValueError("open-loop load needs a rate (requests/sec)")
+    lat = LatencyHistogram()
+    send_lag = LatencyHistogram()  # open loop: scheduled vs actual send
+    counts = {"ok": 0, "shed": 0, "timeout": 0, "error": 0}
+    lock = threading.Lock()
+    next_idx = [0]
+    t_start = time.perf_counter()
+
+    def worker():
+        client = ServeClient(host, port, timeout=timeout)
+        try:
+            while True:
+                with lock:
+                    i = next_idx[0]
+                    if i >= requests:
+                        return
+                    next_idx[0] += 1
+                if mode == "open":
+                    delay = t_start + i / rate - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    else:
+                        send_lag.observe(-delay)
+                left, right = make_pair(i)
+                t0 = time.perf_counter()
+                try:
+                    client.predict(left, right, iters=iters)
+                except ServeError as e:
+                    kind = {503: "shed", 504: "timeout"}.get(e.status,
+                                                             "error")
+                    with lock:
+                        counts[kind] += 1
+                except Exception:
+                    with lock:
+                        counts["error"] += 1
+                else:
+                    lat.observe(time.perf_counter() - t0)
+                    with lock:
+                        counts["ok"] += 1
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"loadgen-{i}")
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    stats = {
+        "mode": mode, "requests": requests, "concurrency": concurrency,
+        "wall_s": round(wall, 3),
+        "pairs_per_sec": round(counts["ok"] / wall, 4) if wall else 0.0,
+        **counts,
+    }
+    if rate:
+        stats["offered_rate"] = rate
+        # How far behind schedule sends fell (0 observations = on time):
+        # large values mean concurrency was too low for the offered rate
+        # and the run degraded toward closed-loop.
+        stats["late_sends"] = send_lag.count
+        stats["send_lag_p99_ms"] = (round(send_lag.percentile(99) * 1e3, 2)
+                                    if send_lag.count else 0.0)
+    if lat.count:
+        s = lat.summary()
+        stats.update(p50_ms=round(s["p50"] * 1e3, 2),
+                     p90_ms=round(s["p90"] * 1e3, 2),
+                     p99_ms=round(s["p99"] * 1e3, 2))
+    return stats

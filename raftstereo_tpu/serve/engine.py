@@ -1,0 +1,170 @@
+"""Shape-bucketed, padded-batch compiled inference engine.
+
+The serving analogue of ``eval/runner.Evaluator``: one compiled executable
+per (shape bucket, GRU iterations), reused across requests.  Three shape
+decisions keep the XLA compile count small and predictable:
+
+* every image is padded with the SAME ``BucketPadder`` policy the Evaluator
+  uses (divis_by alignment, then round-up to ``bucket_multiple``), so
+  near-identical sizes share a bucket — and per-sample numerics match the
+  single-image Evaluator bitwise;
+* every dispatched batch is zero-padded along the batch axis to
+  ``max_batch_size``, so a bucket compiles exactly once regardless of how
+  many requests the micro-batcher coalesced (padding rows are dead weight
+  on the MXU but convs/norms are per-sample, so real samples are
+  unaffected);
+* configured buckets are compiled eagerly at startup (``warmup``), so the
+  first real request never pays the multi-second XLA compile.
+
+The engine is deliberately synchronous and lock-serialized: ordering and
+batching policy live in the batcher; this layer owns shapes, compiles and
+device dispatch only.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ServeConfig
+from ..ops.image import BucketPadder
+from .metrics import ServeMetrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["BatchEngine"]
+
+
+class BatchEngine:
+    """Batched test-mode forward behind a shape-bucketed compile cache."""
+
+    def __init__(self, model, variables, config: ServeConfig,
+                 metrics: Optional[ServeMetrics] = None):
+        self.model = model
+        self.variables = variables
+        self.cfg = config
+        self.metrics = metrics
+        self._fns: Dict[int, object] = {}  # iters -> jitted forward
+        self._compiled: Set[Tuple[int, int, int]] = set()  # (h, w, iters)
+        self._lock = threading.RLock()
+        # Fine-grained lock for _compiled only: stat readers (/healthz)
+        # must not block behind _lock, which is held across a whole device
+        # dispatch (seconds) or compile (minutes).
+        self._stats_lock = threading.Lock()
+        self.last_batch_runtime: float = float("nan")
+        self.last_included_compile: bool = True
+
+    # ----------------------------------------------------------- shape policy
+
+    def _padder(self, shape: Sequence[int]) -> BucketPadder:
+        return BucketPadder(shape, divis_by=self.cfg.divis_by,
+                            bucket_multiple=self.cfg.bucket_multiple)
+
+    def bucket_of(self, shape: Sequence[int]) -> Tuple[int, int]:
+        """The padded (H, W) an image of ``shape`` executes at."""
+        return self._padder(shape).bucket_hw
+
+    @property
+    def cache_stats(self) -> Dict[str, int]:
+        with self._stats_lock:  # vs a concurrent add() resizing the set
+            return {"compiled": len(self._compiled)}
+
+    @property
+    def compiled_keys(self) -> Set[Tuple[int, int, int]]:
+        with self._stats_lock:
+            return set(self._compiled)
+
+    def is_warm(self, hw: Tuple[int, int], iters: int) -> bool:
+        """Whether (bucket, iters) already has a compiled executable."""
+        with self._stats_lock:
+            return (hw[0], hw[1], iters) in self._compiled
+
+    # -------------------------------------------------------------- execution
+
+    def _fn(self, iters: int):
+        if iters not in self._fns:
+            self._fns[iters] = jax.jit(
+                lambda v, a, b, it=iters: self.model.forward(
+                    v, a, b, iters=it, test_mode=True))
+        return self._fns[iters]
+
+    def warmup(self, buckets=None, iters_list=None) -> List[Tuple[int, int,
+                                                                  int]]:
+        """Compile the configured buckets before serving traffic.
+
+        Covers both iteration levels (normal + degraded) so flipping into
+        graceful degradation under load never stalls the queue behind a
+        compile — exactly the moment a compile is least affordable.
+        Returns the (h, w, iters) keys warmed.
+        """
+        buckets = list(buckets or self.cfg.buckets)
+        iters_list = list(iters_list
+                          or {self.cfg.iters, self.cfg.degraded_iters})
+        warmed = []
+        for h, w in buckets:
+            bh, bw = self.bucket_of((h, w, 3))
+            for iters in iters_list:
+                key = (bh, bw, iters)
+                if key in self._compiled:
+                    continue
+                zero = np.zeros((h, w, 3), np.float32)
+                t0 = time.perf_counter()
+                self.infer_batch([(zero, zero)], iters)
+                logger.info("warmup: bucket %dx%d iters=%d compiled in %.1fs",
+                            bh, bw, iters, time.perf_counter() - t0)
+                warmed.append(key)
+        return warmed
+
+    def infer_batch(self, pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
+                    iters: int) -> List[np.ndarray]:
+        """Run a coalesced batch; returns one (H, W) disparity per pair.
+
+        All pairs must map to the same shape bucket (the batcher groups by
+        bucket before dispatching).  The batch axis is zero-padded to
+        ``max_batch_size`` so the compile cache is keyed by bucket alone.
+        """
+        assert pairs, "empty batch"
+        assert len(pairs) <= self.cfg.max_batch_size, (
+            f"batch {len(pairs)} exceeds max_batch_size "
+            f"{self.cfg.max_batch_size}")
+        padders = [self._padder(p[0].shape) for p in pairs]
+        hw = padders[0].bucket_hw
+        assert all(p.bucket_hw == hw for p in padders), (
+            "mixed buckets in one batch: "
+            f"{sorted({p.bucket_hw for p in padders})}")
+        lefts, rights = [], []
+        for (im1, im2), padder in zip(pairs, padders):
+            i1, i2 = padder.pad(jnp.asarray(im1, jnp.float32)[None],
+                                jnp.asarray(im2, jnp.float32)[None])
+            lefts.append(i1)
+            rights.append(i2)
+        pad_rows = self.cfg.max_batch_size - len(pairs)
+        i1 = jnp.concatenate(lefts, axis=0)
+        i2 = jnp.concatenate(rights, axis=0)
+        if pad_rows:
+            i1 = jnp.pad(i1, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+            i2 = jnp.pad(i2, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+        key = (hw[0], hw[1], iters)
+        with self._lock:
+            with self._stats_lock:
+                miss = key not in self._compiled
+            if self.metrics is not None:
+                (self.metrics.compile_misses if miss
+                 else self.metrics.compile_hits).inc()
+            start = time.perf_counter()
+            _, flow_up = self._fn(iters)(self.variables, i1, i2)
+            flow_up = np.asarray(flow_up, np.float32)  # host fetch = done
+            self.last_batch_runtime = time.perf_counter() - start
+            self.last_included_compile = miss
+            with self._stats_lock:
+                self._compiled.add(key)
+        if self.metrics is not None and not miss:
+            self.metrics.batch_latency.observe(self.last_batch_runtime)
+        return [padder.unpad(flow_up[i:i + 1])[0, ..., 0]
+                for i, padder in enumerate(padders)]

@@ -1,0 +1,156 @@
+"""Serving observability: a small metrics registry with Prometheus text
+exposition (no client library dependency — the format is plain text).
+
+Three instrument kinds: monotonically increasing ``Counter``, last-value
+``Gauge`` and the fixed-bucket ``LatencyHistogram`` from utils/profiling.py
+(shared with the Evaluator's per-call timing).  ``MetricsRegistry.render``
+emits the text format Prometheus scrapes from ``GET /metrics``:
+
+    # HELP serve_requests_total ...
+    # TYPE serve_requests_total counter
+    serve_requests_total 42
+    serve_request_latency_seconds_bucket{le="0.1"} 17
+    ...
+
+``ServeMetrics`` bundles every instrument the serving subsystem records, so
+the engine, batcher and HTTP layer share one object and ``/metrics`` is one
+render call.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import List, Optional, Tuple
+
+from ..utils.profiling import LatencyHistogram
+
+__all__ = ["Counter", "Gauge", "MetricsRegistry", "ServeMetrics"]
+
+
+class Counter:
+    """Monotonic counter (Prometheus ``counter``)."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-value instrument (Prometheus ``gauge``)."""
+
+    def __init__(self):
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return format(v, ".9g")
+
+
+class MetricsRegistry:
+    """Ordered name -> instrument registry with Prometheus text rendering."""
+
+    def __init__(self):
+        self._entries: List[Tuple[str, str, str, object]] = []
+        self._lock = threading.Lock()
+
+    def _register(self, kind: str, name: str, help_: str, obj):
+        with self._lock:
+            if any(e[1] == name for e in self._entries):
+                raise ValueError(f"metric {name!r} already registered")
+            self._entries.append((kind, name, help_, obj))
+        return obj
+
+    def counter(self, name: str, help_: str) -> Counter:
+        return self._register("counter", name, help_, Counter())
+
+    def gauge(self, name: str, help_: str) -> Gauge:
+        return self._register("gauge", name, help_, Gauge())
+
+    def histogram(self, name: str, help_: str,
+                  bounds=None, lo: float = 1e-4,
+                  hi: float = 60.0) -> LatencyHistogram:
+        return self._register("histogram", name, help_,
+                              LatencyHistogram(bounds=bounds, lo=lo, hi=hi))
+
+    def render(self) -> str:
+        """Prometheus text exposition format, version 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            entries = list(self._entries)
+        for kind, name, help_, obj in entries:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            if kind == "histogram":
+                # One atomic snapshot: _count must equal the +Inf bucket.
+                pairs, count, total = obj.prometheus()
+                for bound, cum in pairs:
+                    lines.append(
+                        f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+                lines.append(f"{name}_sum {format(total, '.9g')}")
+                lines.append(f"{name}_count {count}")
+            else:
+                lines.append(f"{name} {_fmt(obj.value)}")
+        return "\n".join(lines) + "\n"
+
+
+class ServeMetrics:
+    """Every instrument the serving subsystem records, in one bundle."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self.requests = r.counter(
+            "serve_requests_total", "requests submitted to the batcher")
+        self.responses = r.counter(
+            "serve_responses_total", "requests answered successfully")
+        self.shed = r.counter(
+            "serve_shed_total",
+            "requests rejected at admission because the queue was full")
+        self.timeouts = r.counter(
+            "serve_timeout_total",
+            "requests that exceeded request_timeout_ms while queued")
+        self.errors = r.counter(
+            "serve_errors_total", "requests failed by an engine error")
+        self.degraded_batches = r.counter(
+            "serve_degraded_batches_total",
+            "batches run at degraded_iters due to queue backlog")
+        self.compile_hits = r.counter(
+            "serve_compile_cache_hits_total",
+            "batches dispatched to an already-compiled executable")
+        self.compile_misses = r.counter(
+            "serve_compile_cache_misses_total",
+            "batches whose (bucket, iters) shape triggered an XLA compile")
+        self.queue_depth = r.gauge(
+            "serve_queue_depth", "requests currently waiting in the queue")
+        self.batch_size = r.histogram(
+            "serve_batch_size", "real (un-padded) requests per batch",
+            bounds=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64))
+        self.latency = r.histogram(
+            "serve_request_latency_seconds",
+            "submit-to-result latency per request (queue wait + compute)")
+        self.batch_latency = r.histogram(
+            "serve_batch_latency_seconds",
+            "engine wall-clock per dispatched batch (forward + host fetch)")
+
+    def render(self) -> str:
+        return self.registry.render()

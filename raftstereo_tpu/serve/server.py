@@ -1,0 +1,259 @@
+"""HTTP front-end for the serving subsystem (stdlib ``http.server`` only).
+
+Endpoints:
+
+* ``POST /predict`` — body ``{"left": <array>, "right": <array>,
+  "iters": optional int}``; an ``<array>`` is either a nested JSON list or
+  the compact form ``{"shape": [H, W, 3], "dtype": "float32",
+  "data_b64": "..."}`` (raw little-endian bytes, base64).  ``iters`` must
+  be one of the server's configured levels (``iters`` /
+  ``degraded_iters`` — those executables are warmed; arbitrary values
+  would compile under load).  Replies 200 with ``{"disparity": <array>,
+  "meta": {...}}``, 503 ``overloaded`` when admission control sheds, 504
+  on a per-request timeout, 400 on a malformed body.
+* ``GET /metrics`` — Prometheus text exposition (serve/metrics.py).
+* ``GET /healthz`` — JSON liveness: queue depth, compiled buckets, config.
+
+``ThreadingHTTPServer`` gives one thread per connection; they all funnel
+into the single ``DynamicBatcher`` queue, which is where concurrency is
+actually managed (admission control + micro-batching), so the HTTP layer
+stays dumb on purpose.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from ..config import ServeConfig
+from .batcher import DynamicBatcher, Overloaded, RequestTimedOut, ShuttingDown
+from .engine import BatchEngine
+from .metrics import ServeMetrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StereoServer", "build_server", "decode_array", "encode_array"]
+
+
+def encode_array(a: np.ndarray) -> Dict:
+    """Compact JSON-safe array encoding (raw bytes, base64)."""
+    a = np.ascontiguousarray(a)
+    return {"shape": list(a.shape), "dtype": str(a.dtype),
+            "data_b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def decode_array(obj: Union[Dict, list]) -> np.ndarray:
+    """Inverse of ``encode_array``; nested JSON lists also accepted."""
+    if isinstance(obj, list):
+        return np.asarray(obj, np.float32)
+    a = np.frombuffer(base64.b64decode(obj["data_b64"]),
+                      dtype=np.dtype(obj["dtype"]))
+    return a.reshape(obj["shape"]).astype(np.float32, copy=False)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "raftstereo-serve/1.0"
+    protocol_version = "HTTP/1.1"  # keep-alive: load-gen reuses connections
+
+    # -------------------------------------------------------------- plumbing
+    def log_message(self, fmt, *args):  # route chatter to logging, not stderr
+        logger.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              extra_headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, obj,
+              extra_headers: Optional[Dict[str, str]] = None) -> None:
+        self._send(code, json.dumps(obj).encode(),
+                   "application/json", extra_headers)
+
+    # ------------------------------------------------------------- endpoints
+    def do_GET(self):
+        srv: "StereoServer" = self.server
+        if self.path == "/healthz":
+            self._json(200, {
+                "status": "ok",
+                "queue_depth": srv.batcher.queue_depth,
+                "compiled_buckets": sorted(srv.engine.compiled_keys),
+                "max_batch_size": srv.config.max_batch_size,
+                "iters": srv.config.iters,
+            })
+        elif self.path == "/metrics":
+            self._send(200, srv.metrics.render().encode(),
+                       "text/plain; version=0.0.4")
+        else:
+            self._json(404, {"error": f"no such path {self.path!r}"})
+
+    def do_POST(self):
+        srv: "StereoServer" = self.server
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            length = -1
+        if length < 0 or length > srv.config.max_body_mb * 2 ** 20:
+            # Refuse before buffering: close instead of draining an
+            # arbitrarily large (or unparseable) body.
+            self.close_connection = True
+            self._json(413, {"error": "body too large or bad "
+                                      "Content-Length",
+                             "limit_mb": srv.config.max_body_mb})
+            return
+        # Bound CONCURRENT buffering, not just per-request size: each
+        # in-flight decode transiently holds body + base64 text + decoded
+        # arrays (~3x the body).  Without this, a handful of parallel
+        # near-limit POSTs OOM the host before queue_limit ever engages.
+        with srv.decode_slots:
+            # Drain the body BEFORE any reply: under HTTP/1.1 keep-alive,
+            # unread body bytes would be parsed as the next request line.
+            raw = self.rfile.read(length) if length else b""
+            if self.path != "/predict":
+                self._json(404, {"error": f"no such path {self.path!r}"})
+                return
+            try:
+                payload = json.loads(raw)
+                left = decode_array(payload["left"])
+                right = decode_array(payload["right"])
+                iters = payload.get("iters")
+            except Exception as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            del raw, payload
+        try:
+            if left.ndim != 3 or left.shape[-1] != 3 \
+                    or left.shape != right.shape:
+                raise ValueError(
+                    f"expected matching (H, W, 3) pairs, got "
+                    f"{left.shape} / {right.shape}")
+            if max(left.shape[:2]) > srv.config.max_image_dim:
+                raise ValueError(
+                    f"image side {max(left.shape[:2])} exceeds "
+                    f"max_image_dim {srv.config.max_image_dim}")
+            if iters is not None:
+                # Only the configured (warmed) iteration levels: arbitrary
+                # client values would each compile a fresh executable under
+                # the engine lock — a trivially triggered latency DoS.
+                iters = int(iters)
+                allowed = {srv.config.iters, srv.config.degraded_iters}
+                if iters not in allowed:
+                    raise ValueError(
+                        f"iters {iters} not served; choose from "
+                        f"{sorted(allowed)}")
+            if not srv.config.cold_buckets:
+                # Production setting: shapes outside the warmed buckets
+                # are rejected up front — an on-demand compile would stall
+                # every queued request behind it.
+                hw = srv.engine.bucket_of(left.shape)
+                want = iters if iters is not None else srv.config.iters
+                if not srv.engine.is_warm(hw, want):
+                    raise ValueError(
+                        f"shape {tuple(left.shape[:2])} -> bucket {hw} "
+                        f"(iters {want}) not warmed; configure it in "
+                        f"--buckets")
+        except Exception as e:
+            self._json(400, {"error": f"bad request: {e}"})
+            return
+        # Size the HTTP-side wait for what can actually be ahead of this
+        # request: one in-flight batch (60 s) — or a cold XLA compile,
+        # which takes minutes; with the 60 s slack a cold-bucket request
+        # would get a spurious 503 while the server finishes the compile
+        # and discards the result.
+        hw = srv.engine.bucket_of(left.shape)
+        levels = ([iters] if iters is not None
+                  else [srv.config.iters, srv.config.degraded_iters])
+        warm = all(srv.engine.is_warm(hw, lv) for lv in levels)
+        slack = 60.0 if warm else 600.0
+        try:
+            fut = srv.batcher.submit(left, right, iters)
+        except Overloaded as e:
+            self._json(503, {"error": "overloaded", "detail": str(e)},
+                       {"Retry-After": "1"})
+            return
+        except ShuttingDown:
+            self._json(503, {"error": "shutting down"})
+            return
+        try:
+            # The batcher enforces request_timeout_ms at dispatch; the
+            # slack covers whatever can run ahead (batch or cold compile).
+            res = fut.result(
+                timeout=srv.config.request_timeout_ms / 1000.0 + slack)
+        except RequestTimedOut as e:
+            self._json(504, {"error": "timeout", "detail": str(e)})
+            return
+        except (TimeoutError, ShuttingDown) as e:
+            self._json(503, {"error": "unavailable", "detail": str(e)})
+            return
+        except Exception as e:
+            self._json(500, {"error": f"inference failed: {e}"})
+            return
+        self._json(200, {
+            "disparity": encode_array(res.disparity),
+            "meta": {"iters": res.iters, "degraded": res.degraded,
+                     "batch_size": res.batch_size,
+                     "latency_ms": round(res.latency_s * 1e3, 3)},
+        })
+
+
+class StereoServer(ThreadingHTTPServer):
+    """HTTP server owning the engine + batcher + metrics triple.
+
+    ``config.port == 0`` binds an ephemeral port; read the real one from
+    ``server.server_address[1]`` (tests and ``bench.py --serve`` do).
+    """
+
+    daemon_threads = True
+
+    def __init__(self, config: ServeConfig, engine: BatchEngine,
+                 batcher: DynamicBatcher, metrics: ServeMetrics):
+        self.config = config
+        self.engine = engine
+        self.batcher = batcher
+        self.metrics = metrics
+        # Caps the number of request bodies being buffered/decoded at
+        # once (each transiently costs ~3x its size); excess connections
+        # queue on the semaphore instead of multiplying host RSS.
+        self.decode_slots = threading.BoundedSemaphore(
+            max(4, config.max_batch_size))
+        super().__init__((config.host, config.port), _Handler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def close(self) -> None:
+        """Stop accepting, drain the queue, release the socket."""
+        self.shutdown()
+        self.server_close()
+        self.batcher.stop(drain=True)
+
+
+def build_server(model, variables, config: ServeConfig,
+                 metrics: Optional[ServeMetrics] = None) -> StereoServer:
+    """Wire engine + batcher + HTTP server; warm configured buckets.
+
+    The caller drives ``server.serve_forever()`` (blocking) or a thread, and
+    ``server.close()`` on the way out.
+    """
+    metrics = metrics or ServeMetrics()
+    engine = BatchEngine(model, variables, config, metrics)
+    if config.warmup:
+        engine.warmup()
+    batcher = DynamicBatcher(engine, config, metrics).start()
+    server = StereoServer(config, engine, batcher, metrics)
+    logger.info("serving on %s:%d (buckets=%s, max_batch=%d, iters=%d/%d)",
+                config.host, server.port,
+                sorted(engine.compiled_keys) or "lazy",
+                config.max_batch_size, config.iters, config.degraded_iters)
+    return server

@@ -12,14 +12,18 @@ overkill.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import logging
+import math
+import threading
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["trace", "step_annotation", "StepProfiler", "Timer"]
+__all__ = ["trace", "step_annotation", "StepProfiler", "Timer",
+           "LatencyHistogram"]
 
 
 @contextlib.contextmanager
@@ -105,6 +109,131 @@ class StepProfiler:
 
             jax.profiler.stop_trace()
             self._active = False
+
+
+def _log_spaced_bounds(lo: float, hi: float,
+                       per_decade: int) -> Tuple[float, ...]:
+    """Ascending bucket upper bounds, ``per_decade`` per factor of 10."""
+    n = int(math.ceil(math.log10(hi / lo) * per_decade)) + 1
+    return tuple(lo * 10 ** (i / per_decade) for i in range(n))
+
+
+class LatencyHistogram:
+    """Fixed-bucket histogram with percentile summaries, O(1) per observation.
+
+    Default buckets are log-spaced (5 per decade) from 100 us to 60 s — wide
+    enough for a compiled TPU forward on one end and a compile-included
+    first request on the other.  Pass explicit ``bounds`` for non-latency
+    quantities (e.g. batch sizes).  Thread-safe: the serve layer observes
+    from the batcher worker while the HTTP threads render ``/metrics``.
+
+    Percentiles are estimated by linear interpolation inside the containing
+    bucket (clamped to the observed min/max), the standard fixed-bucket
+    estimate Prometheus applies server-side — exact at bucket edges, off by
+    at most one bucket width inside.
+    """
+
+    def __init__(self, bounds: Optional[Sequence[float]] = None,
+                 lo: float = 1e-4, hi: float = 60.0, per_decade: int = 5):
+        self.bounds: Tuple[float, ...] = (
+            tuple(sorted(bounds)) if bounds is not None
+            else _log_spaced_bounds(lo, hi, per_decade))
+        # One count per bound plus the +Inf overflow bucket.
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += value
+            self._min = min(self._min, value)
+            self._max = max(self._max, value)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total(self) -> float:
+        return self._sum
+
+    def _snapshot(self):
+        """Counts/count/sum/min/max from ONE lock acquisition — derived
+        views (percentiles, Prometheus series) must all come from the same
+        snapshot or a concurrent observe() makes them mutually
+        inconsistent (e.g. a +Inf bucket that disagrees with _count)."""
+        with self._lock:
+            return (list(self._counts), self._count, self._sum,
+                    self._min, self._max)
+
+    def _percentile_from(self, counts, n, vmin, vmax, q: float) -> float:
+        if not n:
+            return float("nan")
+        rank = q / 100.0 * n
+        cum = 0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if cum + c >= rank:
+                lower = self.bounds[i - 1] if i > 0 else vmin
+                upper = self.bounds[i] if i < len(self.bounds) else vmax
+                frac = (rank - cum) / c
+                v = lower + frac * (upper - lower)
+                return min(max(v, vmin), vmax)
+            cum += c
+        return vmax
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-th percentile (q in [0, 100]); NaN when empty."""
+        counts, n, _, vmin, vmax = self._snapshot()
+        return self._percentile_from(counts, n, vmin, vmax, q)
+
+    def summary(self) -> Dict[str, float]:
+        counts, n, total, vmin, vmax = self._snapshot()
+        if not n:
+            return {"count": 0}
+        pct = lambda q: self._percentile_from(counts, n, vmin, vmax, q)  # noqa: E731
+        return {
+            "count": n,
+            "total": total,
+            "mean": total / n,
+            "min": vmin,
+            "max": vmax,
+            "p50": pct(50),
+            "p90": pct(90),
+            "p99": pct(99),
+        }
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """(upper_bound, cumulative_count) pairs ending with (+inf, count) —
+        the Prometheus ``_bucket{le=...}`` series.  See ``prometheus``
+        for the series together with its consistent sum/count."""
+        return self.prometheus()[0]
+
+    def prometheus(self):
+        """(bucket_pairs, count, sum) from one atomic snapshot, so the
+        rendered ``_count`` always equals the ``le="+Inf"`` bucket."""
+        counts, n, total, _, _ = self._snapshot()
+        out, cum = [], 0
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            out.append((b, cum))
+        out.append((math.inf, n))
+        return out, n, total
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf
 
 
 class Timer:

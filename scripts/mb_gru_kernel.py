@@ -51,6 +51,9 @@ def main():
     import numpy as np
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    # pre-0.4.34 jax names CompilerParams TPUCompilerParams.
+    CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
 
     H, W, CIN, COUT, R = args.h, args.w, args.cin, args.cout, args.rows
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
@@ -176,7 +179,7 @@ def main():
             ],
             out_specs=pl.BlockSpec((R, W, COUT), lambda i: (i, 0, 0),
                                    memory_space=pltpu.VMEM),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
         )(xx, halo, w9_)
 
@@ -197,7 +200,7 @@ def main():
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
                       pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
         )(xp, w9_)
 

@@ -158,6 +158,11 @@ class TestEndToEnd:
         # both pad+bucket to the same 128x128 compile
         assert ev.compiled_shapes == {(128, 128)}
         assert not ev.last_included_compile
+        # Compile-cache stats + latency histogram (shared instruments with
+        # the serving engine, serve/engine.py).
+        assert ev.cache_stats == {"hits": 1, "misses": 1, "shapes": 1}
+        assert ev.latency.count == 2
+        assert ev.latency.summary()["max"] >= ev.latency.summary()["min"] > 0
 
 
 def test_evaluator_spatial_mesh_matches_single_device(tiny_model, rng):

@@ -106,6 +106,49 @@ class TestInputPadder:
         np.testing.assert_array_equal(np.asarray(y)[:, 37:], 1.0)
 
 
+class TestBucketPadder:
+    """Shared pad+bucket policy (eval runner + serve engine)."""
+
+    def test_bucket_round_up_and_roundtrip(self, rng):
+        from raftstereo_tpu.ops.image import BucketPadder
+
+        x = jnp.asarray(rng.standard_normal((1, 70, 100, 3))
+                        .astype(np.float32))
+        p = BucketPadder(x.shape, divis_by=32, bucket_multiple=64)
+        assert p.bucket_hw == (128, 128)  # 70->96->128, 100->128
+        y = p.pad(x)
+        assert y.shape == (1, 128, 128, 3)
+        np.testing.assert_array_equal(p.unpad(np.asarray(y)), x)
+
+    def test_without_bucket_equals_input_padder(self, rng):
+        from raftstereo_tpu.ops.image import BucketPadder
+
+        x = jnp.asarray(rng.standard_normal((1, 37, 50, 3))
+                        .astype(np.float32))
+        a = BucketPadder(x.shape, divis_by=32).pad(x)
+        b = InputPadder(x.shape, divis_by=32).pad(x)
+        np.testing.assert_array_equal(a, b)
+
+    def test_accepts_3d_and_2d_dims(self):
+        from raftstereo_tpu.ops.image import BucketPadder
+
+        assert BucketPadder((60, 90, 3), divis_by=32).bucket_hw == (64, 96)
+        assert BucketPadder((60, 90), divis_by=32).bucket_hw == (64, 96)
+        assert BucketPadder((1, 60, 90, 3), divis_by=32,
+                            bucket_multiple=128).bucket_hw == (128, 128)
+
+    def test_pad_pair(self, rng):
+        from raftstereo_tpu.ops.image import BucketPadder
+
+        x = jnp.asarray(rng.standard_normal((1, 60, 90, 3))
+                        .astype(np.float32))
+        p = BucketPadder(x.shape, divis_by=32, bucket_multiple=64)
+        a, b = p.pad(x, x * 2)
+        assert a.shape == b.shape == (1, 64, 128, 3)
+        np.testing.assert_array_equal(p.unpad(np.asarray(b)),
+                                      np.asarray(x * 2))
+
+
 class TestConvexUpsample:
     def test_patches_order(self):
         x = jnp.arange(9.0).reshape(1, 3, 3, 1)

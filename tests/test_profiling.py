@@ -6,8 +6,10 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
-from raftstereo_tpu.utils.profiling import StepProfiler, Timer, trace
+from raftstereo_tpu.utils.profiling import (LatencyHistogram, StepProfiler,
+                                            Timer, trace)
 
 
 def _work():
@@ -72,6 +74,59 @@ class TestStepProfiler:
         assert prof._active
         prof.close()
         assert not prof._active
+
+
+class TestLatencyHistogram:
+    def test_empty(self):
+        h = LatencyHistogram()
+        assert h.count == 0
+        assert h.summary() == {"count": 0}
+        assert np.isnan(h.percentile(50))
+
+    def test_percentiles_on_uniform_data(self):
+        h = LatencyHistogram(lo=1e-3, hi=10.0)
+        for v in np.linspace(0.001, 1.0, 1000):
+            h.observe(float(v))
+        s = h.summary()
+        assert s["count"] == 1000
+        assert s["mean"] == pytest.approx(0.5005, rel=1e-3)
+        # Log-spaced buckets: estimates are bucket-resolution accurate.
+        assert s["p50"] == pytest.approx(0.5, rel=0.3)
+        assert s["p99"] == pytest.approx(0.99, rel=0.3)
+        assert s["p50"] < s["p90"] <= s["p99"] <= s["max"] == 1.0
+
+    def test_explicit_bounds_and_le_semantics(self):
+        h = LatencyHistogram(bounds=(1, 2, 4, 8))
+        for v in (1, 1, 2, 3, 5, 100):
+            h.observe(v)
+        cum = dict(h.cumulative())
+        assert cum[1] == 2      # le="1" counts values <= 1
+        assert cum[2] == 3
+        assert cum[4] == 4
+        assert cum[8] == 5
+        assert cum[float("inf")] == 6  # overflow lands in +Inf only
+        assert h.total == 112
+
+    def test_reset(self):
+        h = LatencyHistogram()
+        h.observe(0.5)
+        h.reset()
+        assert h.count == 0 and h.summary() == {"count": 0}
+
+    def test_thread_safety_totals(self):
+        import threading
+
+        h = LatencyHistogram(bounds=(0.5,))
+        def hammer():
+            for _ in range(1000):
+                h.observe(0.1)
+        ts = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert h.count == 4000
+        assert dict(h.cumulative())[0.5] == 4000
 
 
 class TestTimer:

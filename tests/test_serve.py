@@ -1,0 +1,465 @@
+"""Serving subsystem (raftstereo_tpu/serve, docs/serving.md).
+
+Batcher policy tests run against a stub engine (no model cost) so timing
+assertions stay tight; engine and end-to-end tests use a tiny real model.
+The end-to-end test is the subsystem's acceptance gate: concurrent
+mixed-shape requests over real HTTP, one compile per bucket, responses
+bitwise-equal to the single-image Evaluator, overload sheds rather than
+deadlocks, metrics non-zero.
+"""
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from raftstereo_tpu.config import RAFTStereoConfig, ServeConfig
+from raftstereo_tpu.ops.image import BucketPadder
+from raftstereo_tpu.serve import (BatchEngine, DynamicBatcher, Overloaded,
+                                  RequestTimedOut, ServeClient, ServeMetrics,
+                                  build_server, decode_array, encode_array,
+                                  run_load)
+
+from test_bench import REPO
+
+
+# ----------------------------------------------------------------- fixtures
+
+TINY = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+            corr_radius=2)
+
+
+@pytest.fixture(scope="module")
+def serve_model():
+    from raftstereo_tpu.models import RAFTStereo
+
+    model = RAFTStereo(RAFTStereoConfig(**TINY))
+    variables = model.init(jax.random.key(0), (64, 96))
+    return model, variables
+
+
+class StubEngine:
+    """Batcher-contract stand-in: records (size, iters) per dispatch."""
+
+    def __init__(self, delay=0.0, gate=None, divis_by=32, bucket_multiple=32):
+        self.batches = []
+        self.delay = delay
+        self.gate = gate  # threading.Event the dispatch blocks on
+        self.divis_by = divis_by
+        self.bucket_multiple = bucket_multiple
+
+    def bucket_of(self, shape):
+        return BucketPadder(shape, divis_by=self.divis_by,
+                            bucket_multiple=self.bucket_multiple).bucket_hw
+
+    def infer_batch(self, pairs, iters):
+        if self.gate is not None:
+            self.gate.wait(10.0)
+        if self.delay:
+            time.sleep(self.delay)
+        self.batches.append((len(pairs), iters))
+        return [np.zeros(p[0].shape[:2], np.float32) for p in pairs]
+
+
+def _img(h=60, w=90, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (h, w, 3)).astype(np.float32)
+
+
+def _cfg(**kw):
+    base = dict(port=0, bucket_multiple=32, buckets=((60, 90),),
+                warmup=False, max_batch_size=4, max_wait_ms=40.0,
+                queue_limit=32, request_timeout_ms=5000.0, iters=8,
+                degraded_iters=2, degrade_queue_depth=16)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# ------------------------------------------------------------------ batcher
+
+class TestBatcher:
+    def test_batch_coalesces_to_max_size_before_deadline(self):
+        eng = StubEngine()
+        with DynamicBatcher(eng, _cfg(max_wait_ms=2000.0)) as b:
+            t0 = time.perf_counter()
+            futs = [b.submit(_img(), _img()) for _ in range(4)]
+            res = [f.result(timeout=10) for f in futs]
+        # Size bound, not the 2 s deadline, closed the batch.
+        assert time.perf_counter() - t0 < 1.0
+        assert eng.batches == [(4, 8)]
+        assert all(r.batch_size == 4 and not r.degraded for r in res)
+
+    def test_partial_batch_flushes_at_deadline(self):
+        eng = StubEngine()
+        with DynamicBatcher(eng, _cfg(max_wait_ms=60.0,
+                                      max_batch_size=8)) as b:
+            t0 = time.perf_counter()
+            futs = [b.submit(_img(), _img()) for _ in range(2)]
+            for f in futs:
+                f.result(timeout=10)
+            elapsed = time.perf_counter() - t0
+        assert eng.batches == [(2, 8)]
+        assert elapsed >= 0.05  # held for the deadline, then flushed
+
+    def test_mixed_buckets_batch_separately(self):
+        eng = StubEngine()
+        with DynamicBatcher(eng, _cfg(max_wait_ms=30.0)) as b:
+            futs = [b.submit(_img(60, 90), _img(60, 90)) for _ in range(2)]
+            futs += [b.submit(_img(70, 100), _img(70, 100))
+                     for _ in range(2)]
+            for f in futs:
+                f.result(timeout=10)
+        assert sorted(s for s, _ in eng.batches) == [2, 2]
+
+    def test_full_queue_sheds_then_recovers(self):
+        gate = threading.Event()
+        eng = StubEngine(gate=gate)
+        cfg = _cfg(queue_limit=4, max_batch_size=2, max_wait_ms=1.0)
+        metrics = ServeMetrics()
+        b = DynamicBatcher(eng, cfg, metrics).start()
+        try:
+            # The worker pops up to max_batch_size and blocks on the gate;
+            # keep submitting until the queue itself is full.
+            futs = []
+            deadline = time.perf_counter() + 5.0
+            with pytest.raises(Overloaded):
+                while time.perf_counter() < deadline:
+                    futs.append(b.submit(_img(), _img()))
+            assert metrics.shed.value >= 1
+            gate.set()  # un-block: everything admitted must complete
+            res = [f.result(timeout=10) for f in futs]
+            assert len(res) == len(futs)
+            assert metrics.responses.value == len(futs)
+        finally:
+            gate.set()
+            b.stop()
+
+    def test_degraded_iters_kick_in_and_recover(self):
+        gate = threading.Event()
+        eng = StubEngine(gate=gate)
+        cfg = _cfg(max_batch_size=2, max_wait_ms=1.0, iters=8,
+                   degraded_iters=2, degrade_queue_depth=4, queue_limit=32)
+        metrics = ServeMetrics()
+        b = DynamicBatcher(eng, cfg, metrics).start()
+        try:
+            # Park the worker: it pops this request and blocks on the gate,
+            # so the backlog below builds up deterministically.
+            sentinel = b.submit(_img(), _img())
+            deadline = time.perf_counter() + 5.0
+            while b.queue_depth and time.perf_counter() < deadline:
+                time.sleep(0.002)
+            futs = [b.submit(_img(), _img()) for _ in range(8)]
+            gate.set()
+            sentinel.result(timeout=10)
+            res = [f.result(timeout=10) for f in futs]
+        finally:
+            gate.set()
+            b.stop()
+        iters_used = [it for _, it in eng.batches[1:]]  # drop the sentinel
+        # Backlogs drain 8 -> 6 -> 4 -> 2 in batches of 2: the first three
+        # cross the threshold (4) and degrade, the last recovers to full.
+        assert iters_used == [2, 2, 2, 8]
+        assert metrics.degraded_batches.value == 3
+        assert [r.degraded for r in res] == [True] * 6 + [False] * 2
+        assert all(r.iters == (2 if r.degraded else 8) for r in res)
+
+    def test_request_timeout_fails_late_requests(self):
+        eng = StubEngine()
+        cfg = _cfg(max_batch_size=8, max_wait_ms=120.0,
+                   request_timeout_ms=20.0)
+        metrics = ServeMetrics()
+        with DynamicBatcher(eng, cfg, metrics) as b:
+            fut = b.submit(_img(), _img())
+            # Alone in the queue: held for the 120 ms fill deadline, which
+            # exceeds its own 20 ms timeout -> failed, never dispatched.
+            with pytest.raises(RequestTimedOut):
+                fut.result(timeout=10)
+        assert metrics.timeouts.value == 1
+        assert eng.batches == []
+
+    def test_explicit_iters_respected_and_grouped(self):
+        eng = StubEngine()
+        with DynamicBatcher(eng, _cfg(max_wait_ms=30.0)) as b:
+            f1 = [b.submit(_img(), _img(), iters=3) for _ in range(2)]
+            f2 = [b.submit(_img(), _img()) for _ in range(2)]
+            res1 = [f.result(timeout=10) for f in f1]
+            [f.result(timeout=10) for f in f2]
+        assert sorted(eng.batches) == [(2, 3), (2, 8)]
+        assert all(r.iters == 3 and not r.degraded for r in res1)
+
+
+# ------------------------------------------------------------------- engine
+
+class TestEngine:
+    def test_warmup_then_bucketed_cache_compiles_once_per_bucket(
+            self, serve_model):
+        """One engine through its whole compile lifecycle (one test: XLA
+        compiles are the expensive part of this module, don't repeat them).
+        """
+        model, variables = serve_model
+        cfg = _cfg(max_batch_size=2, iters=2, degraded_iters=1,
+                   buckets=((60, 90),))
+        eng = BatchEngine(model, variables, cfg)
+        # Warmup compiles the configured bucket at BOTH iteration levels.
+        warmed = eng.warmup()
+        assert sorted(warmed) == [(64, 96, 1), (64, 96, 2)]
+        a, b = _img(60, 90, 1), _img(64, 96, 2)  # same 64x96 bucket
+        eng.infer_batch([(a, a)], iters=2)
+        assert not eng.last_included_compile  # warmup paid the compile
+        out = eng.infer_batch([(a, a), (b, b)], iters=2)
+        assert not eng.last_included_compile  # padded batch: same executable
+        assert out[0].shape == (60, 90) and out[1].shape == (64, 96)
+        eng.infer_batch([(_img(70, 100, 3),) * 2], iters=2)  # 96x128 bucket
+        assert eng.last_included_compile
+        assert eng.cache_stats == {"compiled": 3}
+
+    def test_rejects_mixed_buckets_and_oversize(self, serve_model):
+        model, variables = serve_model
+        eng = BatchEngine(model, variables, _cfg(max_batch_size=2))
+        with pytest.raises(AssertionError, match="mixed buckets"):
+            eng.infer_batch([(_img(60, 90),) * 2, (_img(70, 100),) * 2], 2)
+        with pytest.raises(AssertionError, match="max_batch_size"):
+            eng.infer_batch([(_img(),) * 2] * 3, 2)
+
+
+# ------------------------------------------------------------ metrics + wire
+
+class TestMetrics:
+    def test_prometheus_render_parses(self):
+        m = ServeMetrics()
+        m.requests.inc(3)
+        m.queue_depth.set(2)
+        m.latency.observe(0.05)
+        m.batch_size.observe(4)
+        text = m.render()
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                assert line.startswith("# HELP") or line.startswith("# TYPE")
+                continue
+            name, value = line.rsplit(" ", 1)
+            float(value)  # every sample line ends in a number
+            assert name
+        assert "serve_requests_total 3" in text
+        assert "serve_queue_depth 2" in text
+        assert 'serve_request_latency_seconds_bucket{le="+Inf"} 1' in text
+        assert "serve_batch_size_count 1" in text
+
+    def test_duplicate_metric_name_rejected(self):
+        from raftstereo_tpu.serve import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.counter("x_total", "x")
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("x_total", "again")
+
+    def test_array_codec_roundtrip(self, rng):
+        a = rng.normal(size=(7, 9)).astype(np.float32)
+        np.testing.assert_array_equal(decode_array(encode_array(a)), a)
+        nested = decode_array([[1.0, 2.0], [3.0, 4.0]])
+        assert nested.dtype == np.float32 and nested.shape == (2, 2)
+
+
+# ----------------------------------------------------------------- config
+
+class TestServeConfig:
+    def test_arg_roundtrip(self):
+        import argparse
+
+        from raftstereo_tpu.config import add_serve_args, \
+            serve_config_from_args
+
+        p = argparse.ArgumentParser()
+        add_serve_args(p)
+        args = p.parse_args(["--port", "9999", "--buckets", "540x960",
+                             "736x1280", "--max_batch_size", "4",
+                             "--no_warmup"])
+        cfg = serve_config_from_args(args)
+        assert cfg.port == 9999
+        assert cfg.buckets == ((540, 960), (736, 1280))
+        assert cfg.max_batch_size == 4 and not cfg.warmup
+
+    def test_validation(self):
+        with pytest.raises(AssertionError, match="queue_limit"):
+            ServeConfig(queue_limit=2, max_batch_size=8)
+        # degraded_iters above iters clamps down (degradation can only
+        # reduce work) — so e.g. --serve_iters 8 with the default
+        # degraded_iters 16 just works.
+        assert ServeConfig(iters=8, degraded_iters=9).degraded_iters == 8
+        assert ServeConfig(iters=3).degraded_iters == 3
+
+
+# ------------------------------------------------------------------ end2end
+
+class TestEndToEnd:
+    def test_server_concurrent_mixed_shapes(self, serve_model):
+        """Acceptance gate: concurrent mixed-shape traffic over real HTTP.
+
+        Asserts (1) each bucket compiled exactly once, (2) responses equal
+        the single-image Evaluator bitwise at the same iteration count,
+        (3) overload sheds instead of deadlocking, (4) /metrics reports
+        non-zero batch-size and latency histograms.
+        """
+        from raftstereo_tpu.eval import Evaluator
+
+        model, variables = serve_model
+        # warmup=False (from _cfg): the compile misses must come from real
+        # traffic for assertion (1); the generous timeout absorbs the
+        # first-request XLA compiles that warmup would otherwise pay.
+        cfg = _cfg(max_batch_size=4, max_wait_ms=30.0, queue_limit=8,
+                   iters=3, degraded_iters=3, degrade_queue_depth=100,
+                   request_timeout_ms=120000.0,
+                   max_body_mb=1.0, max_image_dim=128)
+        metrics = ServeMetrics()
+        server = build_server(model, variables, cfg, metrics)
+        port = server.port
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            shapes = [(60, 90), (64, 96), (70, 100)]  # 2 distinct buckets
+            pairs = {s: (_img(*s, seed=s[0]), _img(*s, seed=s[1]))
+                     for s in shapes}
+            results, errors = {}, []
+
+            def send(i, shape):
+                try:
+                    client = ServeClient("127.0.0.1", port, timeout=120)
+                    l, r = pairs[shape]
+                    disp, meta = client.predict(l, r)
+                    results[(i, shape)] = (disp, meta)
+                    client.close()
+                except Exception as e:  # pragma: no cover - failure detail
+                    errors.append(e)
+
+            threads = [threading.Thread(target=send, args=(i, s))
+                       for i in range(2) for s in shapes]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            assert not errors, errors
+            assert len(results) == 6
+
+            # (1) one compile per (bucket, iters): batch padding makes the
+            # executable independent of the coalesced batch size.
+            assert server.engine.compiled_keys == {(64, 96, 3),
+                                                   (96, 128, 3)}
+            assert metrics.compile_misses.value == 2
+
+            # (2) bitwise equality with the single-image Evaluator under
+            # the same shape policy: shared BucketPadder, same iters, and
+            # batch_pad = the engine's padded batch size (XLA only
+            # guarantees identical numerics for identical program shapes).
+            ev = Evaluator(model, variables, iters=3, divis_by=32,
+                           bucket_multiple=32,
+                           batch_pad=cfg.max_batch_size)
+            for (_, shape), (disp, meta) in results.items():
+                expected = ev(*pairs[shape])
+                assert disp.shape == shape
+                np.testing.assert_array_equal(disp, expected)
+
+            # (3) overload: a burst far past queue_limit must shed with
+            # clean 503s, and every accepted request completes.
+            burst_stats = run_load(
+                "127.0.0.1", port, lambda i: pairs[(60, 90)],
+                requests=30, concurrency=15, timeout=120)
+            assert burst_stats["shed"] > 0, burst_stats
+            assert burst_stats["ok"] + burst_stats["shed"] \
+                + burst_stats["timeout"] == 30
+            assert burst_stats["error"] == 0
+            # No new compiles: the burst reused the warm 64x96 executable.
+            assert metrics.compile_misses.value == 2
+            assert metrics.compile_hits.value >= 1
+
+            # (4) observability: batch + latency histograms are non-zero
+            # and the healthz endpoint agrees with engine state.
+            client = ServeClient("127.0.0.1", port)
+            text = client.metrics_text()
+            assert "# TYPE serve_batch_size histogram" in text
+
+            def sample(name):
+                return float([l for l in text.splitlines()
+                              if l.startswith(name + " ")][0].split()[-1])
+
+            assert sample("serve_batch_size_count") > 0
+            assert sample("serve_request_latency_seconds_count") > 0
+            assert sample("serve_request_latency_seconds_sum") > 0
+            assert sample("serve_responses_total") >= 6
+
+            # Explicit iters: configured levels are served (warm
+            # executable), anything else is a 400 — never a fresh compile.
+            disp, meta = client.predict(*pairs[(60, 90)], iters=3)
+            assert meta["iters"] == 3
+            np.testing.assert_array_equal(disp, ev(*pairs[(60, 90)]))
+            from raftstereo_tpu.serve import ServeError
+            with pytest.raises(ServeError) as ei:
+                client.predict(*pairs[(60, 90)], iters=7)
+            assert ei.value.status == 400
+            assert metrics.compile_misses.value == 2  # still just the two
+
+            # Admission caps reject before any decode or compile: image
+            # side over max_image_dim -> 400, body over max_body_mb -> 413.
+            with pytest.raises(ServeError) as ei:
+                client.predict(_img(150, 100), _img(150, 100))
+            assert ei.value.status == 400
+            import http.client as hc
+            conn = hc.HTTPConnection("127.0.0.1", port)
+            try:
+                conn.request("POST", "/predict", body=b"x" * (2 * 2 ** 20),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                # The server refuses without draining: depending on send
+                # timing the client either reads the 413 or hits a broken
+                # pipe mid-upload.  Both are the refusal.
+                assert resp.status == 413
+                resp.read()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            conn.close()
+            assert metrics.compile_misses.value == 2  # caps cost no compile
+
+            # A POSTed body to a wrong path must be drained, not parsed as
+            # the next request on this keep-alive connection.
+            conn = hc.HTTPConnection("127.0.0.1", port)
+            conn.request("POST", "/nope", body=b"x" * 4096,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 404
+            resp.read()
+            conn.request("GET", "/healthz")  # same connection still clean
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            conn.close()
+            health = client.healthz()
+            assert health["status"] == "ok"
+            assert sorted(tuple(k) for k in health["compiled_buckets"]) \
+                == [(64, 96, 3), (96, 128, 3)]
+            client.close()
+        finally:
+            server.close()
+            thread.join(10)
+
+    def test_bench_serve_quick_smoke(self, monkeypatch, capsys):
+        """bench.py --serve --quick: the CI smoke for the serving path.
+
+        Runs bench's main() in-process (argv-level, same code path as the
+        shell) — a subprocess would pay ~10 s of fresh jax import for no
+        extra coverage, and the tier-1 budget is tight.
+        """
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        import bench
+
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--serve", "--quick"])
+        bench.main()
+        lines = [l for l in capsys.readouterr().out.strip().splitlines()
+                 if l.startswith("{")]
+        record = json.loads(lines[-1])
+        assert record["unit"] == "pairs/sec" and record["value"] > 0
+        assert record["p99_ms"] > 0
+        assert record["ok"] >= 12 and record["error"] == 0
