@@ -29,8 +29,12 @@ def setup_logging(level=logging.INFO) -> None:
     """
     from ..utils.platform import apply_env_platform
     apply_env_platform()
+    # force=True: the platform bring-up above imports jax/absl, which can
+    # leave a handler on the root logger — without force, basicConfig would
+    # silently no-op and INFO-level progress ("Mesh", "Resumed from step N")
+    # would never reach stderr in non-tty/subprocess runs.
     logging.basicConfig(
-        level=level,
+        level=level, force=True,
         format="%(asctime)s %(levelname)-8s [%(filename)s:%(lineno)d] %(message)s")
 
 

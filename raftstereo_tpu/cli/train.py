@@ -17,9 +17,12 @@ Differences from the reference by design (SURVEY.md §5, §7):
 from __future__ import annotations
 
 import argparse
+import collections
 import logging
 import os
+import statistics
 import sys
+import time
 
 import numpy as np
 
@@ -30,12 +33,13 @@ from ..data.loader import DataLoader, prefetch_to_device
 from ..eval import validate_things
 from ..models import RAFTStereo
 from ..models.raft_stereo import count_parameters
-from ..parallel import batch_sharded, make_mesh
-from ..train.checkpoint import CheckpointManager, save_weights
+from ..parallel import batch_sharded, make_mesh, replicated
+from ..train.checkpoint import CheckpointManager, PreemptionGuard, save_weights
 from ..train.logger import Logger
 from ..train.optim import make_optimizer
 from ..train.state import create_train_state, state_from_variables
 from ..train.step import jit_train_step, make_train_step
+from ..utils.faults import FaultPlan
 from .common import load_variables, setup_logging
 
 logger = logging.getLogger(__name__)
@@ -72,7 +76,27 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                         "semantics) or skip the update and continue")
     g.add_argument("--max_restarts", type=int, default=0,
                    help="auto-restart the loop from the latest checkpoint "
-                        "this many times after a crash (elastic recovery)")
+                        "after a crash (elastic recovery); only restarts "
+                        "without step progress count against the budget")
+    g.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="base seconds between restarts (doubles per "
+                        "consecutive no-progress restart, capped at 60)")
+    g.add_argument("--sample_retries", type=int, default=2,
+                   help="per-sample load retries (with backoff) before an "
+                        "index is quarantined and resampled")
+    g.add_argument("--quarantine_limit", type=int, default=64,
+                   help="max persistently-bad dataset indices to quarantine "
+                        "before the loader declares the dataset broken")
+    g.add_argument("--loader_timeout_s", type=float, default=300.0,
+                   help="seconds to wait for a worker batch before the "
+                        "worker pool is recycled (0 disables)")
+    g.add_argument("--watchdog_factor", type=float, default=10.0,
+                   help="flag steps slower than this multiple of the "
+                        "running median step time (0 disables)")
+    g.add_argument("--faults", default=None,
+                   help="deterministic fault-injection plan (chaos testing; "
+                        "see utils/faults.py), e.g. 'crash@step=7,"
+                        "corrupt@sample=3'; defaults to $RAFTSTEREO_FAULTS")
     a = p.add_argument_group("augmentation (reference: train_stereo.py:244-248)")
     a.add_argument("--img_gamma", type=float, nargs="+", default=None,
                    help="gamma range: GMIN GMAX [GAIN_MIN GAIN_MAX] "
@@ -101,17 +125,27 @@ def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
         do_flip=args.do_flip, spatial_scale=tuple(args.spatial_scale),
         noyjitter=args.noyjitter, data_parallel=args.data_parallel,
         nan_policy=args.nan_policy, max_restarts=args.max_restarts,
+        restart_backoff=args.restart_backoff,
+        sample_retries=args.sample_retries,
+        quarantine_limit=args.quarantine_limit,
+        loader_timeout_s=args.loader_timeout_s,
+        watchdog_factor=args.watchdog_factor,
         device_photometric=args.device_photometric)
 
 
 def train(model_cfg, cfg: TrainConfig, dataset=None,
           num_workers=None, no_validation: bool = False,
-          dataset_root=None, profile_steps=None) -> "TrainState":  # noqa: F821
+          dataset_root=None, profile_steps=None,
+          fault_plan=None) -> "TrainState":  # noqa: F821
     """The training loop; returns the final state.  ``dataset`` injection
-    lets tests run the full loop on synthetic data."""
+    lets tests run the full loop on synthetic data; ``fault_plan``
+    (default: the ``RAFTSTEREO_FAULTS`` env var) injects deterministic
+    failures for chaos testing (utils/faults.py)."""
     import jax
 
     np.random.seed(cfg.seed)
+    plan = FaultPlan.from_env() if fault_plan is None else fault_plan
+    guard = PreemptionGuard().install()
 
     model = RAFTStereo(model_cfg)
     tx, schedule = make_optimizer(cfg)
@@ -123,18 +157,42 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
     logger.info("Mesh: %s", dict(mesh.shape))
 
     ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.name)
-    manager = CheckpointManager(ckpt_dir, keep=cfg.keep_checkpoints)
+    manager = CheckpointManager(ckpt_dir, keep=cfg.keep_checkpoints,
+                                fault_plan=plan)
 
     def init_state():
-        """Latest checkpoint > --restore_ckpt weights > fresh init.  Also the
-        recovery path after a crash (--max_restarts)."""
+        """Latest VALID checkpoint > --restore_ckpt weights > fresh init.
+        Also the recovery path after a crash (--max_restarts); a corrupt
+        latest step falls back to older retained steps instead of
+        re-restoring the same broken step forever."""
         state = create_train_state(model, jax.random.key(cfg.seed), tx,
                                    image_hw=cfg.image_size)
         if manager.latest_step() is not None:
-            state = manager.restore(state)
-            logger.info("Resumed from step %d in %s", int(state.step),
-                        ckpt_dir)
-        elif cfg.restore_ckpt:
+            restored, step = manager.restore_latest_valid(state)
+            if restored is not None:
+                # Rebuild the restored leaves as device arrays that OWN
+                # their buffers (host round-trip + explicit placement on
+                # the mesh): orbax-restored arrays can alias restore-path
+                # memory, and the train step DONATES its input state — on
+                # this container donating them into a compile-cache
+                # deserialized executable is a use-after-free crash.
+                restored = jax.device_put(
+                    jax.tree.map(np.asarray, restored), replicated(mesh))
+                if step != manager.latest_step():
+                    logger.error(
+                        "latest checkpoint (step %d) is corrupt; resumed "
+                        "from retained step %d instead — up to %d steps of "
+                        "work will be recomputed",
+                        manager.latest_step(), step,
+                        manager.latest_step() - step)
+                state = restored
+                logger.info("Resumed from step %d in %s", int(state.step),
+                            ckpt_dir)
+                return state
+            logger.error("every retained checkpoint in %s is corrupt — "
+                         "falling back to %s", ckpt_dir,
+                         cfg.restore_ckpt or "a fresh init")
+        if cfg.restore_ckpt:
             variables = load_variables(cfg.restore_ckpt, model_cfg, model)
             state = state_from_variables(variables, tx)
             logger.info("Initialised weights from %s", cfg.restore_ckpt)
@@ -162,7 +220,11 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
         logger.info("Photometric augmentation on-device "
                     "(--device_photometric): %s", photometric_params)
     loader = DataLoader(dataset, cfg.batch_size, shuffle=True, drop_last=True,
-                        num_workers=num_workers, seed=cfg.seed)
+                        num_workers=num_workers, seed=cfg.seed,
+                        sample_retries=cfg.sample_retries,
+                        quarantine_limit=cfg.quarantine_limit,
+                        batch_timeout=cfg.loader_timeout_s or None,
+                        fault_plan=plan)
     logger.info("Train loader: %d samples, %d batches/epoch",
                 len(dataset), len(loader))
     if len(loader) == 0:
@@ -218,7 +280,42 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
         logger.info("Validation: %s", results)
         metrics_logger.write_dict(results)
 
+    # Steps saved BY THIS PROCESS — the dedup key for boundary/final saves.
+    # Comparing against manager.latest_step() instead would conflate "we
+    # already saved this step" with "a (possibly corrupt, fallback-skipped)
+    # step of that number exists on disk" and silently skip the save.
+    saved_steps = set()
+
+    def save_ckpt(step, state, wait=False):
+        manager.save(step, state, wait=wait)
+        saved_steps.add(step)
+
+    def save_boundary(step, state):
+        """Preemption save: idempotent when a periodic save already covered
+        this exact step in this process."""
+        if step not in saved_steps:
+            save_ckpt(step, state, wait=True)
+
+    step_times = collections.deque(maxlen=101)
+
+    def watchdog(dt, total_steps):
+        """Flag a device step that took a configurable multiple of the
+        running median wall-clock (a hung collective / stuck host looks
+        exactly like this before it looks like anything else)."""
+        flagged = 0.0
+        if (cfg.watchdog_factor > 0 and len(step_times) >= 5
+                and dt > cfg.watchdog_factor * statistics.median(step_times)):
+            flagged = 1.0
+            logger.warning(
+                "step watchdog: step %d took %.2fs (> %gx the running "
+                "median %.3fs over %d steps)", total_steps, dt,
+                cfg.watchdog_factor, statistics.median(step_times),
+                len(step_times))
+        step_times.append(dt)
+        return flagged
+
     def run_loop(state):
+        """Returns (state, preempted)."""
         total_steps = int(state.step)
         should_keep_training = total_steps <= cfg.num_steps
         while should_keep_training:
@@ -227,10 +324,35 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
             # of the reference's pin_memory loader (core/stereo_datasets.py:311).
             for batch in prefetch_to_device(loader, size=2,
                                             devices=batch_sharded(mesh)):
+                # The watchdog clock starts before the fault hooks so an
+                # injected slow@step is measured like a real stall.
+                t0 = time.monotonic()
+                if plan:
+                    # Deterministic chaos hooks for step total_steps+1: may
+                    # sleep (slow), SIGTERM ourselves (preempt), raise
+                    # (crash), or ask for a poisoned batch (nan).
+                    fired = plan.at_step(total_steps + 1)
+                    if "nan" in fired:
+                        img1 = jax.numpy.asarray(batch[0])
+                        batch = (img1.at[(0,) * img1.ndim]
+                                 .set(jax.numpy.nan),) + tuple(batch[1:])
+                if guard.requested:
+                    # Preemption (SIGTERM/SIGINT): save at this step boundary
+                    # and exit cleanly inside the grace period.
+                    save_boundary(total_steps, state)
+                    logger.warning(
+                        "preemption: checkpoint at step %d written; exiting "
+                        "cleanly", total_steps)
+                    return state, True
                 with prof.step(total_steps):
                     state, metrics = step_fn(state, batch)
                 total_steps += 1
+                # float() blocks on the device result, so dt covers the
+                # actual step execution, not just its dispatch.
                 metrics = {k: float(v) for k, v in metrics.items()}
+                health = loader.health_metrics()
+                health["watchdog_slow"] = watchdog(time.monotonic() - t0,
+                                                   total_steps)
                 if metrics.pop("nonfinite", 0.0) >= 0.5:
                     if cfg.nan_policy == "abort":
                         # Reference assert semantics (train_stereo.py:49-52).
@@ -240,7 +362,7 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                                    "update skipped", total_steps)
                     # Don't push the NaN metrics: one skipped step would turn
                     # the whole running-mean window NaN.  Record the skip.
-                    metrics_logger.push({"skipped": 1.0})
+                    metrics_logger.push({"skipped": 1.0, **health})
                 else:
                     metrics["skipped"] = 0.0
                     metrics_logger.write_scalar("live_loss",
@@ -249,10 +371,10 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                     if "lr" in metrics:
                         metrics_logger.write_scalar("lr", metrics["lr"],
                                                     total_steps)
-                    metrics_logger.push(metrics)
+                    metrics_logger.push({**metrics, **health})
 
                 if total_steps % cfg.validation_frequency == 0:
-                    manager.save(total_steps, state)
+                    save_ckpt(total_steps, state)
                     maybe_validate(state)
 
                 if total_steps > cfg.num_steps:
@@ -261,38 +383,71 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
 
             # Per-epoch checkpoint for very long epochs
             # (reference: train_stereo.py:202-205).
-            if len(loader) >= 10000:
-                manager.save(total_steps, state)
-        return state
+            if len(loader) >= 10000 and total_steps not in saved_steps:
+                save_ckpt(total_steps, state)
+        return state, False
 
-    restarts = 0
+    # Elastic recovery: resume from the latest valid checkpoint (the
+    # reference's only recovery is a manual restart with --restore_ckpt,
+    # train_stereo.py:143-148).  Only restarts WITHOUT step progress count
+    # against max_restarts, and consecutive no-progress restarts back off
+    # exponentially, so a crash loop can't thrash the pod.
+    preempted = False
+    restarts_np = 0
+    last_resume_step = int(state.step)
     try:
         while True:
             try:
-                state = run_loop(state)
+                state, preempted = run_loop(state)
                 break
             except (KeyboardInterrupt, FloatingPointError):
                 # FloatingPointError = nan_policy abort: deterministic given
                 # the data — replaying from a checkpoint would hit it again.
                 raise
             except Exception as e:
-                # Elastic recovery: resume from the latest checkpoint
-                # (the reference's only recovery is a manual restart with
-                # --restore_ckpt, train_stereo.py:143-148).
-                if restarts >= cfg.max_restarts:
+                if cfg.max_restarts <= 0:
                     raise
-                restarts += 1
-                logger.warning("training loop failed (%s); restart %d/%d",
-                               e, restarts, cfg.max_restarts)
                 state = init_state()
-                logger.info("restarted at step %d", int(state.step))
+                resume_step = int(state.step)
+                if resume_step > last_resume_step:
+                    # Progress since the previous restart: this one is free
+                    # and the no-progress budget resets in full.
+                    restarts_np = 0
+                    delay = min(cfg.restart_backoff, 60.0)
+                    logger.warning(
+                        "training loop failed (%s); restarting after "
+                        "progress (resuming at step %d, no-progress budget "
+                        "reset to %d) after %.1fs backoff",
+                        e, resume_step, cfg.max_restarts, delay)
+                else:
+                    restarts_np += 1
+                    if restarts_np > cfg.max_restarts:
+                        raise
+                    delay = min(cfg.restart_backoff * 2 ** (restarts_np - 1),
+                                60.0)
+                    logger.warning(
+                        "training loop failed (%s); restart %d/%d without "
+                        "progress, resuming at step %d after %.1fs backoff",
+                        e, restarts_np, cfg.max_restarts, resume_step, delay)
+                last_resume_step = resume_step
+                time.sleep(delay)
     finally:
         # Flush any in-flight profiler trace even when the loop dies between
         # profiled steps (the step-internal handler only covers exceptions
         # raised inside the step itself).
         prof.close()
+        guard.uninstall()
 
-    manager.save(int(state.step), state, wait=True)
+    if preempted:
+        # The boundary checkpoint is already on disk (save_boundary waited);
+        # skip the final-weights export — the grace period is for getting
+        # out, and the relaunch resumes exactly where we stopped.
+        metrics_logger.close()
+        manager.close()
+        return state
+
+    if int(state.step) not in saved_steps:
+        save_ckpt(int(state.step), state, wait=True)
     final = os.path.join(ckpt_dir, f"{cfg.name}-final")
     save_weights(final, state.variables)
     logger.info("Saved final weights to %s", final)
@@ -307,9 +462,11 @@ def main(argv=None) -> int:
     add_train_args(p)
     add_model_args(p)
     args = p.parse_args(argv)
+    plan = FaultPlan.parse(args.faults) if args.faults else None
     train(model_config_from_args(args), train_config_from_args(args),
           num_workers=args.num_workers, no_validation=args.no_validation,
-          dataset_root=args.dataset_root, profile_steps=args.profile_steps)
+          dataset_root=args.dataset_root, profile_steps=args.profile_steps,
+          fault_plan=plan)
     return 0
 
 

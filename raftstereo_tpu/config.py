@@ -139,7 +139,27 @@ class TrainConfig:
     nan_policy: str = "abort"
     # Auto-restart-from-checkpoint budget for the train loop (elastic
     # recovery; the reference's only recovery is a manual --restore_ckpt).
+    # The budget counts restarts WITHOUT progress: a restart that resumes
+    # from a later step than the previous one resets the count, so a long
+    # run with occasional transient failures is never killed by an absolute
+    # cap, while a crash loop stuck at one step exhausts it quickly.
     max_restarts: int = 0
+    # Base of the exponential backoff between restarts (seconds; doubles per
+    # consecutive no-progress restart, capped at 60s).
+    restart_backoff: float = 1.0
+
+    # Self-healing data pipeline (data/loader.py): per-sample retries with
+    # backoff, bounded quarantine of persistently-bad indices (replaced by
+    # deterministic resamples, counted in metrics), and a timeout on worker
+    # batches after which the pool is recycled (0 disables).
+    sample_retries: int = 2
+    quarantine_limit: int = 64
+    loader_timeout_s: float = 300.0
+
+    # Step watchdog: flag (log + metric) any device step slower than this
+    # multiple of the running median step time (0 disables).  Flags only —
+    # a hung XLA collective is for the operator/restart policy to kill.
+    watchdog_factor: float = 10.0
 
     def __post_init__(self):
         assert self.nan_policy in ("abort", "skip"), self.nan_policy

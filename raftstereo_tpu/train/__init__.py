@@ -4,16 +4,18 @@ TPU-first replacement for the reference's training loop
 (reference: train_stereo.py:133-212).
 """
 
-from .checkpoint import CheckpointManager, load_weights, save_weights
+from .checkpoint import (CheckpointManager, PreemptionGuard, load_weights,
+                         save_weights)
 from .logger import Logger
 from .loss import sequence_loss
 from .optim import make_optimizer, onecycle_lr
 from .state import TrainState, create_train_state, state_from_variables
-from .step import jit_train_step, make_train_step
+from .step import jit_train_step, make_train_step, merge_skipped_update
 
 __all__ = [
     "sequence_loss", "make_optimizer", "onecycle_lr",
     "TrainState", "create_train_state", "state_from_variables",
-    "make_train_step", "jit_train_step",
-    "CheckpointManager", "save_weights", "load_weights", "Logger",
+    "make_train_step", "jit_train_step", "merge_skipped_update",
+    "CheckpointManager", "PreemptionGuard", "save_weights", "load_weights",
+    "Logger",
 ]

@@ -8,13 +8,19 @@ round-trip, so the LR schedule continues instead of restarting (SURVEY.md §5).
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Dict, Optional
+import signal
+import time
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
+from ..utils.faults import FaultPlan
 from .state import TrainState
+
+logger = logging.getLogger(__name__)
 
 
 def _all_keys(tree):
@@ -39,6 +45,13 @@ def _metadata_tree(md):
     return tree if isinstance(tree, dict) else {}
 
 
+class PrefusionCheckpointError(ValueError):
+    """A checkpoint with the pre-round-2 separate convz/convr GRU gates was
+    loaded against the fused-convzr layout — a user error, not corruption
+    (the fallback-restore path must NOT treat it as a bad step: every
+    retained step shares the layout)."""
+
+
 _PREFUSION_MSG = (
     "checkpoint predates the fused GRU gate conv (convz/convr -> convzr, "
     "round 2): re-export it through the .pth converter or load weights-only "
@@ -47,22 +60,49 @@ _PREFUSION_MSG = (
 
 
 class CheckpointManager:
-    """Step-indexed checkpoints under ``directory`` with max_to_keep."""
+    """Step-indexed checkpoints under ``directory`` with max_to_keep.
 
-    def __init__(self, directory: str, keep: int = 5):
+    ``fault_plan`` (default: parsed from ``RAFTSTEREO_FAULTS``) lets chaos
+    tests corrupt a just-saved step (``corrupt_ckpt@step=N``) to prove the
+    fallback-restore path.
+    """
+
+    def __init__(self, directory: str, keep: int = 5,
+                 fault_plan: Optional[FaultPlan] = None):
         directory = os.path.abspath(directory)
+        self.directory = directory
+        self._plan = FaultPlan.from_env() if fault_plan is None else fault_plan
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=keep,
                                                  create=True))
 
     def save(self, step: int, state: TrainState, wait: bool = False) -> None:
+        if step in self._mngr.all_steps():
+            # Re-saving an existing step only happens after a fallback
+            # restore skipped a corrupt newer step and training re-reached
+            # it; the in-memory state supersedes whatever is on disk.
+            # Quiesce in-flight async saves before deleting — racing a
+            # pending write of this very step leaves a half-removed dir.
+            logger.warning("overwriting existing checkpoint step %d", step)
+            self._mngr.wait_until_finished()
+            self._mngr.delete(step)
         self._mngr.save(step, args=ocp.args.StandardSave(state))
-        if wait:
+        if self._plan and self._plan.peek("corrupt_ckpt", "step", step):
+            # The save is async; the corruption hook must scribble over a
+            # COMPLETE checkpoint — a partial one would be caught by orbax's
+            # own commit protocol, which is not the failure mode under test.
+            self._mngr.wait_until_finished()
+            self._plan.on_checkpoint_saved(
+                step, os.path.join(self.directory, str(step)))
+        elif wait:
             self._mngr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
+
+    def all_steps(self) -> List[int]:
+        return sorted(self._mngr.all_steps())
 
     def restore(self, state_like: TrainState,
                 step: Optional[int] = None) -> TrainState:
@@ -79,8 +119,33 @@ class CheckpointManager:
             # (error strings need not name the keys, and substring matching
             # would also catch SepConvGRU's convz1/convr1).
             if self._saved_has_prefusion_gates(step):
-                raise ValueError(_PREFUSION_MSG) from e
+                raise PrefusionCheckpointError(_PREFUSION_MSG) from e
             raise
+
+    def restore_latest_valid(
+            self, state_like: TrainState
+    ) -> Tuple[Optional[TrainState], Optional[int]]:
+        """Restore the newest retained step that verifies, falling back to
+        older steps when the latest is corrupt (torn write, bit rot, a
+        preemption mid-upload).  Returns ``(state, step)``, or ``(None,
+        None)`` when no retained step restores cleanly — the caller decides
+        whether that means "fresh init" (elastic recovery) or an error.
+
+        A prefusion-layout mismatch still raises: every retained step shares
+        the layout, so falling back would just fail ``keep`` times and then
+        silently retrain from scratch on a *user error*.
+        """
+        for step in reversed(self.all_steps()):
+            try:
+                return self.restore(state_like, step=step), step
+            except PrefusionCheckpointError:
+                raise                     # layout user error — not corruption
+            except Exception as e:
+                logger.error(
+                    "checkpoint step %d failed to restore (%s: %s) — "
+                    "falling back to the previous retained step",
+                    step, type(e).__name__, e)
+        return None, None
 
     def _saved_has_prefusion_gates(self, step: int) -> bool:
         try:
@@ -92,6 +157,63 @@ class CheckpointManager:
     def close(self) -> None:
         self._mngr.wait_until_finished()
         self._mngr.close()
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT → request a checkpoint at the next step boundary.
+
+    TPU-pod preemptions (and SLURM/k8s evictions) deliver SIGTERM with a
+    grace period before SIGKILL.  The handler only sets a flag; the train
+    loop checks :attr:`requested` at each step boundary, saves, and exits
+    cleanly (exit code 0) so the relaunch resumes at the exact step.  A
+    second signal restores the previous handler and re-delivers, for
+    operators who really mean "die now".
+    """
+
+    def __init__(self, grace_s: float = 30.0):
+        self.grace_s = grace_s
+        self._requested_at: Optional[float] = None
+        self._prev = {}
+
+    def install(self) -> "PreemptionGuard":
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._prev[sig] = signal.signal(sig, self._handle)
+        except ValueError:
+            # Not the main thread (e.g. the loop embedded in a server):
+            # signals go to the main thread anyway; run unguarded.
+            logger.warning("PreemptionGuard: not on the main thread — "
+                           "SIGTERM/SIGINT will not trigger a boundary save")
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except ValueError:
+                pass
+        self._prev = {}
+
+    def _handle(self, signum, frame):
+        if self._requested_at is not None:   # second signal: die now
+            prev = self._prev.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            os.kill(os.getpid(), signum)
+            return
+        self._requested_at = time.monotonic()
+        logger.warning(
+            "received signal %d: checkpointing at the next step boundary "
+            "and exiting (grace %.0fs; signal again to exit immediately)",
+            signum, self.grace_s)
+
+    @property
+    def requested(self) -> bool:
+        return self._requested_at is not None
+
+    @property
+    def deadline_passed(self) -> bool:
+        return (self._requested_at is not None
+                and time.monotonic() - self._requested_at > self.grace_s)
 
 
 def save_weights(path: str, variables: Dict) -> None:
@@ -127,7 +249,7 @@ def load_weights(path: str, variables_like: Optional[Dict] = None) -> Dict:
             except Exception:
                 saved = {}
             if _tree_has_exact_key(saved, "convz"):
-                raise ValueError(_PREFUSION_MSG) from e
+                raise PrefusionCheckpointError(_PREFUSION_MSG) from e
             raise
     ckptr.close()
     return out

@@ -98,6 +98,14 @@ class Logger:
             self._jsonl.flush()
 
     def close(self) -> None:
+        # Flush the partial window: short runs (and the tail of long ones)
+        # would otherwise lose up to SUM_FREQ-1 steps of metrics — including
+        # the robustness gauges chaos tests assert on.
+        if self._counts:
+            means = {k: v / self._counts[k] for k, v in self.running.items()}
+            self._emit({"step": self.total_steps, **means})
+            self.running = {}
+            self._counts = {}
         if self.writer is not None:
             self.writer.close()
         if self._jsonl is not None:

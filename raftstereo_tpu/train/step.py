@@ -23,6 +23,30 @@ from .state import TrainState
 Batch = Tuple[jax.Array, jax.Array, jax.Array, jax.Array]  # img1,img2,disp,valid
 
 
+def merge_skipped_update(finite, params, old_params, opt_state, old_opt_state):
+    """The ``nan_policy=skip`` merge: where ``finite`` is False, drop the bad
+    update on-device — params and optimizer moments keep their old values,
+    but the LR-schedule count still advances — torch semantics, where
+    GradScaler skips optimizer.step() while the loop's scheduler.step() runs
+    unconditionally (reference: train_stereo.py:175-180).
+    """
+    keep = lambda new, old: jnp.where(finite, new, old)
+
+    def merge(new, old):
+        if isinstance(new, optax.ScaleByScheduleState):
+            return new                      # schedule count advances
+        if hasattr(new, "_fields"):         # optax NamedTuple states
+            return type(new)(*(merge(a, b) for a, b in zip(new, old)))
+        if isinstance(new, (tuple, list)):
+            return type(new)(merge(a, b) for a, b in zip(new, old))
+        if isinstance(new, dict):
+            return {k: merge(new[k], old[k]) for k in new}
+        return keep(new, old)
+
+    return (jax.tree.map(keep, params, old_params),
+            merge(opt_state, old_opt_state))
+
+
 def make_train_step(model, tx, cfg: TrainConfig, lr_schedule=None,
                     photometric_params: Dict = None
                     ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict]]:
@@ -89,26 +113,8 @@ def make_train_step(model, tx, cfg: TrainConfig, lr_schedule=None,
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         if cfg.nan_policy == "skip":
-            # Drop the bad update on-device: params and optimizer moments keep
-            # their old values, but the LR-schedule count still advances —
-            # torch semantics, where GradScaler skips optimizer.step() while
-            # the loop's scheduler.step() runs unconditionally
-            # (reference: train_stereo.py:175-180).
-            keep = lambda new, old: jnp.where(finite, new, old)
-
-            def merge(new, old):
-                if isinstance(new, optax.ScaleByScheduleState):
-                    return new                      # schedule count advances
-                if hasattr(new, "_fields"):         # optax NamedTuple states
-                    return type(new)(*(merge(a, b) for a, b in zip(new, old)))
-                if isinstance(new, (tuple, list)):
-                    return type(new)(merge(a, b) for a, b in zip(new, old))
-                if isinstance(new, dict):
-                    return {k: merge(new[k], old[k]) for k in new}
-                return keep(new, old)
-
-            params = jax.tree.map(keep, params, state.params)
-            opt_state = merge(opt_state, state.opt_state)
+            params, opt_state = merge_skipped_update(
+                finite, params, state.params, opt_state, state.opt_state)
         metrics = dict(metrics, loss=loss, grad_norm=grad_norm,
                        nonfinite=1.0 - finite.astype(jnp.float32))
         if lr_schedule is not None:

@@ -1,7 +1,9 @@
-"""Utilities: checkpoint conversion, logging, misc."""
+"""Utilities: checkpoint conversion, fault injection, logging, misc."""
 
 from .convert import convert_checkpoint, load_state_dict, torch_to_variables
+from .faults import FaultPlan, InjectedCrash, InjectedFault, InjectedSampleError
 from .platform import apply_env_platform
 
 __all__ = ["apply_env_platform", "convert_checkpoint", "load_state_dict",
-           "torch_to_variables"]
+           "torch_to_variables", "FaultPlan", "InjectedFault",
+           "InjectedCrash", "InjectedSampleError"]

@@ -1,0 +1,219 @@
+"""Deterministic fault injection for the training stack.
+
+A :class:`FaultPlan` is a small, declarative schedule of failures —
+parsed from a spec string (usually the ``RAFTSTEREO_FAULTS`` env var, so
+subprocess chaos tests and pod launchers can drive it without code
+changes) — with injection hooks wired into the data loader
+(``data/loader.py``), the train loop (``cli/train.py``) and the
+checkpoint manager (``train/checkpoint.py``).  Every recovery mechanism
+in the repo (preemption-safe checkpoints, checkpoint fallback, sample
+quarantine, pool recycle, ``nan_policy``, ``max_restarts``) is proven by
+injecting its failure on purpose (tests/test_faults.py), the way
+frequent-checkpointing systems validate theirs (CheckFreq, FAST '21).
+
+Grammar (comma-separated entries)::
+
+    RAFTSTEREO_FAULTS="crash@step=7,corrupt@sample=3,hang@worker=1:10s,nan@step=5"
+
+    entry := KIND "@" DIM "=" INT [":" SECONDS["s"|"ms"]]
+
+    crash@step=N          raise InjectedCrash before executing step N
+    preempt@step=N        deliver SIGTERM to self before executing step N
+    nan@step=N            poison the batch of step N with a NaN
+    slow@step=N:2s        sleep before step N (trips the step watchdog)
+    corrupt@sample=I      dataset index I always raises (persistent)
+    hang@sample=I:10s     sleep before loading index I (once)
+    hang@worker=W:10s     worker W sleeps before its first load (once)
+    corrupt_ckpt@step=N   scribble over the checkpoint saved at step N
+
+All faults fire exactly once except ``corrupt@sample``, which models a
+persistently bad shard and fires on every access.  Injection is fully
+deterministic: no randomness, no timers beyond the explicit sleeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import time
+from typing import List, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "RAFTSTEREO_FAULTS"
+
+_KINDS = {
+    # kind: (allowed dims, needs duration, persistent)
+    "crash": (("step",), False, False),
+    "preempt": (("step",), False, False),
+    "nan": (("step",), False, False),
+    "slow": (("step",), True, False),
+    "corrupt": (("sample",), False, True),
+    "hang": (("worker", "sample"), True, False),
+    "corrupt_ckpt": (("step",), False, False),
+}
+
+
+class InjectedFault(RuntimeError):
+    """Base of every deliberately injected failure."""
+
+
+class InjectedCrash(InjectedFault):
+    """Raised by ``crash@step=N`` — exercises elastic restart."""
+
+
+class InjectedSampleError(InjectedFault):
+    """Raised by ``corrupt@sample=I`` — exercises retry + quarantine."""
+
+
+def _parse_seconds(text: str) -> float:
+    text = text.strip().lower()
+    if text.endswith("ms"):
+        return float(text[:-2]) / 1000.0
+    if text.endswith("s"):
+        text = text[:-1]
+    return float(text)
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    dim: str
+    value: int
+    seconds: Optional[float] = None
+    # -1 = unlimited (persistent faults); otherwise remaining fire count.
+    remaining: int = 1
+
+    def spec(self) -> str:
+        dur = "" if self.seconds is None else f":{self.seconds:g}s"
+        return f"{self.kind}@{self.dim}={self.value}{dur}"
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A parsed fault schedule.  Picklable (it crosses into spawned data
+    workers); fired-state is per-process by design — a worker consuming
+    its copy of a fault does not consume the parent's."""
+
+    faults: List[Fault] = dataclasses.field(default_factory=list)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "FaultPlan":
+        faults = []
+        for entry in (spec or "").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            try:
+                kind, rest = entry.split("@", 1)
+                dim, value = rest.split("=", 1)
+                seconds = None
+                if ":" in value:
+                    value, dur = value.split(":", 1)
+                    seconds = _parse_seconds(dur)
+                kind, dim, value = kind.strip(), dim.strip(), int(value)
+            except ValueError as e:
+                raise ValueError(
+                    f"bad fault entry {entry!r} (want KIND@DIM=INT[:SECS], "
+                    f"e.g. crash@step=7 or hang@worker=1:10s): {e}") from e
+            if kind not in _KINDS:
+                raise ValueError(f"unknown fault kind {kind!r} in {entry!r}; "
+                                 f"known: {sorted(_KINDS)}")
+            dims, needs_dur, persistent = _KINDS[kind]
+            if dim not in dims:
+                raise ValueError(f"fault {kind!r} takes {dims}, got "
+                                 f"{dim!r} in {entry!r}")
+            if needs_dur and seconds is None:
+                raise ValueError(f"fault {kind!r} needs a duration "
+                                 f"(e.g. {kind}@{dim}={value}:10s)")
+            faults.append(Fault(kind, dim, value, seconds,
+                                remaining=-1 if persistent else 1))
+        return cls(faults)
+
+    @classmethod
+    def from_env(cls, env_var: str = ENV_VAR) -> "FaultPlan":
+        return cls.parse(os.environ.get(env_var))
+
+    # -- matching -----------------------------------------------------------
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def peek(self, kind: str, dim: str, value: int) -> Optional[Fault]:
+        for f in self.faults:
+            if (f.kind == kind and f.dim == dim and f.value == value
+                    and f.remaining != 0):
+                return f
+        return None
+
+    def _take(self, kind: str, dim: str, value: int) -> Optional[Fault]:
+        f = self.peek(kind, dim, value)
+        if f is not None:
+            if f.remaining > 0:
+                f.remaining -= 1
+            logger.warning("fault injection: firing %s", f.spec())
+        return f
+
+    # -- hooks --------------------------------------------------------------
+
+    def at_step(self, step: int) -> Set[str]:
+        """Train-loop hook, called before executing 1-based ``step``.
+
+        Sleeps for ``slow``, self-delivers SIGTERM for ``preempt``, raises
+        for ``crash``; returns the set of fired kinds (the loop poisons the
+        batch itself when ``"nan"`` is in it).
+        """
+        fired = set()
+        f = self._take("slow", "step", step)
+        if f is not None:
+            fired.add("slow")
+            time.sleep(f.seconds)
+        if self._take("nan", "step", step) is not None:
+            fired.add("nan")
+        if self._take("preempt", "step", step) is not None:
+            fired.add("preempt")
+            os.kill(os.getpid(), signal.SIGTERM)
+        f = self._take("crash", "step", step)
+        if f is not None:
+            raise InjectedCrash(f"injected crash before step {step}")
+        return fired
+
+    def on_sample(self, index: int) -> None:
+        """Loader hook, called before loading dataset ``index``."""
+        f = self._take("hang", "sample", index)
+        if f is not None:
+            time.sleep(f.seconds)
+        if self._take("corrupt", "sample", index) is not None:
+            raise InjectedSampleError(f"injected corrupt sample {index}")
+
+    def on_worker(self, worker_id: int) -> None:
+        """Loader hook, called at the top of each worker load task."""
+        f = self._take("hang", "worker", worker_id)
+        if f is not None:
+            time.sleep(f.seconds)
+
+    def on_checkpoint_saved(self, step: int, path: str) -> bool:
+        """Checkpoint-manager hook: corrupt the just-saved step dir.
+        Returns True if it fired (the caller must have waited for the
+        async save to finish before calling)."""
+        if self._take("corrupt_ckpt", "step", step) is None:
+            return False
+        corrupt_tree(path)
+        return True
+
+
+def corrupt_tree(path: str) -> int:
+    """Overwrite every file under ``path`` with garbage (simulates torn
+    writes / bit rot on the checkpoint volume).  Returns files touched."""
+    n = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            with open(os.path.join(root, name), "wb") as f:
+                f.write(b"\x00CORRUPTED-BY-FAULT-INJECTION\x00")
+            n += 1
+    logger.warning("fault injection: corrupted %d files under %s", n, path)
+    return n

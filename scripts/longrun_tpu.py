@@ -173,22 +173,38 @@ def main():
 
     # 2. The resume actually resumed: some step <= killed_at appears twice
     #    (once from phase A, once replayed by phase B from the checkpoint),
-    #    and phase B's first report sits at/below the checkpoint boundary +100.
+    #    and the earliest replayed report sits just past the checkpoint
+    #    boundary phase B restarted from — a replay starting beyond
+    #    boundary+100 means the resume skipped ahead of the retained
+    #    checkpoint (a step-discontinuity the duplicate check alone misses).
     dup = sorted({s for s in steps if steps.count(s) > 1})
     if not dup:
         print("FAIL: no replayed step reports — phase B did not resume "
               "from a mid-run checkpoint", flush=True)
         ok = False
+    else:
+        boundary = (killed_at // args.ckpt_every) * args.ckpt_every
+        if min(dup) > boundary + 100:
+            print(f"FAIL: first replayed report {min(dup)} is past the "
+                  f"checkpoint boundary {boundary}+100 (killed at "
+                  f"{killed_at}, ckpt_every {args.ckpt_every}) — phase B "
+                  "resumed ahead of the retained checkpoint", flush=True)
+            ok = False
 
     # 3. Learning: mean EPE of the last three reports < half the first report
     epes = [(r["step"], r["epe"]) for r in recs if "epe" in r]
-    first_epe = epes[0][1]
-    tail = [e for _, e in epes[-3:]]
-    tail_epe = sum(tail) / len(tail)
-    if not tail_epe < 0.5 * first_epe:
-        print(f"FAIL: no learning: first epe {first_epe:.3f}, "
-              f"tail mean {tail_epe:.3f}", flush=True)
+    if not epes:
+        print("FAIL: no epe records in the metrics log", flush=True)
         ok = False
+        first_epe = tail_epe = float("nan")
+    else:
+        first_epe = epes[0][1]
+        tail = [e for _, e in epes[-3:]]
+        tail_epe = sum(tail) / len(tail)
+        if not tail_epe < 0.5 * first_epe:
+            print(f"FAIL: no learning: first epe {first_epe:.3f}, "
+                  f"tail mean {tail_epe:.3f}", flush=True)
+            ok = False
 
     # 4. nan_policy=abort never fired (phase B rc==0 already implies it;
     #    double-check no skipped steps were recorded).

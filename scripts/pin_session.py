@@ -75,6 +75,11 @@ def main():
         if res.returncode != 0 or not line.startswith("{"):
             print(f"--- {name} FAILED (rc={res.returncode}):\n{res.stderr[-2000:]}",
                   flush=True)
+            if name in existing:
+                # A transient bench failure must not erase the session
+                # record — keep the previous pin (mirrors the --skip branch).
+                print(f"--- {name}: keeping the previous pin", flush=True)
+                configs.append(existing[name])
             continue
         rec = json.loads(line)
         rec["config"] = name

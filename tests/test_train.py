@@ -315,6 +315,52 @@ def test_train_loop_auto_restart(tmp_path, rng, monkeypatch):
     assert (tmp_path / "ckpt" / "r" / "r-final").exists()
 
 
+def test_merge_skipped_update_direct():
+    """Direct unit coverage of the nan_policy=skip optimizer-state merge
+    (train/step.py::merge_skipped_update) on a real make_optimizer chain:
+    the schedule count advances, Adam count AND moments hold, params hold —
+    previously only exercised through the full (slow) train step."""
+    from raftstereo_tpu.train.step import merge_skipped_update
+
+    cfg = TrainConfig(lr=1e-3, num_steps=10)
+    tx, _ = make_optimizer(cfg)
+    params = {"w": jnp.arange(4.0), "b": jnp.ones((2,))}
+    opt0 = tx.init(params)
+    grads = {"w": jnp.full((4,), 0.5), "b": jnp.full((2,), -0.25)}
+    up1, opt1 = tx.update(grads, opt0, params)
+    p1 = optax.apply_updates(params, up1)
+    up2, opt2 = tx.update(grads, opt1, p1)
+    p2 = optax.apply_updates(p1, up2)
+
+    def pick(opt_state, cls):
+        return [l for l in jax.tree.leaves(
+            opt_state, is_leaf=lambda x: isinstance(x, cls))
+            if isinstance(l, cls)]
+
+    # Non-finite step: params and Adam state roll back, schedule advances.
+    mp, mo = merge_skipped_update(jnp.asarray(False), p2, p1, opt2, opt1)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(mp[k]), np.asarray(p1[k]))
+    (sched_m,), (sched_2,) = (pick(mo, optax.ScaleByScheduleState),
+                              pick(opt2, optax.ScaleByScheduleState))
+    assert int(sched_m.count) == int(sched_2.count) == 2
+    (adam_m,), (adam_1,) = (pick(mo, optax.ScaleByAdamState),
+                            pick(opt1, optax.ScaleByAdamState))
+    assert int(adam_m.count) == int(adam_1.count) == 1
+    for field in ("mu", "nu"):
+        for a, b in zip(jax.tree.leaves(getattr(adam_m, field)),
+                        jax.tree.leaves(getattr(adam_1, field))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Finite step: the merge is the identity on params and Adam state.
+    fp, fo = merge_skipped_update(jnp.asarray(True), p2, p1, opt2, opt1)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(fp[k]), np.asarray(p2[k]))
+    (adam_f,), (adam_2,) = (pick(fo, optax.ScaleByAdamState),
+                            pick(opt2, optax.ScaleByAdamState))
+    assert int(adam_f.count) == int(adam_2.count) == 2
+
+
 @pytest.mark.slow
 def test_skip_advances_schedule_but_not_adam(rng):
     """On a skipped step the LR-schedule count advances (torch: unconditional
