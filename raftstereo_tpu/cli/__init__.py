@@ -5,6 +5,9 @@
 * ``python -m raftstereo_tpu.cli.evaluate``  — benchmark validation
 * ``python -m raftstereo_tpu.cli.demo``      — disparity inference + viz
 * ``python -m raftstereo_tpu.cli.serve``     — dynamic-batching HTTP serving
-  (+ ``--loadgen`` traffic driver; docs/serving.md)
+  (+ ``--loadgen`` traffic driver; docs/serving.md); session-aware
+  ``/predict`` for video streams (docs/streaming.md)
+* ``python -m raftstereo_tpu.cli.stream``    — offline warm-start streaming
+  runner: warm vs cold on a synthetic sequence (docs/streaming.md)
 * ``python -m raftstereo_tpu.cli.sl_smoke``  — structured-light data check
 """

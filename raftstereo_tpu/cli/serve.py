@@ -25,7 +25,8 @@ import sys
 import numpy as np
 
 from ..config import (_parse_bucket, add_model_args, add_serve_args,
-                      model_config_from_args, serve_config_from_args)
+                      add_stream_args, model_config_from_args,
+                      serve_config_from_args, stream_config_from_args)
 from .common import load_variables, setup_logging
 
 logger = logging.getLogger(__name__)
@@ -50,7 +51,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="explicit per-request GRU iterations; must be one "
                         "of the server's configured levels (--serve_iters "
                         "or --degraded_iters). default: server-adaptive")
+    g.add_argument("--sequence_len", type=int, default=None,
+                   help="sequence-replay load: frames per synthetic video "
+                        "session, sent with session_id/seq_no so the "
+                        "server warm-starts them (docs/streaming.md)")
+    p.add_argument("--no_stream", action="store_true",
+                   help="disable the session-aware streaming path "
+                        "(session_id/seq_no on /predict)")
+    p.add_argument("--stream_warmup", action="store_true",
+                   help="compile every (bucket, stream-ladder level) at "
+                        "startup so mid-stream level switches never pay "
+                        "an XLA compile")
     add_serve_args(p)
+    add_stream_args(p)
     add_model_args(p)
     return p
 
@@ -64,15 +77,21 @@ def run_loadgen(args) -> int:
         synthetic_pair_pool(h, w, n=min(8, args.requests)),
         requests=args.requests, concurrency=args.concurrency,
         mode="open" if args.open_rate else "closed", rate=args.open_rate,
-        iters=args.request_iters)
+        iters=args.request_iters, sequence_len=args.sequence_len)
     print(json.dumps(stats))
     return 0
 
 
 def main(argv=None) -> int:
     setup_logging()
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if args.loadgen:
+        if args.sequence_len is not None and args.request_iters is not None:
+            parser.error("--sequence_len cannot be combined with "
+                         "--request_iters: the server's adaptive "
+                         "controller owns per-frame iterations for "
+                         "session traffic")
         return run_loadgen(args)
 
     import jax
@@ -81,7 +100,9 @@ def main(argv=None) -> int:
     from ..serve import build_server
 
     config = model_config_from_args(args)
-    serve_cfg = serve_config_from_args(args)
+    stream_cfg = None if args.no_stream else stream_config_from_args(args)
+    serve_cfg = serve_config_from_args(args, stream=stream_cfg,
+                                       stream_warmup=args.stream_warmup)
     model = RAFTStereo(config)
     if args.restore_ckpt:
         variables = load_variables(args.restore_ckpt, config, model)

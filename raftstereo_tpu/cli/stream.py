@@ -1,0 +1,101 @@
+"""Offline streaming/video-stereo runner (docs/streaming.md).
+
+Replays a temporally coherent synthetic moving-camera sequence through the
+temporal warm-start subsystem (stream/) twice — once as a warm-started
+session on the adaptive iteration ladder, once as the cold-start
+full-iteration baseline — and reports per-frame EPE, temporal-consistency
+EPE, and the iterations/latency the warm start saved:
+
+    python -m raftstereo_tpu.cli.stream --frames 8 --image_size 64x96 \
+        --stream_ladder 32 16 8 --restore_ckpt models/sceneflow.pth
+
+Both passes run through the SAME serve-layer engine path
+(``BatchEngine.infer_stream_batch``) the HTTP session endpoint uses, under
+the same pad-and-bucket shape policy — with matching ``--divis_by``/
+``--bucket_multiple``/``--max_batch_size`` the disparities here are
+bitwise-identical to a session driven through ``cli.serve`` (tested in
+tests/test_stream.py).  Prints one JSON object: a summary plus the two
+per-frame record lists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from ..config import (_parse_bucket, add_model_args, add_stream_args,
+                      model_config_from_args, stream_config_from_args)
+from .common import load_variables, setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", default=None,
+                   help=".pth or Orbax weights (default: random weights — "
+                        "the warm-vs-cold comparison is still meaningful, "
+                        "both passes share them)")
+    g = p.add_argument_group("sequence")
+    g.add_argument("--frames", type=int, default=8,
+                   help="synthetic sequence length")
+    g.add_argument("--image_size", type=_parse_bucket, default=(64, 96),
+                   metavar="HxW", help="frame shape")
+    g.add_argument("--start_disp", type=float, default=4.0,
+                   help="frame-0 scene disparity in px")
+    g.add_argument("--drift", type=float, default=0.5,
+                   help="disparity drift per frame in px (scene depth "
+                        "change)")
+    g.add_argument("--pan", type=int, default=2,
+                   help="camera pan per frame in px")
+    g.add_argument("--seed", type=int, default=0)
+    g = p.add_argument_group("engine (serve-parity shape policy)")
+    g.add_argument("--divis_by", type=int, default=32)
+    g.add_argument("--bucket_multiple", type=int, default=64)
+    g.add_argument("--max_batch_size", type=int, default=1,
+                   help="batch-axis padding; match the server's value for "
+                        "bitwise serve parity (XLA numerics are only "
+                        "identical at identical program shapes)")
+    add_stream_args(p)
+    add_model_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+
+    import jax
+
+    from ..data.synthetic import StereoVideoSequence
+    from ..models import RAFTStereo
+    from ..stream import build_stream_engine, compare_warm_cold
+
+    config = model_config_from_args(args)
+    stream_cfg = stream_config_from_args(args)
+    model = RAFTStereo(config)
+    if args.restore_ckpt:
+        variables = load_variables(args.restore_ckpt, config, model)
+        logger.info("Loaded checkpoint %s", args.restore_ckpt)
+    else:
+        variables = model.init(jax.random.key(0))
+        logger.warning("No --restore_ckpt: streaming RANDOM weights")
+
+    seq = StereoVideoSequence(n_frames=args.frames, hw=args.image_size,
+                              d0=args.start_disp, drift=args.drift,
+                              pan=args.pan, seed=args.seed)
+    engine = build_stream_engine(model, variables, args.image_size,
+                                 stream_cfg,
+                                 max_batch_size=args.max_batch_size,
+                                 divis_by=args.divis_by,
+                                 bucket_multiple=args.bucket_multiple)
+    report = compare_warm_cold(engine, seq.frames, stream_cfg)
+    print(json.dumps({"summary": report["summary"],
+                      "warm": report["warm"], "cold": report["cold"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,6 +174,59 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Temporal warm-start streaming parameters (stream/, docs/streaming.md).
+
+    ``ladder`` is the small fixed set of GRU iteration counts the subsystem
+    ever runs — each (bucket, level) pair is one compiled executable, so the
+    adaptive controller can move between levels without ever paying an XLA
+    compile mid-stream.  ``ladder[0]`` is the cold-start (full) count; warm
+    frames use ``ladder[1:]``, picked per frame from an EMA of the update
+    magnitude (mean |refined - warm-start init| at 1/factor resolution, in
+    pixels).  Frozen + hashable like the other configs."""
+
+    ladder: Tuple[int, ...] = (32, 16, 8)
+    # EMA decay of the per-frame update magnitude (higher = smoother).
+    ema_decay: float = 0.6
+    # Controller thresholds on that EMA, in low-res pixels:
+    # above promote -> more iterations next frame; below demote -> fewer;
+    # above cold_reset -> the warm start is not tracking the scene (cut,
+    # fast motion), next frame re-runs cold at ladder[0].
+    promote_threshold: float = 1.0
+    demote_threshold: float = 0.25
+    cold_reset_threshold: float = 4.0
+    # Session store bounds: LRU-evict beyond session_limit, treat sessions
+    # idle past session_ttl_s as expired (next frame is cold, never an
+    # error).
+    session_limit: int = 256
+    session_ttl_s: float = 300.0
+
+    def __post_init__(self):
+        if isinstance(self.ladder, list):
+            object.__setattr__(self, "ladder", tuple(self.ladder))
+        assert len(self.ladder) >= 2, (
+            f"ladder {self.ladder} needs a cold level and at least one "
+            f"warm level")
+        assert all(i >= 1 for i in self.ladder), self.ladder
+        assert all(a > b for a, b in zip(self.ladder, self.ladder[1:])), (
+            f"ladder {self.ladder} must be strictly descending "
+            f"(cold/full first)")
+        # The design contract the stream subsystem is built around (and the
+        # acceptance tests assert): warm frames run at most HALF the cold
+        # iteration count.
+        assert 2 * self.ladder[1] <= self.ladder[0], (
+            f"first warm level {self.ladder[1]} must be <= half the cold "
+            f"level {self.ladder[0]}")
+        assert 0.0 <= self.ema_decay < 1.0, self.ema_decay
+        assert (self.demote_threshold < self.promote_threshold
+                < self.cold_reset_threshold), (
+            self.demote_threshold, self.promote_threshold,
+            self.cold_reset_threshold)
+        assert self.session_limit >= 1, self.session_limit
+        assert self.session_ttl_s > 0, self.session_ttl_s
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Serving-layer parameters (serve/): dynamic micro-batching, the
     shape-bucketed compile cache, admission control and graceful
@@ -219,6 +272,16 @@ class ServeConfig:
     max_body_mb: float = 160.0
     max_image_dim: int = 2048
     cold_buckets: bool = True
+
+    # Temporal warm-start streaming (stream/, docs/streaming.md): when set,
+    # ``/predict`` accepts ``session_id``/``seq_no`` and frames of a session
+    # are warm-started from the previous frame's forward-warped disparity at
+    # an adaptively reduced iteration count.  None disables the session
+    # endpoints.  ``stream_warmup`` eagerly compiles the ladder levels for
+    # every configured bucket at startup (the stream analogue of
+    # ``warmup``), so mid-stream level switches never pay an XLA compile.
+    stream: Optional[StreamConfig] = None
+    stream_warmup: bool = False
 
     def __post_init__(self):
         if isinstance(self.buckets, list):
@@ -292,8 +355,55 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
                         "in production: a compile stalls everyone queued)")
 
 
-def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
+def add_stream_args(parser: argparse.ArgumentParser) -> None:
+    d = StreamConfig()
+    g = parser.add_argument_group("stream")
+    g.add_argument("--stream_ladder", nargs="+", type=int,
+                   default=list(d.ladder), metavar="ITERS",
+                   help="descending GRU-iteration levels; ladder[0] is the "
+                        "cold-start count, warm frames pick from the rest "
+                        "(each level is one pre-compilable executable)")
+    g.add_argument("--ema_decay", type=float, default=d.ema_decay,
+                   help="EMA decay of the per-frame update magnitude that "
+                        "drives the adaptive iteration controller")
+    g.add_argument("--promote_threshold", type=float,
+                   default=d.promote_threshold,
+                   help="EMA (low-res px) above which the next frame runs "
+                        "more iterations")
+    g.add_argument("--demote_threshold", type=float,
+                   default=d.demote_threshold,
+                   help="EMA below which the next frame runs fewer "
+                        "iterations")
+    g.add_argument("--cold_reset_threshold", type=float,
+                   default=d.cold_reset_threshold,
+                   help="EMA above which the warm start is judged lost and "
+                        "the next frame re-runs cold at ladder[0]")
+    g.add_argument("--session_limit", type=int, default=d.session_limit,
+                   help="max live sessions; beyond this the LRU session is "
+                        "evicted (its next frame re-runs cold)")
+    g.add_argument("--session_ttl_s", type=float, default=d.session_ttl_s,
+                   help="idle seconds after which a session expires (its "
+                        "next frame re-runs cold, never an error)")
+
+
+def stream_config_from_args(args: argparse.Namespace) -> StreamConfig:
+    return StreamConfig(
+        ladder=tuple(args.stream_ladder),
+        ema_decay=args.ema_decay,
+        promote_threshold=args.promote_threshold,
+        demote_threshold=args.demote_threshold,
+        cold_reset_threshold=args.cold_reset_threshold,
+        session_limit=args.session_limit,
+        session_ttl_s=args.session_ttl_s,
+    )
+
+
+def serve_config_from_args(args: argparse.Namespace,
+                           stream: Optional[StreamConfig] = None,
+                           stream_warmup: bool = False) -> ServeConfig:
     return ServeConfig(
+        stream=stream,
+        stream_warmup=stream_warmup,
         host=args.host,
         port=args.port,
         divis_by=args.divis_by,

@@ -23,7 +23,7 @@ from .png16 import write_png16
 __all__ = [
     "make_synthetic_kitti", "make_learnable_kitti", "make_synthetic_eth3d",
     "make_synthetic_middlebury", "make_synthetic_things_test",
-    "make_synthetic_sl", "ShiftStereoDataset",
+    "make_synthetic_sl", "ShiftStereoDataset", "StereoVideoSequence",
 ]
 
 
@@ -68,6 +68,51 @@ class ShiftStereoDataset:
 
     def __getitem__(self, i):
         return self._items[i % len(self._items)]
+
+
+class StereoVideoSequence:
+    """Temporally coherent synthetic moving-camera stereo sequence with
+    exact ground-truth disparity — the CPU-testable workload for the
+    streaming subsystem (stream/, docs/streaming.md).
+
+    One shared smooth texture (same construction as
+    :class:`ShiftStereoDataset`, so the correlation volume is genuinely
+    informative); per frame ``t`` the camera pans ``pan`` px across it and
+    the scene depth drifts so the disparity is ``round(d0 + drift * t)``
+    px.  Integer per-frame disparities keep the ground truth exact
+    (``right(y) = left(y + d_t)`` by slicing, no resampling), while
+    consecutive frames stay close enough that forward-warping frame t-1's
+    disparity is a good init for frame t — exactly the property the
+    warm-start policy exploits.
+
+    ``frames`` is a list of ``(left, right, flow)`` with images (H, W, 3)
+    float32 in [0, 255] and ``flow`` the (H, W, 1) NEGATIVE disparity
+    (dataset sign convention, reference: core/stereo_datasets.py:77).
+    """
+
+    def __init__(self, n_frames=8, hw=(64, 96), d0=4.0, drift=0.5, pan=2,
+                 seed=0):
+        h, w = hw
+        rng = np.random.default_rng(seed)
+        ds = [int(round(d0 + drift * t)) for t in range(n_frames)]
+        assert all(d >= 1 for d in ds), (
+            f"disparity must stay >= 1 px over the sequence, got {ds}")
+        span = w + abs(pan) * (n_frames - 1) + max(ds) + 4
+        low = rng.uniform(0, 255, (h // 4 + 1, span // 4 + 2, 3))
+        tex = np.kron(low, np.ones((4, 4, 1)))[:h, :span]
+        self.frames = []
+        for t, d in enumerate(ds):
+            x0 = abs(pan) * t if pan >= 0 else abs(pan) * (n_frames - 1 - t)
+            left = tex[:, x0:x0 + w].astype(np.float32)
+            right = tex[:, x0 + d:x0 + d + w].astype(np.float32)
+            flow = np.full((h, w, 1), -float(d), np.float32)
+            self.frames.append((left, right, flow))
+
+    def __len__(self):
+        return len(self.frames)
+
+    def __getitem__(self, t):
+        return self.frames[t]
 
 
 def make_synthetic_kitti(root, n=6, hw=(120, 160), rng=None):

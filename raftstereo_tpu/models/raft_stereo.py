@@ -292,6 +292,19 @@ class RAFTStereo:
             lambda v, i1, i2: self.forward(v, i1, i2, iters=iters,
                                            test_mode=True))
 
+    def jitted_infer_init(self, iters: int = 32):
+        """Compiled warm-start test-mode forward:
+        (variables, img1, img2, flow_init) -> (low, up).
+
+        ``flow_init`` is a (B, H/factor, W/factor, 1) disparity field added
+        to the zero initialization, so passing zeros reproduces the plain
+        ``jitted_infer`` bitwise (tested) — one executable serves both the
+        cold and warm frames of a stream (the serving engine's warm-start
+        compile cache wraps this, serve/engine.py)."""
+        return jax.jit(
+            lambda v, i1, i2, f: self.forward(v, i1, i2, iters=iters,
+                                              flow_init=f, test_mode=True))
+
 
 def count_parameters(variables: Dict) -> int:
     """Total trainable parameter count (reference: evaluate_stereo.py:15-16)."""

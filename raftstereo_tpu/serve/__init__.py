@@ -18,6 +18,12 @@ Layers, bottom-up:
 
 Entry point: ``python -m raftstereo_tpu.cli.serve``; smoke benchmark:
 ``python bench.py --serve --quick``.
+
+Video streams ride the same engine: ``/predict`` with ``session_id``/
+``seq_no`` warm-starts each frame from the session's previous disparity
+through the engine's warm-start executables (``infer_stream_batch``),
+with per-stream state and the adaptive iteration ladder living in the
+``raftstereo_tpu.stream`` package (docs/streaming.md).
 """
 
 from .batcher import (  # noqa: F401

@@ -93,17 +93,27 @@ class ServeClient:
             return resp.status, resp.read()
 
     def predict(self, left: np.ndarray, right: np.ndarray,
-                iters: Optional[int] = None
+                iters: Optional[int] = None,
+                session_id: Optional[str] = None,
+                seq_no: Optional[int] = None
                 ) -> Tuple[np.ndarray, Dict]:
         """One stereo pair -> ((H, W) disparity, meta dict).
 
-        Raises ``ServeError`` on any non-200 status (503 = shed / 504 =
-        timeout are expected under overload; callers count them).
+        ``session_id`` marks the pair as a frame of a video stream: the
+        server warm-starts it from the session's previous frame
+        (docs/streaming.md).  ``seq_no`` is the frame's position in the
+        stream; omit it for an in-order client.  Raises ``ServeError`` on
+        any non-200 status (503 = shed / 504 = timeout are expected under
+        overload; callers count them).
         """
         payload = {"left": encode_array(np.asarray(left, np.float32)),
                    "right": encode_array(np.asarray(right, np.float32))}
         if iters is not None:
             payload["iters"] = int(iters)
+        if session_id is not None:
+            payload["session_id"] = str(session_id)
+            if seq_no is not None:
+                payload["seq_no"] = int(seq_no)
         status, body = self._request("POST", "/predict",
                                      json.dumps(payload).encode())
         data = json.loads(body)
@@ -129,6 +139,7 @@ def run_load(host: str, port: int,
              requests: int = 64, concurrency: int = 4,
              mode: str = "closed", rate: Optional[float] = None,
              iters: Optional[int] = None,
+             sequence_len: Optional[int] = None,
              timeout: float = 120.0) -> Dict:
     """Drive ``requests`` pairs at the server; returns a stats dict.
 
@@ -136,48 +147,86 @@ def run_load(host: str, port: int,
     exercise several compile buckets).  ``mode='open'`` requires ``rate``
     (requests/sec): send times are fixed at ``i / rate`` from start,
     regardless of completions.
+
+    ``sequence_len`` switches to SEQUENCE REPLAY (streaming traffic):
+    request ``i`` is frame ``i % sequence_len`` of session
+    ``loadgen-{i // sequence_len}``, sent with ``session_id``/``seq_no``
+    so the server warm-starts it.  Workers claim whole sequences (a
+    session's frames must arrive in order), and the stats grow
+    ``warm_frames``/``cold_frames`` from the response meta — a quick check
+    that warm starts actually engaged.
     """
     assert mode in ("closed", "open"), mode
     if mode == "open" and not rate:
         raise ValueError("open-loop load needs a rate (requests/sec)")
+    if sequence_len is not None:
+        assert sequence_len >= 1, sequence_len
+        if iters is not None:
+            raise ValueError("explicit iters cannot drive sequence replay "
+                             "(the server's controller owns per-frame "
+                             "iterations)")
     lat = LatencyHistogram()
     send_lag = LatencyHistogram()  # open loop: scheduled vs actual send
     counts = {"ok": 0, "shed": 0, "timeout": 0, "error": 0}
+    if sequence_len is not None:
+        counts["warm_frames"] = 0
+        counts["cold_frames"] = 0
     lock = threading.Lock()
     next_idx = [0]
     t_start = time.perf_counter()
+
+    def claim() -> Optional[int]:
+        """Next request index; sequence replay claims a whole sequence so
+        one worker owns a session's frames in order."""
+        stride = sequence_len or 1
+        with lock:
+            i = next_idx[0]
+            if i >= requests:
+                return None
+            next_idx[0] += stride
+            return i
 
     def worker():
         client = ServeClient(host, port, timeout=timeout)
         try:
             while True:
-                with lock:
-                    i = next_idx[0]
-                    if i >= requests:
-                        return
-                    next_idx[0] += 1
-                if mode == "open":
-                    delay = t_start + i / rate - time.perf_counter()
-                    if delay > 0:
-                        time.sleep(delay)
+                start = claim()
+                if start is None:
+                    return
+                stop = min(start + (sequence_len or 1), requests)
+                for i in range(start, stop):
+                    if mode == "open":
+                        delay = t_start + i / rate - time.perf_counter()
+                        if delay > 0:
+                            time.sleep(delay)
+                        else:
+                            send_lag.observe(-delay)
+                    left, right = make_pair(i)
+                    session = seq = None
+                    if sequence_len is not None:
+                        session = f"loadgen-{i // sequence_len}"
+                        seq = i % sequence_len
+                    t0 = time.perf_counter()
+                    try:
+                        _, meta = client.predict(left, right, iters=iters,
+                                                 session_id=session,
+                                                 seq_no=seq)
+                    except ServeError as e:
+                        kind = {503: "shed", 504: "timeout"}.get(e.status,
+                                                                 "error")
+                        with lock:
+                            counts[kind] += 1
+                    except Exception:
+                        with lock:
+                            counts["error"] += 1
                     else:
-                        send_lag.observe(-delay)
-                left, right = make_pair(i)
-                t0 = time.perf_counter()
-                try:
-                    client.predict(left, right, iters=iters)
-                except ServeError as e:
-                    kind = {503: "shed", 504: "timeout"}.get(e.status,
-                                                             "error")
-                    with lock:
-                        counts[kind] += 1
-                except Exception:
-                    with lock:
-                        counts["error"] += 1
-                else:
-                    lat.observe(time.perf_counter() - t0)
-                    with lock:
-                        counts["ok"] += 1
+                        lat.observe(time.perf_counter() - t0)
+                        with lock:
+                            counts["ok"] += 1
+                            if sequence_len is not None:
+                                key = ("warm_frames" if meta.get("warm")
+                                       else "cold_frames")
+                                counts[key] += 1
         finally:
             client.close()
 
@@ -195,6 +244,8 @@ def run_load(host: str, port: int,
         "pairs_per_sec": round(counts["ok"] / wall, 4) if wall else 0.0,
         **counts,
     }
+    if sequence_len is not None:
+        stats["sequence_len"] = sequence_len
     if rate:
         stats["offered_rate"] = rate
         # How far behind schedule sends fell (0 observations = on time):

@@ -50,8 +50,10 @@ class BatchEngine:
         self.variables = variables
         self.cfg = config
         self.metrics = metrics
-        self._fns: Dict[int, object] = {}  # iters -> jitted forward
-        self._compiled: Set[Tuple[int, int, int]] = set()  # (h, w, iters)
+        self._fns: Dict[object, object] = {}  # iters | ("stream", iters)
+        # Compiled keys: (h, w, iters) for the plain forward and
+        # (h, w, iters, "stream") for the warm-start (flow_init) forward.
+        self._compiled: Set[Tuple] = set()
         self._lock = threading.RLock()
         # Fine-grained lock for _compiled only: stat readers (/healthz)
         # must not block behind _lock, which is held across a whole device
@@ -76,7 +78,7 @@ class BatchEngine:
             return {"compiled": len(self._compiled)}
 
     @property
-    def compiled_keys(self) -> Set[Tuple[int, int, int]]:
+    def compiled_keys(self) -> Set[Tuple]:
         with self._stats_lock:
             return set(self._compiled)
 
@@ -84,6 +86,17 @@ class BatchEngine:
         """Whether (bucket, iters) already has a compiled executable."""
         with self._stats_lock:
             return (hw[0], hw[1], iters) in self._compiled
+
+    def is_stream_warm(self, hw: Tuple[int, int], iters: int) -> bool:
+        """Whether (bucket, iters) has a compiled WARM-START executable."""
+        with self._stats_lock:
+            return (hw[0], hw[1], iters, "stream") in self._compiled
+
+    def low_hw(self, hw: Tuple[int, int]) -> Tuple[int, int]:
+        """The 1/factor grid a padded bucket's disparity field lives on —
+        the shape of session state and of every ``flow_init``."""
+        f = self.model.config.factor
+        return hw[0] // f, hw[1] // f
 
     # -------------------------------------------------------------- execution
 
@@ -93,6 +106,16 @@ class BatchEngine:
                 lambda v, a, b, it=iters: self.model.forward(
                     v, a, b, iters=it, test_mode=True))
         return self._fns[iters]
+
+    def _stream_fn(self, iters: int):
+        """Warm-start forward: takes a (B, H/f, W/f, 1) flow_init.  Cold
+        frames pass zeros — bitwise-identical to the plain forward (tested
+        in tests/test_model.py / tests/test_stream.py), so one executable
+        per (bucket, level) serves every frame of a stream."""
+        key = ("stream", iters)
+        if key not in self._fns:
+            self._fns[key] = self.model.jitted_infer_init(iters)
+        return self._fns[key]
 
     def warmup(self, buckets=None, iters_list=None) -> List[Tuple[int, int,
                                                                   int]]:
@@ -121,14 +144,34 @@ class BatchEngine:
                 warmed.append(key)
         return warmed
 
-    def infer_batch(self, pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
-                    iters: int) -> List[np.ndarray]:
-        """Run a coalesced batch; returns one (H, W) disparity per pair.
+    def warmup_stream(self, buckets=None,
+                      ladder: Sequence[int] = ()) -> List[Tuple]:
+        """Compile the warm-start executables for every (bucket, ladder
+        level) before serving streams, so the adaptive controller can move
+        between levels mid-stream without ever stalling a session behind an
+        XLA compile.  Returns the (h, w, iters, "stream") keys warmed."""
+        buckets = list(buckets or self.cfg.buckets)
+        warmed = []
+        for h, w in buckets:
+            bh, bw = self.bucket_of((h, w, 3))
+            for iters in ladder:
+                key = (bh, bw, iters, "stream")
+                if key in self._compiled:
+                    continue
+                zero = np.zeros((h, w, 3), np.float32)
+                t0 = time.perf_counter()
+                self.infer_stream_batch([(zero, zero)], iters, [None])
+                logger.info("stream warmup: bucket %dx%d iters=%d compiled "
+                            "in %.1fs", bh, bw, iters,
+                            time.perf_counter() - t0)
+                warmed.append(key)
+        return warmed
 
-        All pairs must map to the same shape bucket (the batcher groups by
-        bucket before dispatching).  The batch axis is zero-padded to
-        ``max_batch_size`` so the compile cache is keyed by bucket alone.
-        """
+    def _pad_pairs(self, pairs):
+        """Shared shape policy: per-pair BucketPadder padding plus batch-
+        axis zero-padding to ``max_batch_size``, so the compile cache is
+        keyed by bucket alone.  All pairs must map to one bucket (the
+        batcher groups by bucket before dispatching)."""
         assert pairs, "empty batch"
         assert len(pairs) <= self.cfg.max_batch_size, (
             f"batch {len(pairs)} exceeds max_batch_size "
@@ -150,7 +193,15 @@ class BatchEngine:
         if pad_rows:
             i1 = jnp.pad(i1, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
             i2 = jnp.pad(i2, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
-        key = (hw[0], hw[1], iters)
+        return padders, hw, i1, i2, pad_rows
+
+    def _dispatch(self, key, call):
+        """Lock-serialized device dispatch with compile-cache bookkeeping:
+        runs ``call`` under the engine lock, fetches every output to host
+        (fetch = completion), records timing/metrics.  Returns
+        ``(host_outputs, included_compile)`` — the flag is per-call, not
+        read back from shared engine state, so concurrent callers cannot
+        race each other's compile accounting."""
         with self._lock:
             with self._stats_lock:
                 miss = key not in self._compiled
@@ -158,13 +209,63 @@ class BatchEngine:
                 (self.metrics.compile_misses if miss
                  else self.metrics.compile_hits).inc()
             start = time.perf_counter()
-            _, flow_up = self._fn(iters)(self.variables, i1, i2)
-            flow_up = np.asarray(flow_up, np.float32)  # host fetch = done
+            out = [np.asarray(o, np.float32) for o in call()]
             self.last_batch_runtime = time.perf_counter() - start
             self.last_included_compile = miss
             with self._stats_lock:
                 self._compiled.add(key)
         if self.metrics is not None and not miss:
             self.metrics.batch_latency.observe(self.last_batch_runtime)
+        return out, miss
+
+    def infer_batch(self, pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
+                    iters: int) -> List[np.ndarray]:
+        """Run a coalesced batch; returns one (H, W) disparity per pair."""
+        padders, hw, i1, i2, _ = self._pad_pairs(pairs)
+        key = (hw[0], hw[1], iters)
+        (flow_up,), _ = self._dispatch(
+            key, lambda: [self._fn(iters)(self.variables, i1, i2)[1]])
         return [padder.unpad(flow_up[i:i + 1])[0, ..., 0]
+                for i, padder in enumerate(padders)]
+
+    def infer_stream_batch(self, pairs: Sequence[Tuple[np.ndarray,
+                                                       np.ndarray]],
+                           iters: int,
+                           flow_inits: Sequence[Optional[np.ndarray]]
+                           ) -> List[Tuple[np.ndarray, np.ndarray, bool]]:
+        """Warm-start batch: per pair an optional low-res ``flow_init``
+        ((H/f, W/f) at the padded bucket shape; None = cold, zeros are
+        substituted so the batch always runs the same executable).
+
+        Returns one ``(disparity, disp_low, included_compile)`` per pair:
+        the unpadded full-resolution (H, W) disparity, the PADDED 1/factor
+        field — the session state a stream forward-warps into the next
+        frame's ``flow_init`` (kept padded so it is already at the shape
+        the next dispatch needs) — and whether this call paid the XLA
+        compile.  Same bucket/batch-pad policy as ``infer_batch``.
+        """
+        assert len(pairs) == len(flow_inits), (len(pairs), len(flow_inits))
+        padders, hw, i1, i2, pad_rows = self._pad_pairs(pairs)
+        lh, lw = self.low_hw(hw)
+        inits = []
+        for init in flow_inits:
+            if init is None:
+                init = np.zeros((lh, lw), np.float32)
+            init = np.asarray(init, np.float32)
+            assert init.shape == (lh, lw), (
+                f"flow_init {init.shape} != low-res bucket shape "
+                f"{(lh, lw)} (bucket {hw}, factor "
+                f"{self.model.config.factor})")
+            inits.append(jnp.asarray(init)[None, :, :, None])
+        fi = jnp.concatenate(inits, axis=0)
+        if pad_rows:
+            fi = jnp.pad(fi, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+        key = (hw[0], hw[1], iters, "stream")
+        (low, up), miss = self._dispatch(
+            key, lambda: self._stream_fn(iters)(self.variables, i1, i2, fi))
+        # .copy(): the low-res slice becomes long-lived session state; a
+        # view would pin the whole (max_batch_size, ...) batch array in the
+        # session store for its TTL.
+        return [(padder.unpad(up[i:i + 1])[0, ..., 0],
+                 low[i, :, :, 0].copy(), miss)
                 for i, padder in enumerate(padders)]

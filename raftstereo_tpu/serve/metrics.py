@@ -151,6 +151,29 @@ class ServeMetrics:
         self.batch_latency = r.histogram(
             "serve_batch_latency_seconds",
             "engine wall-clock per dispatched batch (forward + host fetch)")
+        # Temporal warm-start streaming (stream/, docs/streaming.md).
+        self.stream_active = r.gauge(
+            "stream_sessions_active", "live sessions in the session store")
+        self.stream_warm_frames = r.counter(
+            "stream_warm_frames_total",
+            "frames warm-started from the previous frame's disparity")
+        self.stream_cold_frames = r.counter(
+            "stream_cold_frames_total",
+            "frames run cold (new/expired/evicted/out-of-sequence session "
+            "or controller cold reset)")
+        self.stream_evicted = r.counter(
+            "stream_sessions_evicted_total",
+            "sessions LRU-evicted because the store hit session_limit")
+        self.stream_expired = r.counter(
+            "stream_sessions_expired_total",
+            "sessions dropped after idling past session_ttl_s")
+        self.stream_frame_iters = r.histogram(
+            "stream_frame_iters", "GRU iterations run per streamed frame",
+            bounds=(1, 2, 4, 8, 12, 16, 24, 32, 48, 64))
+        self.stream_frame_latency = r.histogram(
+            "stream_frame_latency_seconds",
+            "per-frame wall-clock (warp + forward + host fetch), "
+            "compile-free frames only")
 
     def render(self) -> str:
         return self.registry.render()

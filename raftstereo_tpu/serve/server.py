@@ -84,13 +84,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         srv: "StereoServer" = self.server
         if self.path == "/healthz":
-            self._json(200, {
+            health = {
                 "status": "ok",
                 "queue_depth": srv.batcher.queue_depth,
                 "compiled_buckets": sorted(srv.engine.compiled_keys),
                 "max_batch_size": srv.config.max_batch_size,
                 "iters": srv.config.iters,
-            })
+            }
+            if srv.stream is not None:
+                health["stream"] = {
+                    "ladder": list(srv.config.stream.ladder),
+                    "sessions_active": len(srv.stream.store),
+                    "session_limit": srv.config.stream.session_limit,
+                }
+            self._json(200, health)
         elif self.path == "/metrics":
             self._send(200, srv.metrics.render().encode(),
                        "text/plain; version=0.0.4")
@@ -127,6 +134,8 @@ class _Handler(BaseHTTPRequestHandler):
                 left = decode_array(payload["left"])
                 right = decode_array(payload["right"])
                 iters = payload.get("iters")
+                session_id = payload.get("session_id")
+                seq_no = payload.get("seq_no")
             except Exception as e:
                 self._json(400, {"error": f"bad request: {e}"})
                 return
@@ -141,6 +150,30 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError(
                     f"image side {max(left.shape[:2])} exceeds "
                     f"max_image_dim {srv.config.max_image_dim}")
+            if session_id is not None:
+                # Streaming frame: validated here, then dispatched outside
+                # this block (the session path bypasses the micro-batcher).
+                if srv.stream is None:
+                    raise ValueError(
+                        "streaming disabled on this server (start with a "
+                        "stream config / without --no_stream)")
+                if iters is not None:
+                    raise ValueError(
+                        "iters cannot be combined with session_id: the "
+                        "adaptive controller owns per-frame iterations "
+                        "(configure --stream_ladder)")
+                session_id = str(session_id)
+                if seq_no is not None:
+                    seq_no = int(seq_no)
+                if not srv.config.cold_buckets:
+                    hw = srv.engine.bucket_of(left.shape)
+                    missing = [lv for lv in srv.config.stream.ladder
+                               if not srv.engine.is_stream_warm(hw, lv)]
+                    if missing:
+                        raise ValueError(
+                            f"shape {tuple(left.shape[:2])} -> bucket {hw} "
+                            f"stream levels {missing} not warmed; configure "
+                            f"--buckets and --stream_warmup")
             if iters is not None:
                 # Only the configured (warmed) iteration levels: arbitrary
                 # client values would each compile a fresh executable under
@@ -151,10 +184,11 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError(
                         f"iters {iters} not served; choose from "
                         f"{sorted(allowed)}")
-            if not srv.config.cold_buckets:
-                # Production setting: shapes outside the warmed buckets
-                # are rejected up front — an on-demand compile would stall
-                # every queued request behind it.
+            if session_id is None and not srv.config.cold_buckets:
+                # Production setting (plain requests; session frames have
+                # their own stream-executable check above): shapes outside
+                # the warmed buckets are rejected up front — an on-demand
+                # compile would stall every queued request behind it.
                 hw = srv.engine.bucket_of(left.shape)
                 want = iters if iters is not None else srv.config.iters
                 if not srv.engine.is_warm(hw, want):
@@ -164,6 +198,42 @@ class _Handler(BaseHTTPRequestHandler):
                         f"--buckets")
         except Exception as e:
             self._json(400, {"error": f"bad request: {e}"})
+            return
+        if session_id is not None:
+            # Session frames bypass the micro-batcher: ordering within a
+            # session is the point (frame N warm-starts from N-1), so they
+            # serialize on the session lock and then the engine lock.
+            # Admission control still applies — queue_limit bounds the
+            # frames waiting on those locks, so a slow batch or compile
+            # sheds stream traffic with 503s (holding decoded arrays in
+            # unboundedly many blocked handler threads would grow host
+            # RSS exactly like the unbounded queue the plain path rejects).
+            with srv.stream_inflight_lock:
+                if srv.stream_inflight >= srv.config.queue_limit:
+                    srv.metrics.shed.inc()
+                    self._json(503, {"error": "overloaded",
+                                     "detail": f"stream frames in flight "
+                                               f">= queue_limit "
+                                               f"{srv.config.queue_limit}"},
+                               {"Retry-After": "1"})
+                    return
+                srv.stream_inflight += 1
+            try:
+                res = srv.stream.step(session_id, seq_no, left, right)
+            except Exception as e:
+                self._json(500, {"error": f"inference failed: {e}"})
+                return
+            finally:
+                with srv.stream_inflight_lock:
+                    srv.stream_inflight -= 1
+            self._json(200, {
+                "disparity": encode_array(res.disparity),
+                "meta": {"session_id": res.session_id, "seq_no": res.seq_no,
+                         "frame_idx": res.frame_idx, "iters": res.iters,
+                         "warm": res.warm,
+                         "update_ema": round(res.update_ema, 4),
+                         "latency_ms": round(res.latency_s * 1e3, 3)},
+            })
             return
         # Size the HTTP-side wait for what can actually be ahead of this
         # request: one in-flight batch (60 s) — or a cold XLA compile,
@@ -216,11 +286,18 @@ class StereoServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, config: ServeConfig, engine: BatchEngine,
-                 batcher: DynamicBatcher, metrics: ServeMetrics):
+                 batcher: DynamicBatcher, metrics: ServeMetrics,
+                 stream=None):
         self.config = config
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
+        self.stream = stream  # stream.runner.StreamRunner or None
+        # Admission control for the session path (which bypasses the
+        # batcher queue): frames concurrently decoded-and-waiting on the
+        # session/engine locks, shed with 503 beyond queue_limit.
+        self.stream_inflight = 0
+        self.stream_inflight_lock = threading.Lock()
         # Caps the number of request bodies being buffered/decoded at
         # once (each transiently costs ~3x its size); excess connections
         # queue on the semaphore instead of multiplying host RSS.
@@ -250,10 +327,19 @@ def build_server(model, variables, config: ServeConfig,
     engine = BatchEngine(model, variables, config, metrics)
     if config.warmup:
         engine.warmup()
+    stream = None
+    if config.stream is not None:
+        from ..stream.runner import StreamRunner  # local: avoids an
+        # import cycle (stream.runner's engine builder imports this pkg)
+        stream = StreamRunner(engine, config.stream, metrics)
+        if config.stream_warmup:
+            engine.warmup_stream(ladder=config.stream.ladder)
     batcher = DynamicBatcher(engine, config, metrics).start()
-    server = StereoServer(config, engine, batcher, metrics)
-    logger.info("serving on %s:%d (buckets=%s, max_batch=%d, iters=%d/%d)",
+    server = StereoServer(config, engine, batcher, metrics, stream=stream)
+    logger.info("serving on %s:%d (buckets=%s, max_batch=%d, iters=%d/%d, "
+                "stream=%s)",
                 config.host, server.port,
                 sorted(engine.compiled_keys) or "lazy",
-                config.max_batch_size, config.iters, config.degraded_iters)
+                config.max_batch_size, config.iters, config.degraded_iters,
+                list(config.stream.ladder) if config.stream else "off")
     return server

@@ -1,0 +1,70 @@
+"""Adaptive iteration controller: pick each warm frame's GRU iteration
+count from a small fixed ladder of pre-compiled levels.
+
+RAFT-Stereo's refinement makes iteration count a smooth quality/latency
+knob (the serving layer already exploits it for load shedding —
+serve/batcher.py); for video the right count per frame depends on how much
+the scene MOVED.  The observable is the update magnitude: mean
+|refined disparity - warm-start init| at 1/factor resolution, i.e. how far
+the GRU had to move the forward-warped previous estimate.  An EMA of that
+signal steers a ladder index:
+
+* EMA > ``promote_threshold``  -> the warp is lagging the scene, climb to a
+  higher iteration level next frame;
+* EMA < ``demote_threshold``   -> near-static scene, descend a level;
+* EMA > ``cold_reset_threshold`` -> the warm start is not tracking at all
+  (scene cut, fast motion, bad warp): the next frame re-runs COLD at
+  ``ladder[0]`` with a zero init and the stream re-converges.
+
+Levels are indices into ``StreamConfig.ladder``: index 0 is the cold/full
+count, warm frames use indices >= 1 only — the config asserts
+``ladder[1] <= ladder[0] / 2``, so every warm frame costs at most half a
+cold frame.  Decisions are pure functions of (level, EMA), which is what
+makes the HTTP session path and the offline ``cli/stream.py`` runner
+bit-reproducible against each other (docs/streaming.md).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..config import StreamConfig
+
+__all__ = ["AdaptiveIterController"]
+
+
+class AdaptiveIterController:
+    """Deterministic ladder walker over ``StreamConfig`` thresholds."""
+
+    def __init__(self, cfg: StreamConfig):
+        self.cfg = cfg
+
+    @property
+    def cold_iters(self) -> int:
+        return self.cfg.ladder[0]
+
+    @property
+    def first_warm_level(self) -> int:
+        """Ladder index a stream starts warm frames at (after any cold
+        frame): the highest warm level, so a fresh stream converges before
+        the controller is allowed to demote it."""
+        return 1
+
+    def warm_iters(self, level: int) -> int:
+        return self.cfg.ladder[level]
+
+    def update_ema(self, ema: float, delta: float) -> float:
+        d = self.cfg.ema_decay
+        return d * ema + (1.0 - d) * delta
+
+    def next_level(self, level: int, ema: float) -> Tuple[int, bool]:
+        """(next warm level, force_cold) after a warm frame at ``level``."""
+        cfg = self.cfg
+        last = len(cfg.ladder) - 1
+        if ema > cfg.cold_reset_threshold:
+            return self.first_warm_level, True
+        if ema > cfg.promote_threshold:
+            return max(1, level - 1), False
+        if ema < cfg.demote_threshold:
+            return min(last, level + 1), False
+        return level, False

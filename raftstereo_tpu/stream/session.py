@@ -1,0 +1,117 @@
+"""Per-stream session state and its bounded store.
+
+A :class:`Session` is everything the warm-start policy carries between the
+frames of one video stream: the previous frame's low-resolution disparity
+(kept at the PADDED bucket's 1/factor grid, so it is already the shape the
+next dispatch's ``flow_init`` needs), the next expected sequence number, the
+EMA of the per-frame update magnitude that drives the adaptive iteration
+controller, and the controller's current ladder level.
+
+The :class:`SessionStore` is deliberately forgiving: hitting the session
+limit evicts the least-recently-used session, and an idle session past its
+TTL expires — in both cases the client's next frame simply runs COLD (full
+iterations, zero init) and re-establishes state.  Losing a session is a
+performance event, never a correctness error, so the store never raises at
+a client.  Evictions/expirations/active count are exported through
+``ServeMetrics`` (``/metrics``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Session", "SessionStore"]
+
+
+@dataclasses.dataclass
+class Session:
+    """Warm-start state for one stream (mutated under ``lock``)."""
+
+    sid: str
+    last_used: float = 0.0
+    next_seq: int = 0
+    frame_idx: int = 0
+    # Previous frame's disparity at the padded bucket's 1/factor grid
+    # ((H/f, W/f) float32, dataset sign convention); None until the first
+    # frame completes.
+    prev_disp_low: Optional[np.ndarray] = None
+    bucket_hw: Optional[Tuple[int, int]] = None
+    # EMA of mean |refined - warm-start init| (low-res px) and the
+    # controller's current ladder level for the NEXT warm frame.
+    ema: float = 0.0
+    level: int = 1
+    # Set by the controller when the EMA says the warm start lost the
+    # scene: the next frame re-runs cold even though state exists.
+    force_cold: bool = False
+    warm_frames: int = 0
+    cold_frames: int = 0
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+
+
+class SessionStore:
+    """Bounded LRU + TTL map of ``session_id -> Session``.
+
+    ``now_fn`` is injectable so TTL tests don't sleep.  Thread-safe: the
+    store lock covers only lookup/eviction bookkeeping; per-frame work
+    serializes on each session's own lock (two frames of one session never
+    interleave, while different sessions only contend on the engine).
+    """
+
+    def __init__(self, limit: int, ttl_s: float, metrics=None,
+                 now_fn=time.monotonic):
+        assert limit >= 1, limit
+        self.limit = limit
+        self.ttl_s = ttl_s
+        self.metrics = metrics
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self._sessions: "collections.OrderedDict[str, Session]" = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def get_or_create(self, sid: str) -> Tuple[Session, bool]:
+        """Return ``(session, created)``, touching LRU order.
+
+        An expired session is dropped and replaced by a fresh one
+        (``created=True`` — the caller runs the frame cold); exceeding the
+        limit evicts the least-recently-used session.  Never raises.
+        """
+        with self._lock:
+            now = self._now()
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                if now - sess.last_used > self.ttl_s:
+                    del self._sessions[sid]
+                    if self.metrics is not None:
+                        self.metrics.stream_expired.inc()
+                    sess = None
+                else:
+                    sess.last_used = now
+                    self._sessions.move_to_end(sid)
+                    return sess, False
+            sess = Session(sid, last_used=now)
+            self._sessions[sid] = sess
+            while len(self._sessions) > self.limit:
+                self._sessions.popitem(last=False)
+                if self.metrics is not None:
+                    self.metrics.stream_evicted.inc()
+            if self.metrics is not None:
+                self.metrics.stream_active.set(len(self._sessions))
+            return sess, True
+
+    def drop(self, sid: str) -> bool:
+        """Explicitly end a session; True if it existed."""
+        with self._lock:
+            existed = self._sessions.pop(sid, None) is not None
+            if self.metrics is not None:
+                self.metrics.stream_active.set(len(self._sessions))
+            return existed

@@ -21,6 +21,26 @@ TINY = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
             corr_radius=2)
 
 
+class TestHelpRegression:
+    """Every subcommand must exit 0 on --help: argparse wiring (flag
+    groups, shared config builders, new subcommands) breaks at collection
+    speed instead of in production.  In-process: a subprocess per command
+    would pay ~10 s of fresh jax import each for no extra coverage."""
+
+    SUBCOMMANDS = ["train", "evaluate", "demo", "serve", "convert",
+                   "sl_smoke", "stream"]
+
+    @pytest.mark.parametrize("name", SUBCOMMANDS)
+    def test_help_exits_zero(self, name, capsys):
+        import importlib
+
+        mod = importlib.import_module(f"raftstereo_tpu.cli.{name}")
+        with pytest.raises(SystemExit) as ei:
+            mod.main(["--help"])
+        assert ei.value.code == 0
+        assert "usage" in capsys.readouterr().out.lower()
+
+
 class TestViz:
     def test_jet_endpoints(self):
         out = jet(np.array([0.0, 0.5, 1.0]))

@@ -59,6 +59,26 @@ class TestForward:
                           test_mode=True)[0]
         assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-3
 
+    @pytest.mark.parametrize("iters", [2, 8])
+    def test_flow_init_zeros_bitwise_matches_none(self, default_model, rng,
+                                                  iters):
+        """Warm-start plumbing is a NO-OP at zero init: the flow_init=zeros
+        forward must be bitwise-identical to flow_init=None through the
+        lax.scan path at multi-iteration (serving-scale) counts — the
+        property that lets cold stream frames share the warm-start
+        executables (stream/, serve/engine.py).  The compiled-path twin
+        (separate jitted executables, engine-level) lives in
+        tests/test_stream.py."""
+        model, variables = default_model
+        i1, i2 = make_images(rng)
+        zeros = jnp.zeros((1, 16, 24, 1))
+        low_a, up_a = model.forward(variables, i1, i2, iters=iters,
+                                    test_mode=True)
+        low_b, up_b = model.forward(variables, i1, i2, iters=iters,
+                                    flow_init=zeros, test_mode=True)
+        np.testing.assert_array_equal(np.asarray(low_a), np.asarray(low_b))
+        np.testing.assert_array_equal(np.asarray(up_a), np.asarray(up_b))
+
     def test_jit_compiles_and_matches_eager(self, default_model, rng):
         model, variables = default_model
         i1, i2 = make_images(rng)
