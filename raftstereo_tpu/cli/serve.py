@@ -113,7 +113,9 @@ def main(argv=None) -> int:
 
     server = build_server(model, variables, serve_cfg)
     print(json.dumps({"serving": f"http://{serve_cfg.host}:{server.port}",
-                      "endpoints": ["/predict", "/metrics", "/healthz"]}),
+                      "endpoints": ["/predict", "/metrics", "/healthz",
+                                    "/debug/trace", "/debug/profile",
+                                    "/debug/threads", "/debug/vars"]}),
           flush=True)
     try:
         server.serve_forever()

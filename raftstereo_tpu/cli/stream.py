@@ -58,6 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batch-axis padding; match the server's value for "
                         "bitwise serve parity (XLA numerics are only "
                         "identical at identical program shapes)")
+    p.add_argument("--trace_out", default=None, metavar="PATH",
+                   help="write the run's warp/forward spans as Chrome "
+                        "trace-event JSON (open at ui.perfetto.dev; "
+                        "docs/observability.md)")
     add_stream_args(p)
     add_model_args(p)
     return p
@@ -91,7 +95,17 @@ def main(argv=None) -> int:
                                  max_batch_size=args.max_batch_size,
                                  divis_by=args.divis_by,
                                  bucket_multiple=args.bucket_multiple)
-    report = compare_warm_cold(engine, seq.frames, stream_cfg)
+    tracer = None
+    if args.trace_out:
+        from ..obs import Tracer
+        tracer = Tracer(capacity=max(16 * args.frames, 1024))
+    report = compare_warm_cold(engine, seq.frames, stream_cfg,
+                               tracer=tracer)
+    if tracer is not None:
+        with open(args.trace_out, "w") as f:
+            f.write(tracer.export_json())
+        logger.info("wrote %d spans to %s (open at ui.perfetto.dev)",
+                    len(tracer.spans()), args.trace_out)
     print(json.dumps({"summary": report["summary"],
                       "warm": report["warm"], "cold": report["cold"]}))
     return 0

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import collections
+import dataclasses
 import logging
 import os
 import statistics
@@ -71,6 +72,17 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    metavar=("START", "STOP"),
                    help="capture an XLA profiler trace of steps [START, STOP)"
                         " into runs/<name>/profile (view in TensorBoard)")
+    g.add_argument("--metrics_port", type=int, default=None,
+                   help="serve train telemetry over HTTP on this port "
+                        "(/metrics Prometheus scrape, /debug/trace span "
+                        "export, /debug/threads, /debug/vars) so long runs "
+                        "are observable without the JSONL file; 0 binds an "
+                        "ephemeral port (docs/observability.md)")
+    g.add_argument("--metrics_host", default="127.0.0.1",
+                   help="interface the telemetry exporter binds; the "
+                        "default stays loopback-only because /debug/threads "
+                        "and /debug/vars expose stacks and resolved paths "
+                        "— set 0.0.0.0 deliberately for a remote scraper")
     g.add_argument("--nan_policy", choices=["abort", "skip"], default="abort",
                    help="non-finite loss/grad: abort (reference assert "
                         "semantics) or skip the update and continue")
@@ -136,16 +148,30 @@ def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
 def train(model_cfg, cfg: TrainConfig, dataset=None,
           num_workers=None, no_validation: bool = False,
           dataset_root=None, profile_steps=None,
-          fault_plan=None) -> "TrainState":  # noqa: F821
+          fault_plan=None, metrics_port=None,
+          metrics_host="127.0.0.1") -> "TrainState":  # noqa: F821
     """The training loop; returns the final state.  ``dataset`` injection
     lets tests run the full loop on synthetic data; ``fault_plan``
     (default: the ``RAFTSTEREO_FAULTS`` env var) injects deterministic
-    failures for chaos testing (utils/faults.py)."""
+    failures for chaos testing (utils/faults.py).  ``metrics_port`` mounts
+    the opt-in telemetry exporter (obs/, docs/observability.md)."""
     import jax
+
+    from ..obs import Tracer, TelemetryServer
+    from ..train.telemetry import TrainMetrics
 
     np.random.seed(cfg.seed)
     plan = FaultPlan.from_env() if fault_plan is None else fault_plan
     guard = PreemptionGuard().install()
+
+    # Always-on phase tracing (bounded ring, microseconds per span) +
+    # the metrics bundle; the HTTP exporter mounts later, once setup has
+    # validated (starting it here would leak the socket when e.g. the
+    # batch-size/mesh check below raises before the loop's finally).
+    tracer = Tracer(capacity=4096)
+    tmetrics = TrainMetrics()
+    run_trace = tracer.new_trace_id()
+    telemetry = None
 
     model = RAFTStereo(model_cfg)
     tx, schedule = make_optimizer(cfg)
@@ -287,7 +313,14 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
     saved_steps = set()
 
     def save_ckpt(step, state, wait=False):
+        t0 = time.perf_counter()
         manager.save(step, state, wait=wait)
+        t1 = time.perf_counter()
+        # wait=False saves measure the async dispatch; wait=True (boundary
+        # and final saves) the full write.
+        tracer.record("checkpoint", t0, t1, run_trace,
+                      attrs={"step": step, "wait": wait})
+        tmetrics.checkpoint_seconds.observe(t1 - t0)
         saved_steps.add(step)
 
     def save_boundary(step, state):
@@ -314,6 +347,8 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
         step_times.append(dt)
         return flagged
 
+    _EPOCH_DONE = object()
+
     def run_loop(state):
         """Returns (state, preempted)."""
         total_steps = int(state.step)
@@ -322,8 +357,18 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
             # Prefetch: the host->HBM copy (and mesh sharding) of the next
             # batch overlaps the current step's compute — the TPU analogue
             # of the reference's pin_memory loader (core/stereo_datasets.py:311).
-            for batch in prefetch_to_device(loader, size=2,
-                                            devices=batch_sharded(mesh)):
+            batches = iter(prefetch_to_device(loader, size=2,
+                                              devices=batch_sharded(mesh)))
+            while True:
+                # Explicit next(): the wait for the prefetched batch IS the
+                # data-starvation signal (span + train_data_wait_seconds).
+                t_d0 = time.perf_counter()
+                batch = next(batches, _EPOCH_DONE)
+                t_d1 = time.perf_counter()
+                if batch is _EPOCH_DONE:
+                    break
+                tracer.record("data_wait", t_d0, t_d1, run_trace,
+                              attrs={"step": total_steps + 1})
                 # The watchdog clock starts before the fault hooks so an
                 # injected slow@step is measured like a real stall.
                 t0 = time.monotonic()
@@ -344,15 +389,28 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                         "preemption: checkpoint at step %d written; exiting "
                         "cleanly", total_steps)
                     return state, True
+                in_xla_window = (prof.enabled
+                                 and prof.start <= total_steps < prof.stop)
+                t_s0 = time.perf_counter()
                 with prof.step(total_steps):
                     state, metrics = step_fn(state, batch)
                 total_steps += 1
                 # float() blocks on the device result, so dt covers the
                 # actual step execution, not just its dispatch.
                 metrics = {k: float(v) for k, v in metrics.items()}
+                t_s1 = time.perf_counter()
+                # xla_profile cross-references this span with the
+                # StepProfiler capture it overlapped, so the host-side
+                # phase trace and the XLA device trace line up in Perfetto.
+                tracer.record("step", t_s0, t_s1, run_trace,
+                              attrs={"step": total_steps,
+                                     "xla_profile": in_xla_window})
+                tmetrics.observe_step(step_s=t_s1 - t_s0,
+                                      data_s=t_d1 - t_d0)
                 health = loader.health_metrics()
                 health["watchdog_slow"] = watchdog(time.monotonic() - t0,
                                                    total_steps)
+                tmetrics.observe_health(health)
                 if metrics.pop("nonfinite", 0.0) >= 0.5:
                     if cfg.nan_policy == "abort":
                         # Reference assert semantics (train_stereo.py:49-52).
@@ -360,6 +418,7 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                             f"non-finite loss/gradient at step {total_steps}")
                     logger.warning("step %d: non-finite loss/gradient — "
                                    "update skipped", total_steps)
+                    tmetrics.skipped.inc()
                     # Don't push the NaN metrics: one skipped step would turn
                     # the whole running-mean window NaN.  Record the skip.
                     metrics_logger.push({"skipped": 1.0, **health})
@@ -395,6 +454,14 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
     preempted = False
     restarts_np = 0
     last_resume_step = int(state.step)
+    if metrics_port is not None:
+        telemetry = TelemetryServer(
+            tmetrics.registry, tracer,
+            vars_fn=lambda: {"config": dataclasses.asdict(cfg),
+                             "model_config": dataclasses.asdict(model_cfg)},
+            host=metrics_host, port=metrics_port).start()
+        logger.info("telemetry exporter on %s:%d", metrics_host,
+                    telemetry.port)
     try:
         while True:
             try:
@@ -437,6 +504,8 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
         # raised inside the step itself).
         prof.close()
         guard.uninstall()
+        if telemetry is not None:
+            telemetry.close()
 
     if preempted:
         # The boundary checkpoint is already on disk (save_boundary waited);
@@ -466,7 +535,8 @@ def main(argv=None) -> int:
     train(model_config_from_args(args), train_config_from_args(args),
           num_workers=args.num_workers, no_validation=args.no_validation,
           dataset_root=args.dataset_root, profile_steps=args.profile_steps,
-          fault_plan=plan)
+          fault_plan=plan, metrics_port=args.metrics_port,
+          metrics_host=args.metrics_host)
     return 0
 
 

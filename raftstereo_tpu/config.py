@@ -283,6 +283,11 @@ class ServeConfig:
     stream: Optional[StreamConfig] = None
     stream_warmup: bool = False
 
+    # Observability (obs/, docs/observability.md): capacity of the span
+    # ring buffer behind /debug/trace.  Spans are a few hundred bytes; the
+    # ring bounds memory no matter the traffic.
+    trace_buffer: int = 4096
+
     def __post_init__(self):
         if isinstance(self.buckets, list):
             object.__setattr__(
@@ -301,6 +306,7 @@ class ServeConfig:
         assert self.max_wait_ms >= 0, self.max_wait_ms
         assert self.divis_by >= 1 and self.bucket_multiple >= 1
         assert self.max_body_mb > 0 and self.max_image_dim >= 1
+        assert self.trace_buffer >= 1, self.trace_buffer
 
 
 def _parse_bucket(text: str) -> Tuple[int, int]:
@@ -353,6 +359,9 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
                    help="reject shapes whose bucket was not warmed at "
                         "startup instead of compiling on demand (recommended "
                         "in production: a compile stalls everyone queued)")
+    g.add_argument("--trace_buffer", type=int, default=d.trace_buffer,
+                   help="span ring-buffer capacity behind /debug/trace "
+                        "(docs/observability.md)")
 
 
 def add_stream_args(parser: argparse.ArgumentParser) -> None:
@@ -420,6 +429,7 @@ def serve_config_from_args(args: argparse.Namespace,
         max_body_mb=args.max_body_mb,
         max_image_dim=args.max_image_dim,
         cold_buckets=not args.no_cold_buckets,
+        trace_buffer=args.trace_buffer,
     )
 
 

@@ -1,0 +1,29 @@
+"""Tracing + telemetry subsystem (docs/observability.md).
+
+Dependency-free observability shared by all three workloads:
+
+* ``trace``    — nested, thread-safe spans in a bounded ring buffer,
+                 exportable as Chrome trace-event / Perfetto JSON.  The
+                 serve path traces every request (admission → queue wait →
+                 dispatch → host fetch, keyed by ``X-Request-Id``), the
+                 stream path traces warp → forward per frame, the train
+                 loop traces data-wait / step / checkpoint phases.
+* ``prom``     — Prometheus text-exposition validator + metric-name lint
+                 (``scripts/check_metrics.py``), keeping the hand-rolled
+                 render scrapeable.
+* ``exporter`` — the train-side ``--metrics_port`` HTTP exporter and the
+                 debug-endpoint helpers (thread dump, build info, trace
+                 download) the serving front-end shares.
+
+The instruments themselves (Counter/Gauge/label families/histograms) live
+in ``serve/metrics.py``; this package is everything around them.
+"""
+
+from .exporter import (  # noqa: F401
+    TelemetryServer,
+    build_info,
+    dump_threads,
+    trace_response,
+)
+from .prom import lint_registry, parse_sample, validate_prometheus  # noqa: F401
+from .trace import Span, Tracer, to_chrome_trace  # noqa: F401

@@ -1,0 +1,183 @@
+"""Telemetry HTTP exporter + shared debug-endpoint plumbing.
+
+``TelemetryServer`` is the small stdlib HTTP server the train CLI mounts
+with ``--metrics_port``: long TPU runs expose the same ``MetricsRegistry``
+render a scraper expects (``/metrics``), the span ring as a Perfetto
+download (``/debug/trace``), an all-thread stack dump (``/debug/threads``)
+and resolved config + build info (``/debug/vars``) — instead of being
+observable only through the JSONL log on disk.
+
+The serving front-end (serve/server.py) mounts the SAME debug surface on
+its own handler; the formatting helpers here (``dump_threads``,
+``build_info``, ``trace_response``) are shared so both speak one format.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TelemetryServer", "build_info", "dump_threads",
+           "trace_response"]
+
+_STARTED_AT = time.time()
+
+
+def build_info() -> Dict:
+    """Process/build identification for ``/debug/vars``."""
+    info = {
+        "python": sys.version.split()[0],
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "started_unix": round(_STARTED_AT, 3),
+        "uptime_s": round(time.time() - _STARTED_AT, 3),
+    }
+    for mod in ("jax", "jaxlib", "numpy", "flax", "optax"):
+        m = sys.modules.get(mod)
+        if m is not None:
+            info[f"{mod}_version"] = getattr(m, "__version__", "?")
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            info["jax_backend"] = jax.default_backend()
+            info["jax_device_count"] = jax.device_count()
+        except Exception:  # backend not initialized yet — fine
+            pass
+    return info
+
+
+def dump_threads() -> str:
+    """Stack dump of every live thread (``/debug/threads``) — the
+    post-mortem for 'the server stopped answering': which thread holds
+    which lock, where the batcher worker is parked."""
+    frames = sys._current_frames()
+    names = {t.ident: t for t in threading.enumerate()}
+    parts = []
+    for ident, frame in sorted(frames.items()):
+        t = names.get(ident)
+        label = t.name if t is not None else "?"
+        daemon = " daemon" if (t is not None and t.daemon) else ""
+        parts.append(f"--- thread {label} (ident {ident}{daemon}) ---")
+        parts.append("".join(traceback.format_stack(frame)).rstrip())
+        parts.append("")
+    return "\n".join(parts) + "\n"
+
+
+def trace_response(tracer, query: str) -> Tuple[bytes, Dict[str, str]]:
+    """Body + headers for ``GET /debug/trace[?last=N]``: Chrome
+    trace-event JSON served as a download Perfetto opens directly."""
+    qs = parse_qs(query or "")
+    last = None
+    if "last" in qs:
+        last = max(int(qs["last"][0]), 0)
+    trace_id = qs.get("trace_id", [None])[0]
+    body = tracer.export_json(last=last, trace_id=trace_id).encode()
+    return body, {"Content-Disposition":
+                  'attachment; filename="trace.json"'}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "raftstereo-telemetry/1.0"
+
+    def log_message(self, fmt, *args):
+        logger.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              extra: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv: "TelemetryServer" = self.server
+        url = urlparse(self.path)
+        try:
+            if url.path == "/metrics":
+                self._send(200, srv.registry.render().encode(),
+                           "text/plain; version=0.0.4")
+            elif url.path == "/debug/trace" and srv.tracer is not None:
+                try:
+                    body, extra = trace_response(srv.tracer, url.query)
+                except ValueError as e:  # e.g. ?last=abc — client error,
+                    # same mapping as the serving front-end
+                    self._send(400, json.dumps(
+                        {"error": f"bad query: {e}"}).encode(),
+                        "application/json")
+                    return
+                self._send(200, body, "application/json", extra)
+            elif url.path == "/debug/threads":
+                self._send(200, dump_threads().encode(), "text/plain")
+            elif url.path == "/debug/vars":
+                out = {"build": build_info()}
+                if srv.vars_fn is not None:
+                    out.update(srv.vars_fn())
+                self._send(200, json.dumps(out, default=str).encode(),
+                           "application/json")
+            elif url.path == "/healthz":
+                self._send(200, b'{"status": "ok"}', "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": f"no such path {url.path!r}"}).encode(),
+                    "application/json")
+        except Exception as e:  # never die on a debug scrape
+            self._send(500, json.dumps({"error": str(e)}).encode(),
+                       "application/json")
+
+
+class TelemetryServer(ThreadingHTTPServer):
+    """Read-only telemetry server: metrics + debug endpoints, no inference.
+
+    ``port=0`` binds an ephemeral port (read it from ``.port``).  Use as::
+
+        srv = TelemetryServer(registry, tracer, port=9100).start()
+        ...
+        srv.close()
+    """
+
+    daemon_threads = True
+
+    def __init__(self, registry, tracer=None,
+                 vars_fn: Optional[Callable[[], Dict]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        # Loopback by default: /debug/threads and /debug/vars expose
+        # stacks, argv and resolved paths — exporting beyond the host is
+        # an explicit choice (cli.train --metrics_host).
+        self.registry = registry
+        self.tracer = tracer
+        self.vars_fn = vars_fn
+        self._thread: Optional[threading.Thread] = None
+        super().__init__((host, port), _Handler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self) -> "TelemetryServer":
+        assert self._thread is None, "telemetry server already started"
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True, name="telemetry-http")
+        self._thread.start()
+        logger.info("telemetry exporter on :%d (/metrics, /debug/trace, "
+                    "/debug/threads, /debug/vars)", self.port)
+        return self
+
+    def close(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(10)
+            self._thread = None

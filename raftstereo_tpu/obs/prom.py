@@ -1,0 +1,241 @@
+"""Prometheus text-exposition (0.0.4) format validator + metric-name lint.
+
+The registry's ``render`` is hand-rolled (no client library), so nothing
+upstream guarantees the output actually parses — and a scrape that 400s in
+production is an outage of exactly the signal needed to debug it.  This
+module is the compensating control: a strict line-by-line validator run
+over fully populated renders in the tier-1 tests (tests/test_obs.py) and
+over the live ``/metrics`` endpoint in the serving e2e test, plus the
+naming lint behind ``scripts/check_metrics.py``.
+
+Dependency-free on purpose, like the metrics code it validates.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["validate_prometheus", "parse_sample", "lint_registry"]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*)(?: (.*))?$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|histogram|summary|untyped)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|'
+                       r'\\n)*)"')
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _parse_value(text: str) -> float:
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    if text == "NaN":
+        return math.nan
+    return float(text)
+
+
+def parse_sample(line: str) -> Tuple[str, Tuple[Tuple[str, str], ...], float]:
+    """Parse one sample line into (name, ((label, raw_value), ...), value).
+
+    Raises ``ValueError`` with a specific message on any malformation —
+    the validator surfaces these per line.
+    """
+    brace = line.find("{")
+    if brace == -1:
+        parts = line.split(" ")
+        if len(parts) not in (2, 3):  # optional trailing timestamp
+            raise ValueError(f"expected 'name value [timestamp]': {line!r}")
+        name, labels, rest = parts[0], (), parts[1:]
+    else:
+        name = line[:brace]
+        close = line.rfind("}")
+        if close == -1:
+            raise ValueError(f"unterminated label set: {line!r}")
+        body = line[brace + 1:close]
+        labels = []
+        pos = 0
+        while pos < len(body):
+            m = _LABEL_RE.match(body, pos)
+            if not m:
+                raise ValueError(
+                    f"bad label pair at {body[pos:]!r} in {line!r}")
+            labels.append((m.group(1), m.group(2)))
+            pos = m.end()
+            if pos < len(body):
+                if body[pos] != ",":
+                    raise ValueError(
+                        f"expected ',' between labels in {line!r}")
+                pos += 1
+        labels = tuple(labels)
+        rest = line[close + 1:].split()
+        if len(rest) not in (1, 2):
+            raise ValueError(f"expected 'value [timestamp]' after labels: "
+                             f"{line!r}")
+    if not _NAME_RE.match(name):
+        raise ValueError(f"invalid metric name {name!r}")
+    seen = set()
+    for k, _ in labels:
+        if k in seen:
+            raise ValueError(f"duplicate label {k!r} in {line!r}")
+        seen.add(k)
+    try:
+        value = _parse_value(rest[0])
+    except ValueError:
+        raise ValueError(f"unparseable sample value {rest[0]!r} in {line!r}")
+    if len(rest) == 2 and not re.match(r"^-?[0-9]+$", rest[1]):
+        raise ValueError(f"bad timestamp {rest[1]!r} in {line!r}")
+    return name, labels, value
+
+
+def _base_of(name: str, types: Dict[str, str]) -> Optional[str]:
+    """The declared metric a sample line belongs to (histograms own their
+    ``_bucket``/``_sum``/``_count`` series)."""
+    if name in types:
+        return name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[:-len(suffix)]
+            if types.get(base) in ("histogram", "summary"):
+                return base
+    return None
+
+
+def validate_prometheus(text: str) -> List[str]:
+    """Validate a text exposition; returns a list of error strings
+    (empty = valid).  Checks, beyond per-line syntax:
+
+    * HELP/TYPE comments well-formed, at most one of each per metric,
+      TYPE declared before the metric's samples;
+    * HELP text uses only the legal escapes (``\\\\`` and ``\\n``);
+    * every sample belongs to a declared metric (histogram ``_bucket`` /
+      ``_sum`` / ``_count`` included), no duplicate series;
+    * histogram ``le`` labels parse as numbers, cumulative counts are
+      monotone, and the ``+Inf`` bucket equals ``_count``.
+    """
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    helps: Set[str] = set()
+    sampled: Set[str] = set()
+    series_seen: Set[Tuple] = set()
+    hist: Dict[str, Dict] = {}  # base -> {"buckets": [...], "count": float}
+    if text and not text.endswith("\n"):
+        errors.append("exposition must end with a newline")
+    for n, line in enumerate(text.splitlines(), 1):
+        where = f"line {n}"
+        if line == "":
+            continue
+        if line.startswith("#"):
+            if line.startswith("# HELP "):
+                m = _HELP_RE.match(line)
+                if not m:
+                    errors.append(f"{where}: malformed HELP: {line!r}")
+                    continue
+                name, help_ = m.group(1), m.group(2) or ""
+                if name in helps:
+                    errors.append(f"{where}: duplicate HELP for {name}")
+                helps.add(name)
+                bad = re.search(r"\\(?![\\n])", help_)
+                if bad:
+                    errors.append(
+                        f"{where}: illegal escape in HELP text for {name} "
+                        f"(only \\\\ and \\n are allowed)")
+            elif line.startswith("# TYPE "):
+                m = _TYPE_RE.match(line)
+                if not m:
+                    errors.append(f"{where}: malformed TYPE: {line!r}")
+                    continue
+                name = m.group(1)
+                if name in types:
+                    errors.append(f"{where}: duplicate TYPE for {name}")
+                if name in sampled:
+                    errors.append(
+                        f"{where}: TYPE for {name} after its samples")
+                types[name] = m.group(2)
+            # other comments are legal and ignored
+            continue
+        try:
+            name, labels, value = parse_sample(line)
+        except ValueError as e:
+            errors.append(f"{where}: {e}")
+            continue
+        base = _base_of(name, types)
+        if base is None:
+            errors.append(f"{where}: sample {name} has no TYPE declaration")
+            continue
+        sampled.add(base)
+        key = (name, labels)
+        if key in series_seen:
+            errors.append(f"{where}: duplicate series {line.split(' ')[0]}")
+        series_seen.add(key)
+        if types[base] == "histogram":
+            h = hist.setdefault(base, {"buckets": [], "count": None})
+            if name == base + "_bucket":
+                le = dict(labels).get("le")
+                if le is None:
+                    errors.append(f"{where}: histogram bucket without le")
+                    continue
+                try:
+                    bound = _parse_value(le)
+                except ValueError:
+                    errors.append(f"{where}: unparseable le={le!r}")
+                    continue
+                h["buckets"].append((bound, value))
+            elif name == base + "_count":
+                h["count"] = value
+    for base, h in hist.items():
+        buckets = h["buckets"]
+        if not buckets:
+            errors.append(f"histogram {base} has no _bucket series")
+            continue
+        bounds = [b for b, _ in buckets]
+        cums = [c for _, c in buckets]
+        if bounds != sorted(bounds):
+            errors.append(f"histogram {base} buckets out of order")
+        if any(a > b for a, b in zip(cums, cums[1:])):
+            errors.append(f"histogram {base} cumulative counts not monotone")
+        if bounds[-1] != math.inf:
+            errors.append(f"histogram {base} missing le=\"+Inf\" bucket")
+        elif h["count"] is None:
+            errors.append(f"histogram {base} missing _count")
+        elif cums[-1] != h["count"]:
+            errors.append(
+                f"histogram {base} +Inf bucket {cums[-1]} != _count "
+                f"{h['count']}")
+    return errors
+
+
+# ------------------------------------------------------------------- lint
+
+# Histogram names that measure a duration must carry the unit; these
+# tokens flag a time-ish histogram whose name forgot it.
+_TIME_TOKENS = ("latency", "duration", "wait", "runtime", "elapsed")
+
+
+def lint_registry(entries) -> List[str]:
+    """Metric-name lint over ``MetricsRegistry.entries()`` tuples
+    ``(kind, name, help, obj)``:
+
+    * counters end ``_total``; gauges and histograms do NOT;
+    * histograms measuring time end ``_seconds`` (detected by name
+      tokens: latency/duration/wait/runtime/elapsed);
+    * every metric has non-empty HELP and a valid name.
+    """
+    errors = []
+    for kind, name, help_, _ in entries:
+        if not _NAME_RE.match(name):
+            errors.append(f"{name}: invalid metric name")
+        if not help_ or not help_.strip():
+            errors.append(f"{name}: empty HELP text")
+        if kind == "counter" and not name.endswith("_total"):
+            errors.append(f"{name}: counter names must end in _total")
+        if kind != "counter" and name.endswith("_total"):
+            errors.append(f"{name}: _total suffix is reserved for counters")
+        if kind == "histogram" and not name.endswith("_seconds") \
+                and any(tok in name for tok in _TIME_TOKENS):
+            errors.append(
+                f"{name}: time histogram names must end in _seconds")
+    return errors

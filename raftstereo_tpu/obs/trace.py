@@ -1,0 +1,234 @@
+"""In-process span tracer with Chrome trace-event (Perfetto) export.
+
+The XLA profiler (utils/profiling.py) answers "what did the device do" for
+a pre-scheduled window; this module answers "where did THIS request/step
+go" continuously: lightweight host-side spans (trace id, parent id, name,
+attrs, wall-time) recorded into a bounded ring buffer, always on, cheap
+enough for every request (one dict + one deque append per span, a few
+microseconds — measured in tests/test_obs.py).
+
+Spans are exportable as Chrome trace-event JSON — the format Perfetto and
+``chrome://tracing`` open directly, and the same family of viewers the XLA
+trace lands in, so a request trace and a ``jax.profiler`` capture can be
+eyeballed side by side.  ``GET /debug/trace`` on the serving front-end and
+the train-side telemetry exporter both serve this export
+(docs/observability.md).
+
+Two recording styles:
+
+* ``with tracer.span("admission", trace_id=rid):`` — live nesting via a
+  thread-local stack (children inherit trace/parent ids automatically);
+* ``tracer.record("queue_wait", t0, t1, rid)`` — after-the-fact, for
+  phases measured by another component (the batcher reconstructs each
+  request's queue-wait/dispatch/host-fetch from the dispatch worker).
+
+Timestamps are ``time.perf_counter`` values (monotonic, ns-resolution);
+the export converts them to epoch microseconds with one process-wide
+offset so spans from every thread share a clock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["Span", "Tracer", "to_chrome_trace"]
+
+# perf_counter -> unix epoch seconds, fixed at import so every span (and
+# every thread) converts identically.
+_EPOCH_OFFSET = time.time() - time.perf_counter()
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One completed span (immutable once recorded)."""
+
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    t0: float  # time.perf_counter at start
+    t1: float  # time.perf_counter at end
+    thread: str
+    attrs: Dict[str, object]
+
+    @property
+    def duration_s(self) -> float:
+        return self.t1 - self.t0
+
+    @property
+    def wall_t0(self) -> float:
+        """Start as unix epoch seconds."""
+        return self.t0 + _EPOCH_OFFSET
+
+
+class _Live:
+    """Handle yielded by ``Tracer.span`` — mutate ``attrs`` mid-span."""
+
+    __slots__ = ("trace_id", "span_id", "attrs")
+
+    def __init__(self, trace_id: str, span_id: str, attrs: Dict):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.attrs = attrs
+
+
+class Tracer:
+    """Thread-safe bounded span recorder.
+
+    ``capacity`` bounds memory: the ring keeps the most recent spans and
+    silently drops the oldest — telemetry must never be the thing that
+    OOMs the server.  Dropped spans are counted (``dropped``).
+    """
+
+    def __init__(self, capacity: int = 4096):
+        assert capacity >= 1, capacity
+        self.capacity = capacity
+        self._spans: deque = deque(maxlen=capacity)
+        self._recorded = 0
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------------ ids
+
+    @staticmethod
+    def new_trace_id() -> str:
+        return uuid.uuid4().hex
+
+    @staticmethod
+    def _new_span_id() -> str:
+        return uuid.uuid4().hex[:16]
+
+    def current(self) -> Optional[Tuple[str, str]]:
+        """(trace_id, span_id) of this thread's innermost open span."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    # ------------------------------------------------------------ recording
+
+    def record(self, name: str, t0: float, t1: float, trace_id: str,
+               parent_id: Optional[str] = None,
+               attrs: Optional[Dict] = None) -> str:
+        """Record a span measured elsewhere (``t0``/``t1`` are
+        ``time.perf_counter`` values).  Returns the span id so callers can
+        parent further spans under it."""
+        sid = self._new_span_id()
+        span = Span(trace_id=trace_id, span_id=sid, parent_id=parent_id,
+                    name=name, t0=t0, t1=t1,
+                    thread=threading.current_thread().name,
+                    attrs=dict(attrs or {}))
+        with self._lock:
+            self._recorded += 1
+            self._spans.append(span)
+        return sid
+
+    @contextlib.contextmanager
+    def span(self, name: str, trace_id: Optional[str] = None,
+             parent_id: Optional[str] = None, **attrs) -> Iterator[_Live]:
+        """Context-managed span; nests via a thread-local stack.
+
+        With no explicit ``trace_id`` the span joins this thread's current
+        trace (becoming a child of the innermost open span) or starts a
+        fresh trace when there is none.
+        """
+        cur = self.current()
+        if trace_id is None:
+            if cur is not None:
+                trace_id = cur[0]
+                if parent_id is None:
+                    parent_id = cur[1]
+            else:
+                trace_id = self.new_trace_id()
+        elif parent_id is None and cur is not None and cur[0] == trace_id:
+            parent_id = cur[1]
+        sid = self._new_span_id()
+        live = _Live(trace_id, sid, dict(attrs))
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append((trace_id, sid))
+        t0 = time.perf_counter()
+        try:
+            yield live
+        finally:
+            t1 = time.perf_counter()
+            stack.pop()
+            span = Span(trace_id=trace_id, span_id=sid, parent_id=parent_id,
+                        name=name, t0=t0, t1=t1,
+                        thread=threading.current_thread().name,
+                        attrs=live.attrs)
+            with self._lock:
+                self._recorded += 1
+                self._spans.append(span)
+
+    # -------------------------------------------------------------- reading
+
+    @property
+    def recorded(self) -> int:
+        """Spans ever recorded (including ones the ring has dropped)."""
+        return self._recorded
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._recorded - len(self._spans)
+
+    def spans(self, last: Optional[int] = None,
+              trace_id: Optional[str] = None) -> List[Span]:
+        """Most recent spans, oldest first; optionally the last ``last``
+        and/or only one trace."""
+        with self._lock:
+            out = list(self._spans)
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        if last is not None:
+            out = out[-max(int(last), 0):]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def to_chrome(self, last: Optional[int] = None,
+                  trace_id: Optional[str] = None) -> Dict:
+        return to_chrome_trace(self.spans(last=last, trace_id=trace_id))
+
+    def export_json(self, last: Optional[int] = None,
+                    trace_id: Optional[str] = None) -> str:
+        return json.dumps(self.to_chrome(last=last, trace_id=trace_id))
+
+
+def to_chrome_trace(spans: List[Span]) -> Dict:
+    """Chrome trace-event JSON (the ``traceEvents`` array form).
+
+    Every span becomes one complete ("ph": "X") event; trace/span/parent
+    ids and attrs ride in ``args`` so Perfetto's query/filter UI can slice
+    by request id.  Open at https://ui.perfetto.dev or chrome://tracing.
+    """
+    pid = os.getpid()
+    threads = {}  # name -> stable synthetic tid (Perfetto wants ints)
+    events = []
+    for s in spans:
+        tid = threads.setdefault(s.thread, len(threads) + 1)
+        events.append({
+            "ph": "X",
+            "name": s.name,
+            "cat": "obs",
+            "ts": round(s.wall_t0 * 1e6, 3),
+            "dur": round(s.duration_s * 1e6, 3),
+            "pid": pid,
+            "tid": tid,
+            "args": {"trace_id": s.trace_id, "span_id": s.span_id,
+                     "parent_id": s.parent_id, **s.attrs},
+        })
+    for name, tid in threads.items():
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": name}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

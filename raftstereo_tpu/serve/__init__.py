@@ -12,7 +12,10 @@ Layers, bottom-up:
 * ``metrics``               — counters / gauges / latency histograms with
                               Prometheus text exposition.
 * ``server.StereoServer``   — stdlib HTTP front-end: ``/predict``,
-                              ``/metrics``, ``/healthz``.
+                              ``/metrics``, ``/healthz``, ``/debug/*``
+                              (per-request traces keyed by X-Request-Id,
+                              on-demand XLA profile, thread dump, vars —
+                              raftstereo_tpu.obs, docs/observability.md).
 * ``client``                — blocking client + closed/open-loop load
                               generator.
 

@@ -93,6 +93,9 @@ class _Request:
     future: Future
     t_enqueue: float
     seq: int
+    # Trace id of the originating request (obs/trace.py): the dispatch
+    # worker reconstructs queue-wait/dispatch/host-fetch spans under it.
+    trace_id: Optional[str] = None
 
 
 # Group key: (bucket_h, bucket_w, explicit iters or None).  Requests with an
@@ -110,10 +113,11 @@ class DynamicBatcher:
     """
 
     def __init__(self, engine, config: ServeConfig,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None, tracer=None):
         self.engine = engine
         self.cfg = config
         self.metrics = metrics or ServeMetrics()
+        self.tracer = tracer  # obs.Tracer or None (tracing is optional)
         self._cv = threading.Condition()
         self._queues: Dict[_Key, Deque[_Request]] = {}
         self._depth = 0
@@ -159,17 +163,19 @@ class DynamicBatcher:
         return self._depth
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
-               iters: Optional[int] = None) -> Future:
+               iters: Optional[int] = None,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue one stereo pair; returns a ``Future`` for the result.
 
         Raises ``Overloaded`` immediately when the queue is at
         ``queue_limit`` — the caller maps this to HTTP 503 so clients see a
-        clear shed signal instead of an unbounded wait.
+        clear shed signal instead of an unbounded wait.  ``trace_id`` tags
+        the request's spans (queue wait, dispatch, host fetch) in the
+        tracer ring.
         """
         key: _Key = (*self.engine.bucket_of(image1.shape), iters)
         fut = Future()
         with self._cv:
-            self.metrics.requests.inc()
             if self._closed:
                 raise ShuttingDown("batcher stopped")
             if self._depth >= self.cfg.queue_limit:
@@ -179,7 +185,7 @@ class DynamicBatcher:
             self._seq += 1
             self._queues.setdefault(key, collections.deque()).append(
                 _Request(image1, image2, iters, fut, time.perf_counter(),
-                         self._seq))
+                         self._seq, trace_id))
             self._depth += 1
             self.metrics.queue_depth.set(self._depth)
             self._cv.notify_all()
@@ -223,6 +229,41 @@ class DynamicBatcher:
                 self.metrics.queue_depth.set(self._depth)
             self._dispatch(key, batch, backlog)
 
+    def _trace_batch(self, key: _Key, batch, iters: int, degraded: bool,
+                     t_run0: float, t_done: float, error=None) -> None:
+        """Reconstruct each request's phase spans from the dispatch the
+        worker just ran: queue wait (enqueue -> batch close), dispatch
+        (engine call through device compute) and host fetch — siblings
+        under the request's trace id, so their durations sum to the
+        server-side latency (asserted in tests/test_obs.py)."""
+        seg = getattr(self.engine, "last_segments", None) if error is None \
+            else None
+        bucket = f"{key[0]}x{key[1]}"
+        for r in batch:
+            if r.trace_id is None:
+                continue
+            self.tracer.record(
+                "queue_wait", r.t_enqueue, t_run0, r.trace_id,
+                attrs={"bucket": bucket})
+            attrs = {"bucket": bucket, "iters": iters, "degraded": degraded,
+                     "batch_size": len(batch)}
+            if error is not None:
+                attrs["error"] = str(error)
+            if seg is None:
+                self.tracer.record("dispatch", t_run0, t_done, r.trace_id,
+                                   attrs=attrs)
+                continue
+            attrs["compile"] = seg["compile"]
+            parent = self.tracer.record(
+                "dispatch", t_run0, seg["dispatch"][1], r.trace_id,
+                attrs=attrs)
+            if seg.get("pad"):
+                self.tracer.record("pad_bucket", *seg["pad"], r.trace_id,
+                                   parent_id=parent)
+            self.tracer.record("device_compute", *seg["dispatch"],
+                               r.trace_id, parent_id=parent)
+            self.tracer.record("host_fetch", *seg["host_fetch"], r.trace_id)
+
     def _dispatch(self, key: _Key, batch, backlog: int) -> None:
         now = time.perf_counter()
         timeout_s = self.cfg.request_timeout_ms / 1000.0
@@ -230,6 +271,10 @@ class DynamicBatcher:
         for r in batch:
             if now - r.t_enqueue > timeout_s:
                 self.metrics.timeouts.inc()
+                if self.tracer is not None and r.trace_id is not None:
+                    self.tracer.record(
+                        "queue_wait", r.t_enqueue, now, r.trace_id,
+                        attrs={"outcome": "timeout"})
                 r.future._resolve(exc=RequestTimedOut(
                     f"queued {now - r.t_enqueue:.3f}s > "
                     f"{timeout_s:.3f}s limit"))
@@ -246,15 +291,21 @@ class DynamicBatcher:
                      else self.cfg.iters)
         if degraded:
             self.metrics.degraded_batches.inc()
+        t_run0 = time.perf_counter()
         try:
             disps = self.engine.infer_batch(
                 [(r.image1, r.image2) for r in alive], iters)
         except Exception as e:  # fail the batch, keep serving
             self.metrics.errors.inc(len(alive))
+            if self.tracer is not None:
+                self._trace_batch(key, alive, iters, degraded, t_run0,
+                                  time.perf_counter(), error=e)
             for r in alive:
                 r.future._resolve(exc=e)
             return
         done = time.perf_counter()
+        if self.tracer is not None:
+            self._trace_batch(key, alive, iters, degraded, t_run0, done)
         self.metrics.batch_size.observe(len(alive))
         for r, d in zip(alive, disps):
             latency = done - r.t_enqueue

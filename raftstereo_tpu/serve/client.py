@@ -45,12 +45,16 @@ def synthetic_pair_pool(height: int, width: int, n: int = 4, seed: int = 0):
 
 
 class ServeError(RuntimeError):
-    """Non-200 reply; ``status`` and the decoded error payload attached."""
+    """Non-200 reply; ``status``, the decoded error payload and (when the
+    server sent one) the ``X-Request-Id`` attached — the id keys the failed
+    request's spans in ``/debug/trace``."""
 
-    def __init__(self, status: int, payload: Dict):
+    def __init__(self, status: int, payload: Dict,
+                 request_id: Optional[str] = None):
         super().__init__(f"HTTP {status}: {payload.get('error', payload)}")
         self.status = status
         self.payload = payload
+        self.request_id = request_id
 
 
 class ServeClient:
@@ -64,7 +68,8 @@ class ServeClient:
         self._conn.close()
 
     def _request(self, method: str, path: str,
-                 body: Optional[bytes] = None) -> Tuple[int, bytes]:
+                 body: Optional[bytes] = None
+                 ) -> Tuple[int, bytes, Dict[str, str]]:
         headers = {"Content-Type": "application/json"} if body else {}
         try:
             self._conn.request(method, path, body=body, headers=headers)
@@ -76,7 +81,7 @@ class ServeClient:
             self._conn.request(method, path, body=body, headers=headers)
         try:
             resp = self._conn.getresponse()
-            return resp.status, resp.read()
+            return resp.status, resp.read(), dict(resp.headers)
         except socket.timeout:
             # Never resend on a response timeout — for /predict the server
             # may still be computing; a retry would run inference twice
@@ -90,7 +95,7 @@ class ServeClient:
             self._conn.close()
             self._conn.request(method, path, body=body, headers=headers)
             resp = self._conn.getresponse()
-            return resp.status, resp.read()
+            return resp.status, resp.read(), dict(resp.headers)
 
     def predict(self, left: np.ndarray, right: np.ndarray,
                 iters: Optional[int] = None,
@@ -114,24 +119,66 @@ class ServeClient:
             payload["session_id"] = str(session_id)
             if seq_no is not None:
                 payload["seq_no"] = int(seq_no)
-        status, body = self._request("POST", "/predict",
-                                     json.dumps(payload).encode())
+        status, body, headers = self._request(
+            "POST", "/predict", json.dumps(payload).encode())
         data = json.loads(body)
         if status != 200:
-            raise ServeError(status, data)
-        return decode_array(data["disparity"]), data["meta"]
+            raise ServeError(status, data,
+                             request_id=headers.get("X-Request-Id"))
+        meta = data["meta"]
+        # The server already puts request_id in meta; the header is
+        # authoritative (and present on error replies too).
+        meta.setdefault("request_id", headers.get("X-Request-Id"))
+        return decode_array(data["disparity"]), meta
 
-    def healthz(self) -> Dict:
-        status, body = self._request("GET", "/healthz")
+    def _get_json(self, path: str) -> Dict:
+        status, body, _ = self._request("GET", path)
         if status != 200:
             raise ServeError(status, json.loads(body))
         return json.loads(body)
 
+    def healthz(self) -> Dict:
+        return self._get_json("/healthz")
+
     def metrics_text(self) -> str:
-        status, body = self._request("GET", "/metrics")
+        status, body, _ = self._request("GET", "/metrics")
         if status != 200:
             raise ServeError(status, json.loads(body))
         return body.decode()
+
+    # ---------------------------------------------------- debug endpoints
+
+    def debug_trace(self, last: Optional[int] = None,
+                    trace_id: Optional[str] = None) -> Dict:
+        """Chrome trace-event JSON of the server's recent spans
+        (docs/observability.md); save it and open at ui.perfetto.dev."""
+        qs = []
+        if last is not None:
+            qs.append(f"last={int(last)}")
+        if trace_id is not None:
+            qs.append(f"trace_id={trace_id}")
+        path = "/debug/trace" + ("?" + "&".join(qs) if qs else "")
+        return self._get_json(path)
+
+    def debug_vars(self) -> Dict:
+        return self._get_json("/debug/vars")
+
+    def debug_threads(self) -> str:
+        status, body, _ = self._request("GET", "/debug/threads")
+        if status != 200:
+            raise ServeError(status, json.loads(body))
+        return body.decode()
+
+    def debug_profile(self, seconds: float) -> Dict:
+        """Start an on-demand jax.profiler window on the server; raises
+        ``ServeError`` (409) while a capture is already running."""
+        status, body, _ = self._request(
+            "POST", "/debug/profile",
+            json.dumps({"seconds": seconds}).encode())
+        data = json.loads(body)
+        if status != 200:
+            raise ServeError(status, data)
+        return data
 
 
 def run_load(host: str, port: int,

@@ -61,6 +61,11 @@ class BatchEngine:
         self._stats_lock = threading.Lock()
         self.last_batch_runtime: float = float("nan")
         self.last_included_compile: bool = True
+        # Per-thread phase timing of the most recent dispatch THIS thread
+        # ran (the batcher worker and concurrent stream handlers each read
+        # their own): thread-local because an attribute would be overwritten
+        # by whichever dispatch finished last.
+        self._seg = threading.local()
 
     # ----------------------------------------------------------- shape policy
 
@@ -167,12 +172,21 @@ class BatchEngine:
                 warmed.append(key)
         return warmed
 
+    @property
+    def last_segments(self) -> Optional[Dict[str, object]]:
+        """Phase timing of the last dispatch on THIS thread:
+        ``{"pad", "dispatch", "host_fetch"}`` as (perf_counter t0, t1)
+        windows plus ``"compile"`` — the raw material the batcher and
+        stream runner turn into per-request trace spans (obs/trace.py)."""
+        return getattr(self._seg, "last", None)
+
     def _pad_pairs(self, pairs):
         """Shared shape policy: per-pair BucketPadder padding plus batch-
         axis zero-padding to ``max_batch_size``, so the compile cache is
         keyed by bucket alone.  All pairs must map to one bucket (the
         batcher groups by bucket before dispatching)."""
         assert pairs, "empty batch"
+        t_pad0 = time.perf_counter()
         assert len(pairs) <= self.cfg.max_batch_size, (
             f"batch {len(pairs)} exceeds max_batch_size "
             f"{self.cfg.max_batch_size}")
@@ -193,6 +207,7 @@ class BatchEngine:
         if pad_rows:
             i1 = jnp.pad(i1, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
             i2 = jnp.pad(i2, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+        self._seg.pad = (t_pad0, time.perf_counter())
         return padders, hw, i1, i2, pad_rows
 
     def _dispatch(self, key, call):
@@ -202,18 +217,35 @@ class BatchEngine:
         ``(host_outputs, included_compile)`` — the flag is per-call, not
         read back from shared engine state, so concurrent callers cannot
         race each other's compile accounting."""
+        mode = "stream" if len(key) == 4 else "batch"
+        labels = dict(bucket=f"{key[0]}x{key[1]}", iters=str(key[2]),
+                      mode=mode)
         with self._lock:
             with self._stats_lock:
                 miss = key not in self._compiled
             if self.metrics is not None:
                 (self.metrics.compile_misses if miss
-                 else self.metrics.compile_hits).inc()
+                 else self.metrics.compile_hits).labels(**labels).inc()
             start = time.perf_counter()
-            out = [np.asarray(o, np.float32) for o in call()]
-            self.last_batch_runtime = time.perf_counter() - start
+            out_dev = call()
+            # Two measured phases: device compute (dispatch until the
+            # result exists on device) and the device->host copy.  Both
+            # still happen under the engine lock — fetch-before-release is
+            # the engine's completion contract.
+            jax.block_until_ready(out_dev)
+            t_compute = time.perf_counter()
+            out = [np.asarray(o, np.float32) for o in out_dev]
+            t_fetch = time.perf_counter()
+            self.last_batch_runtime = t_fetch - start
             self.last_included_compile = miss
             with self._stats_lock:
                 self._compiled.add(key)
+        self._seg.last = {
+            "pad": getattr(self._seg, "pad", None),
+            "dispatch": (start, t_compute),
+            "host_fetch": (t_compute, t_fetch),
+            "compile": miss,
+        }
         if self.metrics is not None and not miss:
             self.metrics.batch_latency.observe(self.last_batch_runtime)
         return out, miss

@@ -3,12 +3,19 @@ exposition (no client library dependency — the format is plain text).
 
 Three instrument kinds: monotonically increasing ``Counter``, last-value
 ``Gauge`` and the fixed-bucket ``LatencyHistogram`` from utils/profiling.py
-(shared with the Evaluator's per-call timing).  ``MetricsRegistry.render``
-emits the text format Prometheus scrapes from ``GET /metrics``:
+(shared with the Evaluator's per-call timing).  Counters and gauges can be
+registered with ``labels=(...)`` — a label FAMILY whose per-label-set
+children are created on first use — so hot counters split by dimension
+(``serve_requests_total{endpoint=,outcome=}``,
+``serve_compile_cache_misses_total{bucket=,iters=,mode=}``) while the
+render stays valid Prometheus 0.0.4 (label values escaped, one TYPE block
+per family; validated by raftstereo_tpu/obs/prom.py in the tier-1 tests).
+``MetricsRegistry.render`` emits the text format Prometheus scrapes from
+``GET /metrics``:
 
     # HELP serve_requests_total ...
     # TYPE serve_requests_total counter
-    serve_requests_total 42
+    serve_requests_total{endpoint="predict",outcome="ok"} 42
     serve_request_latency_seconds_bucket{le="0.1"} 17
     ...
 
@@ -21,11 +28,12 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils.profiling import LatencyHistogram
 
-__all__ = ["Counter", "Gauge", "MetricsRegistry", "ServeMetrics"]
+__all__ = ["Counter", "Gauge", "LabelFamily", "MetricsRegistry",
+           "ServeMetrics"]
 
 
 class Counter:
@@ -45,17 +53,65 @@ class Counter:
 
 
 class Gauge:
-    """Last-value instrument (Prometheus ``gauge``)."""
+    """Last-value instrument (Prometheus ``gauge``).
+
+    Locked ``set`` AND ``add``: read-modify-write callers (live session
+    counts, in-flight gauges) must not lose updates under the threaded
+    HTTP front-end, and ``g.set(g.value + 1)`` races exactly there.
+    """
 
     def __init__(self):
         self._value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self._value = v
+        with self._lock:
+            self._value = v
+
+    def add(self, delta: float) -> None:
+        with self._lock:
+            self._value += delta
 
     @property
     def value(self) -> float:
         return self._value
+
+
+class LabelFamily:
+    """A labeled metric family: ``family.labels(k=v, ...)`` returns the
+    child instrument for that label set, creating it on first use.
+
+    ``value`` sums the children — the label-blind total, which is also
+    what pre-label callers and tests read.  Children render as one series
+    per label set under a single HELP/TYPE block.
+    """
+
+    def __init__(self, make_child, label_names: Sequence[str]):
+        assert label_names, "a family needs at least one label"
+        self._make = make_child
+        self.label_names = tuple(label_names)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **kv):
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"labels {sorted(kv)} != declared {sorted(self.label_names)}")
+        key = tuple(str(kv[k]) for k in self.label_names)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make()
+            return child
+
+    def series(self) -> List[Tuple[Tuple[str, ...], object]]:
+        """(label_values, child) pairs in first-use order (snapshot)."""
+        with self._lock:
+            return list(self._children.items())
+
+    @property
+    def value(self) -> float:
+        return sum(c.value for _, c in self.series())
 
 
 def _fmt(v: float) -> str:
@@ -64,6 +120,14 @@ def _fmt(v: float) -> str:
     if float(v).is_integer():
         return str(int(v))
     return format(v, ".9g")
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
 class MetricsRegistry:
@@ -80,11 +144,14 @@ class MetricsRegistry:
             self._entries.append((kind, name, help_, obj))
         return obj
 
-    def counter(self, name: str, help_: str) -> Counter:
-        return self._register("counter", name, help_, Counter())
+    def counter(self, name: str, help_: str,
+                labels: Sequence[str] = ()):
+        obj = LabelFamily(Counter, labels) if labels else Counter()
+        return self._register("counter", name, help_, obj)
 
-    def gauge(self, name: str, help_: str) -> Gauge:
-        return self._register("gauge", name, help_, Gauge())
+    def gauge(self, name: str, help_: str, labels: Sequence[str] = ()):
+        obj = LabelFamily(Gauge, labels) if labels else Gauge()
+        return self._register("gauge", name, help_, obj)
 
     def histogram(self, name: str, help_: str,
                   bounds=None, lo: float = 1e-4,
@@ -92,13 +159,17 @@ class MetricsRegistry:
         return self._register("histogram", name, help_,
                               LatencyHistogram(bounds=bounds, lo=lo, hi=hi))
 
+    def entries(self) -> List[Tuple[str, str, str, object]]:
+        """(kind, name, help, instrument) snapshot — for the name lint
+        (scripts/check_metrics.py) and exporters."""
+        with self._lock:
+            return list(self._entries)
+
     def render(self) -> str:
         """Prometheus text exposition format, version 0.0.4."""
         lines: List[str] = []
-        with self._lock:
-            entries = list(self._entries)
-        for kind, name, help_, obj in entries:
-            lines.append(f"# HELP {name} {help_}")
+        for kind, name, help_, obj in self.entries():
+            lines.append(f"# HELP {name} {_escape_help(help_)}")
             lines.append(f"# TYPE {name} {kind}")
             if kind == "histogram":
                 # One atomic snapshot: _count must equal the +Inf bucket.
@@ -108,6 +179,14 @@ class MetricsRegistry:
                         f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
                 lines.append(f"{name}_sum {format(total, '.9g')}")
                 lines.append(f"{name}_count {count}")
+            elif isinstance(obj, LabelFamily):
+                # A family with no children renders HELP/TYPE only —
+                # legal, and keeps scrape schemas stable from startup.
+                for values, child in obj.series():
+                    labelset = ",".join(
+                        f'{k}="{_escape_label(v)}"'
+                        for k, v in zip(obj.label_names, values))
+                    lines.append(f"{name}{{{labelset}}} {_fmt(child.value)}")
             else:
                 lines.append(f"{name} {_fmt(obj.value)}")
         return "\n".join(lines) + "\n"
@@ -120,7 +199,12 @@ class ServeMetrics:
         r = registry or MetricsRegistry()
         self.registry = r
         self.requests = r.counter(
-            "serve_requests_total", "requests submitted to the batcher")
+            "serve_requests_total",
+            "requests answered by the HTTP front-end, by endpoint "
+            "(predict/stream; other = POST to an unknown path) and outcome "
+            "(ok/bad_request/shed/timeout/unavailable/too_large/not_found/"
+            "error)",
+            labels=("endpoint", "outcome"))
         self.responses = r.counter(
             "serve_responses_total", "requests answered successfully")
         self.shed = r.counter(
@@ -136,10 +220,12 @@ class ServeMetrics:
             "batches run at degraded_iters due to queue backlog")
         self.compile_hits = r.counter(
             "serve_compile_cache_hits_total",
-            "batches dispatched to an already-compiled executable")
+            "batches dispatched to an already-compiled executable",
+            labels=("bucket", "iters", "mode"))
         self.compile_misses = r.counter(
             "serve_compile_cache_misses_total",
-            "batches whose (bucket, iters) shape triggered an XLA compile")
+            "batches whose (bucket, iters) shape triggered an XLA compile",
+            labels=("bucket", "iters", "mode"))
         self.queue_depth = r.gauge(
             "serve_queue_depth", "requests currently waiting in the queue")
         self.batch_size = r.histogram(
@@ -159,8 +245,11 @@ class ServeMetrics:
             "frames warm-started from the previous frame's disparity")
         self.stream_cold_frames = r.counter(
             "stream_cold_frames_total",
-            "frames run cold (new/expired/evicted/out-of-sequence session "
-            "or controller cold reset)")
+            "frames run cold, by reason: new (no session state — includes "
+            "expired/evicted sessions re-established), reset (controller "
+            "cold reset), out_of_order (seq_no mismatch), resized (bucket "
+            "change mid-stream)",
+            labels=("reason",))
         self.stream_evicted = r.counter(
             "stream_sessions_evicted_total",
             "sessions LRU-evicted because the store hit session_limit")

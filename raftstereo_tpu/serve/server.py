@@ -10,9 +10,19 @@ Endpoints:
   ``degraded_iters`` — those executables are warmed; arbitrary values
   would compile under load).  Replies 200 with ``{"disparity": <array>,
   "meta": {...}}``, 503 ``overloaded`` when admission control sheds, 504
-  on a per-request timeout, 400 on a malformed body.
+  on a per-request timeout, 400 on a malformed body.  Every reply carries
+  an ``X-Request-Id`` header (also ``meta.request_id``) — the trace id of
+  the request's spans in ``/debug/trace``.
 * ``GET /metrics`` — Prometheus text exposition (serve/metrics.py).
 * ``GET /healthz`` — JSON liveness: queue depth, compiled buckets, config.
+* ``GET /debug/trace?last=N`` — recent spans as downloadable Chrome
+  trace-event JSON (open at ui.perfetto.dev); ``trace_id=`` filters to
+  one request.
+* ``POST /debug/profile`` — body ``{"seconds": S}``: on-demand
+  ``jax.profiler`` window; 409 while a capture is already running.
+* ``GET /debug/threads`` — all-thread stack dump (the batcher/HTTP
+  deadlock surface earns this).
+* ``GET /debug/vars`` — resolved ServeConfig + build info + engine state.
 
 ``ThreadingHTTPServer`` gives one thread per connection; they all funnel
 into the single ``DynamicBatcher`` queue, which is where concurrency is
@@ -23,15 +33,20 @@ stays dumb on purpose.
 from __future__ import annotations
 
 import base64
+import dataclasses
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Union
+from urllib.parse import urlparse
 
 import numpy as np
 
 from ..config import ServeConfig
+from ..obs import Tracer, build_info, dump_threads, trace_response
+from ..utils.profiling import OnDemandProfiler, ProfilerBusy
 from .batcher import DynamicBatcher, Overloaded, RequestTimedOut, ShuttingDown
 from .engine import BatchEngine
 from .metrics import ServeMetrics
@@ -57,6 +72,23 @@ def decode_array(obj: Union[Dict, list]) -> np.ndarray:
     return a.reshape(obj["shape"]).astype(np.float32, copy=False)
 
 
+def _outcome(code: int, obj: Dict) -> str:
+    """Label value for ``serve_requests_total{outcome=}``."""
+    if code == 200:
+        return "ok"
+    if code == 400:
+        return "bad_request"
+    if code == 404:
+        return "not_found"
+    if code == 413:
+        return "too_large"
+    if code == 503:
+        return "shed" if obj.get("error") == "overloaded" else "unavailable"
+    if code == 504:
+        return "timeout"
+    return "error"
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "raftstereo-serve/1.0"
     protocol_version = "HTTP/1.1"  # keep-alive: load-gen reuses connections
@@ -80,10 +112,32 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(obj).encode(),
                    "application/json", extra_headers)
 
+    def _finish(self, code: int, obj: Dict, endpoint: str, rid: str,
+                t0: float,
+                extra_headers: Optional[Dict[str, str]] = None) -> None:
+        """Terminal reply for a /predict request: attach the request id,
+        count the labeled outcome, close the root trace span."""
+        srv: "StereoServer" = self.server
+        if code == 200 and "meta" in obj:
+            obj["meta"]["request_id"] = rid
+        headers = {"X-Request-Id": rid}
+        headers.update(extra_headers or {})
+        # Count + close the span BEFORE writing: a client that hangs up
+        # mid-reply (BrokenPipeError out of _json) must still be counted,
+        # and its trace must still have a root span.  The request span
+        # therefore excludes the response write itself.
+        outcome = _outcome(code, obj)
+        srv.metrics.requests.labels(endpoint=endpoint, outcome=outcome).inc()
+        srv.tracer.record("request", t0, time.perf_counter(), rid,
+                          attrs={"endpoint": endpoint, "status": code,
+                                 "outcome": outcome})
+        self._json(code, obj, headers)
+
     # ------------------------------------------------------------- endpoints
     def do_GET(self):
         srv: "StereoServer" = self.server
-        if self.path == "/healthz":
+        url = urlparse(self.path)
+        if url.path == "/healthz":
             health = {
                 "status": "ok",
                 "queue_depth": srv.batcher.queue_depth,
@@ -98,14 +152,73 @@ class _Handler(BaseHTTPRequestHandler):
                     "session_limit": srv.config.stream.session_limit,
                 }
             self._json(200, health)
-        elif self.path == "/metrics":
+        elif url.path == "/metrics":
             self._send(200, srv.metrics.render().encode(),
                        "text/plain; version=0.0.4")
+        elif url.path == "/debug/trace":
+            try:
+                body, extra = trace_response(srv.tracer, url.query)
+            except ValueError as e:  # e.g. ?last=abc
+                self._json(400, {"error": f"bad query: {e}"})
+                return
+            self._send(200, body, "application/json", extra)
+        elif url.path == "/debug/threads":
+            self._send(200, dump_threads().encode(), "text/plain")
+        elif url.path == "/debug/vars":
+            self._json(200, {
+                "config": dataclasses.asdict(srv.config),
+                "build": build_info(),
+                "engine": {
+                    "compiled_buckets": sorted(srv.engine.compiled_keys),
+                    "queue_depth": srv.batcher.queue_depth,
+                    "stream_sessions": (len(srv.stream.store)
+                                        if srv.stream is not None else None),
+                },
+                "trace": {"capacity": srv.tracer.capacity,
+                          "recorded": srv.tracer.recorded,
+                          "dropped": srv.tracer.dropped},
+                "profile_running": srv.profiler.running,
+            })
         else:
             self._json(404, {"error": f"no such path {self.path!r}"})
 
+    def _debug_profile(self, srv: "StereoServer") -> None:
+        """POST /debug/profile: bounded on-demand jax.profiler window,
+        mutually exclusive with any running capture (HTTP 409)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            length = -1
+        if length < 0 or length > 1 << 16:  # tiny JSON only
+            self.close_connection = True
+            self._json(400, {"error": "bad Content-Length"})
+            return
+        raw = self.rfile.read(length) if length else b""
+        try:
+            payload = json.loads(raw) if raw else {}
+            seconds = float(payload.get("seconds", 3.0))
+        except Exception as e:
+            self._json(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            info = srv.profiler.start(seconds)
+        except ProfilerBusy as e:
+            self._json(409, {"error": "profile already running",
+                             "detail": str(e)})
+            return
+        except ValueError as e:
+            self._json(400, {"error": str(e)})
+            return
+        self._json(200, info)
+
     def do_POST(self):
         srv: "StereoServer" = self.server
+        if urlparse(self.path).path == "/debug/profile":
+            self._debug_profile(srv)
+            return
+        rid = srv.tracer.new_trace_id()
+        t_req0 = time.perf_counter()
+        endpoint = "predict"
         try:
             length = int(self.headers.get("Content-Length", 0) or 0)
         except ValueError:
@@ -114,9 +227,10 @@ class _Handler(BaseHTTPRequestHandler):
             # Refuse before buffering: close instead of draining an
             # arbitrarily large (or unparseable) body.
             self.close_connection = True
-            self._json(413, {"error": "body too large or bad "
-                                      "Content-Length",
-                             "limit_mb": srv.config.max_body_mb})
+            self._finish(413, {"error": "body too large or bad "
+                                        "Content-Length",
+                               "limit_mb": srv.config.max_body_mb},
+                         endpoint, rid, t_req0)
             return
         # Bound CONCURRENT buffering, not just per-request size: each
         # in-flight decode transiently holds body + base64 text + decoded
@@ -127,7 +241,8 @@ class _Handler(BaseHTTPRequestHandler):
             # unread body bytes would be parsed as the next request line.
             raw = self.rfile.read(length) if length else b""
             if self.path != "/predict":
-                self._json(404, {"error": f"no such path {self.path!r}"})
+                self._finish(404, {"error": f"no such path {self.path!r}"},
+                             "other", rid, t_req0)
                 return
             try:
                 payload = json.loads(raw)
@@ -137,7 +252,8 @@ class _Handler(BaseHTTPRequestHandler):
                 session_id = payload.get("session_id")
                 seq_no = payload.get("seq_no")
             except Exception as e:
-                self._json(400, {"error": f"bad request: {e}"})
+                self._finish(400, {"error": f"bad request: {e}"},
+                             endpoint, rid, t_req0)
                 return
             del raw, payload
         try:
@@ -153,6 +269,7 @@ class _Handler(BaseHTTPRequestHandler):
             if session_id is not None:
                 # Streaming frame: validated here, then dispatched outside
                 # this block (the session path bypasses the micro-batcher).
+                endpoint = "stream"
                 if srv.stream is None:
                     raise ValueError(
                         "streaming disabled on this server (start with a "
@@ -197,8 +314,14 @@ class _Handler(BaseHTTPRequestHandler):
                         f"(iters {want}) not warmed; configure it in "
                         f"--buckets")
         except Exception as e:
-            self._json(400, {"error": f"bad request: {e}"})
+            self._finish(400, {"error": f"bad request: {e}"},
+                         endpoint, rid, t_req0)
             return
+        # Decode + validation done: the admission span closes where the
+        # request either enters the batcher queue or the session path.
+        srv.tracer.record("admission", t_req0, time.perf_counter(), rid,
+                          attrs={"endpoint": endpoint,
+                                 "shape": list(left.shape)})
         if session_id is not None:
             # Session frames bypass the micro-batcher: ordering within a
             # session is the point (frame N warm-starts from N-1), so they
@@ -211,29 +334,32 @@ class _Handler(BaseHTTPRequestHandler):
             with srv.stream_inflight_lock:
                 if srv.stream_inflight >= srv.config.queue_limit:
                     srv.metrics.shed.inc()
-                    self._json(503, {"error": "overloaded",
-                                     "detail": f"stream frames in flight "
-                                               f">= queue_limit "
-                                               f"{srv.config.queue_limit}"},
-                               {"Retry-After": "1"})
+                    self._finish(503, {"error": "overloaded",
+                                       "detail": f"stream frames in flight "
+                                                 f">= queue_limit "
+                                                 f"{srv.config.queue_limit}"},
+                                 endpoint, rid, t_req0,
+                                 {"Retry-After": "1"})
                     return
                 srv.stream_inflight += 1
             try:
-                res = srv.stream.step(session_id, seq_no, left, right)
+                res = srv.stream.step(session_id, seq_no, left, right,
+                                      trace_id=rid)
             except Exception as e:
-                self._json(500, {"error": f"inference failed: {e}"})
+                self._finish(500, {"error": f"inference failed: {e}"},
+                             endpoint, rid, t_req0)
                 return
             finally:
                 with srv.stream_inflight_lock:
                     srv.stream_inflight -= 1
-            self._json(200, {
+            self._finish(200, {
                 "disparity": encode_array(res.disparity),
                 "meta": {"session_id": res.session_id, "seq_no": res.seq_no,
                          "frame_idx": res.frame_idx, "iters": res.iters,
                          "warm": res.warm,
                          "update_ema": round(res.update_ema, 4),
                          "latency_ms": round(res.latency_s * 1e3, 3)},
-            })
+            }, endpoint, rid, t_req0)
             return
         # Size the HTTP-side wait for what can actually be ahead of this
         # request: one in-flight batch (60 s) — or a cold XLA compile,
@@ -246,13 +372,14 @@ class _Handler(BaseHTTPRequestHandler):
         warm = all(srv.engine.is_warm(hw, lv) for lv in levels)
         slack = 60.0 if warm else 600.0
         try:
-            fut = srv.batcher.submit(left, right, iters)
+            fut = srv.batcher.submit(left, right, iters, trace_id=rid)
         except Overloaded as e:
-            self._json(503, {"error": "overloaded", "detail": str(e)},
-                       {"Retry-After": "1"})
+            self._finish(503, {"error": "overloaded", "detail": str(e)},
+                         endpoint, rid, t_req0, {"Retry-After": "1"})
             return
         except ShuttingDown:
-            self._json(503, {"error": "shutting down"})
+            self._finish(503, {"error": "shutting down"},
+                         endpoint, rid, t_req0)
             return
         try:
             # The batcher enforces request_timeout_ms at dispatch; the
@@ -260,24 +387,27 @@ class _Handler(BaseHTTPRequestHandler):
             res = fut.result(
                 timeout=srv.config.request_timeout_ms / 1000.0 + slack)
         except RequestTimedOut as e:
-            self._json(504, {"error": "timeout", "detail": str(e)})
+            self._finish(504, {"error": "timeout", "detail": str(e)},
+                         endpoint, rid, t_req0)
             return
         except (TimeoutError, ShuttingDown) as e:
-            self._json(503, {"error": "unavailable", "detail": str(e)})
+            self._finish(503, {"error": "unavailable", "detail": str(e)},
+                         endpoint, rid, t_req0)
             return
         except Exception as e:
-            self._json(500, {"error": f"inference failed: {e}"})
+            self._finish(500, {"error": f"inference failed: {e}"},
+                         endpoint, rid, t_req0)
             return
-        self._json(200, {
+        self._finish(200, {
             "disparity": encode_array(res.disparity),
             "meta": {"iters": res.iters, "degraded": res.degraded,
                      "batch_size": res.batch_size,
                      "latency_ms": round(res.latency_s * 1e3, 3)},
-        })
+        }, endpoint, rid, t_req0)
 
 
 class StereoServer(ThreadingHTTPServer):
-    """HTTP server owning the engine + batcher + metrics triple.
+    """HTTP server owning the engine + batcher + metrics + tracer.
 
     ``config.port == 0`` binds an ephemeral port; read the real one from
     ``server.server_address[1]`` (tests and ``bench.py --serve`` do).
@@ -287,12 +417,14 @@ class StereoServer(ThreadingHTTPServer):
 
     def __init__(self, config: ServeConfig, engine: BatchEngine,
                  batcher: DynamicBatcher, metrics: ServeMetrics,
-                 stream=None):
+                 stream=None, tracer: Optional[Tracer] = None):
         self.config = config
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
         self.stream = stream  # stream.runner.StreamRunner or None
+        self.tracer = tracer or Tracer(capacity=config.trace_buffer)
+        self.profiler = OnDemandProfiler(log_dir="runs/serve/profile")
         # Admission control for the session path (which bypasses the
         # batcher queue): frames concurrently decoded-and-waiting on the
         # session/engine locks, shed with 503 beyond queue_limit.
@@ -317,13 +449,16 @@ class StereoServer(ThreadingHTTPServer):
 
 
 def build_server(model, variables, config: ServeConfig,
-                 metrics: Optional[ServeMetrics] = None) -> StereoServer:
-    """Wire engine + batcher + HTTP server; warm configured buckets.
+                 metrics: Optional[ServeMetrics] = None,
+                 tracer: Optional[Tracer] = None) -> StereoServer:
+    """Wire engine + batcher + tracer + HTTP server; warm configured
+    buckets.
 
     The caller drives ``server.serve_forever()`` (blocking) or a thread, and
     ``server.close()`` on the way out.
     """
     metrics = metrics or ServeMetrics()
+    tracer = tracer or Tracer(capacity=config.trace_buffer)
     engine = BatchEngine(model, variables, config, metrics)
     if config.warmup:
         engine.warmup()
@@ -331,11 +466,12 @@ def build_server(model, variables, config: ServeConfig,
     if config.stream is not None:
         from ..stream.runner import StreamRunner  # local: avoids an
         # import cycle (stream.runner's engine builder imports this pkg)
-        stream = StreamRunner(engine, config.stream, metrics)
+        stream = StreamRunner(engine, config.stream, metrics, tracer=tracer)
         if config.stream_warmup:
             engine.warmup_stream(ladder=config.stream.ladder)
-    batcher = DynamicBatcher(engine, config, metrics).start()
-    server = StereoServer(config, engine, batcher, metrics, stream=stream)
+    batcher = DynamicBatcher(engine, config, metrics, tracer=tracer).start()
+    server = StereoServer(config, engine, batcher, metrics, stream=stream,
+                          tracer=tracer)
     logger.info("serving on %s:%d (buckets=%s, max_batch=%d, iters=%d/%d, "
                 "stream=%s)",
                 config.host, server.port,

@@ -60,20 +60,26 @@ class StreamRunner:
     """
 
     def __init__(self, engine, cfg: StreamConfig, metrics=None,
-                 store: Optional[SessionStore] = None):
+                 store: Optional[SessionStore] = None, tracer=None):
         self.engine = engine
         self.cfg = cfg
         self.metrics = metrics
+        self.tracer = tracer  # obs.Tracer or None (tracing is optional)
         self.controller = AdaptiveIterController(cfg)
         self.store = store or SessionStore(cfg.session_limit,
                                            cfg.session_ttl_s, metrics)
 
     def step(self, session_id: str, seq_no: Optional[int],
-             left: np.ndarray, right: np.ndarray) -> StreamResult:
+             left: np.ndarray, right: np.ndarray,
+             trace_id: Optional[str] = None) -> StreamResult:
         """Run one frame of a session; always answers (cold on any session
-        miss — new, expired, evicted, out-of-sequence, or resized)."""
+        miss — new, expired, evicted, out-of-sequence, or resized).
+        ``trace_id`` tags the frame's warp/forward spans in the tracer."""
         sess, _ = self.store.get_or_create(session_id)
         ctl = self.controller
+        tracer = self.tracer
+        if tracer is not None and trace_id is None:
+            trace_id = tracer.new_trace_id()
         with sess.lock:
             t0 = time.perf_counter()
             if seq_no is None:
@@ -85,12 +91,38 @@ class StreamRunner:
                     and sess.bucket_hw == bucket)
             if warm:
                 init = forward_interpolate(sess.prev_disp_low)
+                t_warp = time.perf_counter()
+                if tracer is not None:
+                    tracer.record("warp", t0, t_warp, trace_id,
+                                  attrs={"session_id": session_id,
+                                         "seq_no": seq_no})
                 iters = ctl.warm_iters(sess.level)
+                cold_reason = None
+            elif sess.prev_disp_low is None:
+                # Includes expired/evicted sessions: the store already
+                # re-created them, so to this frame they are new.
+                init, iters, cold_reason = None, ctl.cold_iters, "new"
+            elif sess.force_cold:
+                init, iters, cold_reason = None, ctl.cold_iters, "reset"
+            elif seq_no != sess.next_seq:
+                init, iters, cold_reason = None, ctl.cold_iters, \
+                    "out_of_order"
             else:
-                init = None
-                iters = ctl.cold_iters
+                init, iters, cold_reason = None, ctl.cold_iters, "resized"
+            t_fwd0 = time.perf_counter()
             disp, low, compiled = self.engine.infer_stream_batch(
                 [(left, right)], iters, [init])[0]
+            if tracer is not None:
+                seg = getattr(self.engine, "last_segments", None)
+                fwd_end = (seg["dispatch"][1] if seg
+                           else time.perf_counter())
+                tracer.record("forward", t_fwd0, fwd_end, trace_id,
+                              attrs={"session_id": session_id,
+                                     "seq_no": seq_no, "iters": iters,
+                                     "warm": warm, "compile": compiled})
+                if seg is not None:
+                    tracer.record("host_fetch", *seg["host_fetch"],
+                                  trace_id)
             if warm:
                 delta = float(np.mean(np.abs(low - init)))
                 sess.ema = ctl.update_ema(sess.ema, delta)
@@ -110,8 +142,11 @@ class StreamRunner:
             ema = sess.ema
             latency = time.perf_counter() - t0
         if self.metrics is not None:
-            (self.metrics.stream_warm_frames if warm
-             else self.metrics.stream_cold_frames).inc()
+            if warm:
+                self.metrics.stream_warm_frames.inc()
+            else:
+                self.metrics.stream_cold_frames.labels(
+                    reason=cold_reason).inc()
             self.metrics.stream_frame_iters.observe(iters)
             if not compiled:
                 self.metrics.stream_frame_latency.observe(latency)
@@ -153,7 +188,7 @@ def _epe(pred: np.ndarray, gt: Optional[np.ndarray]) -> Optional[float]:
 
 def run_sequence(engine, frames: Sequence[Tuple], stream_cfg: StreamConfig,
                  warm: bool = True, session_id: str = "offline",
-                 metrics=None) -> Dict:
+                 metrics=None, tracer=None) -> Dict:
     """Drive ``frames`` (``(left, right, gt?)`` tuples) through a fresh
     ``StreamRunner`` on ``engine``.
 
@@ -164,7 +199,7 @@ def run_sequence(engine, frames: Sequence[Tuple], stream_cfg: StreamConfig,
     latencies).  Returns per-frame records plus the predictions (kept for
     temporal-consistency metrics and parity tests).
     """
-    runner = StreamRunner(engine, stream_cfg, metrics)
+    runner = StreamRunner(engine, stream_cfg, metrics, tracer=tracer)
     records: List[Dict] = []
     preds: List[np.ndarray] = []
     for t, frame in enumerate(frames):
@@ -205,16 +240,19 @@ def _mean_latency(records: Sequence[Dict]) -> Optional[float]:
 
 
 def compare_warm_cold(engine, frames: Sequence[Tuple],
-                      stream_cfg: StreamConfig, metrics=None) -> Dict:
+                      stream_cfg: StreamConfig, metrics=None,
+                      tracer=None) -> Dict:
     """Warm-start streaming vs the cold full-iteration baseline on the same
     frames; the summary is what ``cli/stream.py`` and ``bench.py --stream``
     report and what the acceptance test asserts."""
     # Cold first: it compiles only ladder[0]; the warm pass then adds the
     # warm levels, so each pass's first-frame compile flags are honest.
     cold = run_sequence(engine, frames, stream_cfg, warm=False,
-                        session_id="baseline", metrics=metrics)
+                        session_id="baseline", metrics=metrics,
+                        tracer=tracer)
     warm = run_sequence(engine, frames, stream_cfg, warm=True,
-                        session_id="stream", metrics=metrics)
+                        session_id="stream", metrics=metrics,
+                        tracer=tracer)
     wr, cr = warm["records"], cold["records"]
     warm_iters_after_first = [r["iters"] for r in wr[1:]]
     warm_epe = wr[-1]["epe"]

@@ -93,6 +93,7 @@ class SessionStore:
                     del self._sessions[sid]
                     if self.metrics is not None:
                         self.metrics.stream_expired.inc()
+                        self.metrics.stream_active.add(-1)
                     sess = None
                 else:
                     sess.last_used = now
@@ -100,18 +101,22 @@ class SessionStore:
                     return sess, False
             sess = Session(sid, last_used=now)
             self._sessions[sid] = sess
+            if self.metrics is not None:
+                # Gauge.add is locked: concurrent HTTP threads create and
+                # expire sessions in parallel, and an unlocked
+                # read-modify-write would lose counts.
+                self.metrics.stream_active.add(1)
             while len(self._sessions) > self.limit:
                 self._sessions.popitem(last=False)
                 if self.metrics is not None:
                     self.metrics.stream_evicted.inc()
-            if self.metrics is not None:
-                self.metrics.stream_active.set(len(self._sessions))
+                    self.metrics.stream_active.add(-1)
             return sess, True
 
     def drop(self, sid: str) -> bool:
         """Explicitly end a session; True if it existed."""
         with self._lock:
             existed = self._sessions.pop(sid, None) is not None
-            if self.metrics is not None:
-                self.metrics.stream_active.set(len(self._sessions))
+            if existed and self.metrics is not None:
+                self.metrics.stream_active.add(-1)
             return existed

@@ -77,8 +77,12 @@ class Logger:
 
     def write_scalar(self, name: str, value: float,
                      step: Optional[int] = None) -> None:
-        """Per-batch scalar (live_loss / lr, reference: train_stereo.py:171)."""
+        """Per-batch scalar (live_loss / lr, reference: train_stereo.py:171).
+
+        Always lands in the JSONL stream, not just TensorBoard — on a
+        torch-free host the scalars used to vanish silently."""
         step = self.total_steps if step is None else step
+        self._emit({"step": step, name: float(value)})
         if self.writer is not None:
             self.writer.add_scalar(name, float(value), step)
 

@@ -243,10 +243,16 @@ class Timer:
         with t("data"): batch = next(it)
         with t("step"): state, m = train_step(state, batch)
         t.summary()  # {'data': {'total': ..., 'mean': ..., 'count': N}, ...}
+
+    O(1) memory per segment name: each accumulator is (count, total, min,
+    max), never a list of observations — a Timer left running in a serving
+    or long-train process must not grow without bound.
     """
 
     def __init__(self):
+        # name -> [count, total, min, max]
         self._acc: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def __call__(self, name: str) -> Iterator[None]:
@@ -254,13 +260,97 @@ class Timer:
         try:
             yield
         finally:
-            self._acc.setdefault(name, []).append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            with self._lock:
+                acc = self._acc.get(name)
+                if acc is None:
+                    self._acc[name] = [1, dt, dt, dt]
+                else:
+                    acc[0] += 1
+                    acc[1] += dt
+                    acc[2] = min(acc[2], dt)
+                    acc[3] = max(acc[3], dt)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            snap = {k: list(v) for k, v in self._acc.items()}
         return {
-            k: {"total": sum(v), "mean": sum(v) / len(v), "count": len(v)}
-            for k, v in self._acc.items() if v
+            k: {"total": total, "mean": total / n, "count": n,
+                "min": lo, "max": hi}
+            for k, (n, total, lo, hi) in snap.items() if n
         }
 
     def reset(self) -> None:
-        self._acc.clear()
+        with self._lock:
+            self._acc.clear()
+
+
+class ProfilerBusy(RuntimeError):
+    """An on-demand capture was requested while one is already running."""
+
+
+class OnDemandProfiler:
+    """Bounded on-demand ``jax.profiler`` windows (``POST /debug/profile``).
+
+    One capture at a time, started from any thread, stopped by a timer
+    thread after ``seconds`` — profiling is heavyweight (device trace +
+    host callstacks), so two overlapping windows would corrupt each other
+    and uncapped duration would let a debug endpoint degrade serving
+    indefinitely.
+    """
+
+    def __init__(self, log_dir: str = "profile",
+                 max_seconds: float = 120.0):
+        self.log_dir = log_dir
+        self.max_seconds = max_seconds
+        self._lock = threading.Lock()
+        self._until: Optional[float] = None
+        self._captures = 0
+
+    @property
+    def running(self) -> bool:
+        with self._lock:
+            return self._until is not None
+
+    def start(self, seconds: float,
+              log_dir: Optional[str] = None) -> Dict[str, object]:
+        """Begin a capture of ``seconds``; raises ``ProfilerBusy`` when one
+        is already running (the mutual exclusion the endpoint maps to HTTP
+        409).  Returns ``{"log_dir", "seconds", "capture"}``."""
+        import jax
+
+        seconds = float(seconds)
+        if not 0 < seconds <= self.max_seconds:
+            raise ValueError(
+                f"seconds must be in (0, {self.max_seconds}], got {seconds}")
+        target = log_dir or self.log_dir
+        with self._lock:
+            if self._until is not None:
+                raise ProfilerBusy(
+                    f"capture already running until ~{self._until:.1f} "
+                    f"(perf_counter)")
+            self._until = time.perf_counter() + seconds
+            self._captures += 1
+            capture = self._captures
+        try:
+            jax.profiler.start_trace(target)
+        except BaseException:
+            with self._lock:
+                self._until = None
+            raise
+        logger.info("on-demand profile #%d: %.2fs -> %s",
+                    capture, seconds, target)
+
+        def _stop():
+            time.sleep(seconds)
+            try:
+                jax.profiler.stop_trace()
+                logger.info("on-demand profile #%d written to %s",
+                            capture, target)
+            finally:
+                with self._lock:
+                    self._until = None
+
+        threading.Thread(target=_stop, daemon=True,
+                         name=f"profile-stop-{capture}").start()
+        return {"log_dir": target, "seconds": seconds, "capture": capture}

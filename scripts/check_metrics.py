@@ -1,0 +1,66 @@
+"""Metric-name lint: keep the telemetry namespace scrapeable and consistent.
+
+Instantiates every metrics bundle in the codebase (``ServeMetrics``,
+``TrainMetrics``) onto ONE shared registry — so a name collision between
+the serve and train namespaces fails here instead of when someone finally
+mounts both on one process — then checks:
+
+* naming conventions (counters end ``_total``, time histograms end
+  ``_seconds``, no ``_total`` on non-counters, non-empty HELP);
+* a fully populated render passes the Prometheus 0.0.4 format validator
+  (raftstereo_tpu/obs/prom.py).
+
+Wired into tier-1 via tests/test_obs.py; runnable standalone:
+
+    python scripts/check_metrics.py   # exit 1 + report on any violation
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+# Runnable from anywhere: the repo root is this file's parent directory.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def check() -> List[str]:
+    """Run all lint passes; returns the list of violations (empty = ok)."""
+    from raftstereo_tpu.obs import lint_registry, validate_prometheus
+    from raftstereo_tpu.serve.metrics import MetricsRegistry, ServeMetrics
+    from raftstereo_tpu.train.telemetry import TrainMetrics
+
+    errors: List[str] = []
+    registry = MetricsRegistry()
+    try:
+        serve = ServeMetrics(registry)
+        TrainMetrics(registry)
+    except ValueError as e:  # duplicate registration across bundles
+        return [f"bundle collision: {e}"]
+    errors += lint_registry(registry.entries())
+
+    # Populate one child per labeled family (families render no samples
+    # until first use) and validate the full exposition.
+    serve.requests.labels(endpoint="predict", outcome="ok").inc()
+    serve.compile_misses.labels(bucket="64x96", iters="8", mode="batch").inc()
+    serve.compile_hits.labels(bucket="64x96", iters="8", mode="stream").inc()
+    serve.stream_cold_frames.labels(reason="new").inc()
+    serve.latency.observe(0.01)
+    errors += validate_prometheus(registry.render())
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"check_metrics: {e}", file=sys.stderr)
+    print(f"check_metrics: {'FAIL' if errors else 'OK'} "
+          f"({len(errors)} violation(s))")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

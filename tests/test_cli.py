@@ -61,6 +61,10 @@ class TestViz:
 class TestTrainCLI:
     @pytest.mark.slow
     def test_train_and_resume(self, tmp_path, rng, monkeypatch):
+        import socket
+        import threading
+        import urllib.request
+
         from raftstereo_tpu.cli.train import train
 
         make_synthetic_kitti(tmp_path / "kitti", n=4, rng=rng)
@@ -73,14 +77,56 @@ class TestTrainCLI:
                            validation_frequency=2, seed=7,
                            checkpoint_dir=str(tmp_path / "ckpt"),
                            data_parallel=2)
-        state = train(mcfg, tcfg, dataset=dataset, num_workers=0,
-                      no_validation=True, profile_steps=(1, 2))
+        # --metrics_port exporter: scrape while the run is live (the
+        # multi-second step compile guarantees a window) — the run itself
+        # is the same one the resume assertions below depend on.
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        scraped = {}
+        stop = threading.Event()
+
+        def poll():
+            base = f"http://127.0.0.1:{port}"
+            while not stop.is_set():
+                try:
+                    for key, path in (("metrics", "/metrics"),
+                                      ("vars", "/debug/vars"),
+                                      ("trace", "/debug/trace?last=50")):
+                        with urllib.request.urlopen(base + path,
+                                                    timeout=2) as r:
+                            scraped[key] = r.read().decode()
+                except Exception:
+                    pass
+                stop.wait(0.05)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        try:
+            state = train(mcfg, tcfg, dataset=dataset, num_workers=0,
+                          no_validation=True, profile_steps=(1, 2),
+                          metrics_port=port)
+        finally:
+            stop.set()
+            poller.join(10)
         assert int(state.step) == 4  # runs to num_steps+1 then stops
         final = tmp_path / "ckpt" / "t" / "t-final"
         assert final.exists()
         # --profile_steps integration: a trace landed in runs/<name>/profile.
         prof_dir = tmp_path / "runs" / "t" / "profile"
         assert any(p.is_file() for p in prof_dir.rglob("*"))
+        # The exporter answered while training: the scrape is valid
+        # Prometheus with the train families, and /debug/vars resolved the
+        # run's config.
+        from raftstereo_tpu.obs import validate_prometheus
+        assert "train_steps_total" in scraped.get("metrics", ""), scraped
+        assert "train_data_wait_seconds" in scraped["metrics"]
+        assert validate_prometheus(scraped["metrics"]) == []
+        dvars = json.loads(scraped["vars"])
+        assert dvars["config"]["name"] == "t"
+        assert "python" in dvars["build"]
+        assert "traceEvents" in json.loads(scraped["trace"])
 
         # Resume: manager restores from step 4; loop exits immediately.
         state2 = train(mcfg, tcfg, dataset=dataset, num_workers=0,

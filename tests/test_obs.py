@@ -1,0 +1,499 @@
+"""Observability subsystem (raftstereo_tpu/obs, docs/observability.md).
+
+Unit coverage for the span tracer, the Prometheus format validator, the
+labeled metric families and the bounded Timer, plus the subsystem's
+acceptance gate: an HTTP e2e that drives ``/predict`` and asserts the
+response carries an ``X-Request-Id`` whose queue-wait / dispatch /
+host-fetch spans appear in ``/debug/trace`` as valid Chrome trace-event
+JSON with durations summing to at most the observed request latency,
+``/metrics`` passes the format validator, span recording overhead stays
+under 2% of request latency, and tracing adds zero XLA compiles.
+"""
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from raftstereo_tpu.config import RAFTStereoConfig, ServeConfig, StreamConfig
+from raftstereo_tpu.obs import (TelemetryServer, Tracer, dump_threads,
+                                lint_registry, parse_sample,
+                                to_chrome_trace, validate_prometheus)
+from raftstereo_tpu.serve import ServeClient, ServeError, ServeMetrics, \
+    build_server
+from raftstereo_tpu.serve.metrics import MetricsRegistry
+from raftstereo_tpu.utils.profiling import Timer
+
+from test_bench import REPO
+
+TINY = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+            corr_radius=2)
+
+
+# ------------------------------------------------------------------- tracer
+
+class TestTracer:
+    def test_nesting_inherits_trace_and_parent(self):
+        tr = Tracer()
+        with tr.span("root") as root:
+            with tr.span("child") as child:
+                assert child.trace_id == root.trace_id
+        spans = {s.name: s for s in tr.spans()}
+        assert spans["child"].parent_id == spans["root"].span_id
+        assert spans["root"].parent_id is None
+        # Children record before parents (they close first) but share the
+        # trace; durations nest.
+        assert spans["child"].duration_s <= spans["root"].duration_s
+
+    def test_record_explicit_window_and_parenting(self):
+        tr = Tracer()
+        rid = tr.new_trace_id()
+        parent = tr.record("dispatch", 1.0, 3.0, rid, attrs={"iters": 8})
+        tr.record("device_compute", 1.5, 2.5, rid, parent_id=parent)
+        a, b = tr.spans()
+        assert a.duration_s == 2.0 and b.parent_id == a.span_id
+        assert a.attrs["iters"] == 8 and b.trace_id == rid
+
+    def test_ring_bound_and_drop_count(self):
+        tr = Tracer(capacity=8)
+        rid = tr.new_trace_id()
+        for i in range(20):
+            tr.record(f"s{i}", 0.0, 1.0, rid)
+        assert len(tr.spans()) == 8
+        assert tr.recorded == 20 and tr.dropped == 12
+        assert [s.name for s in tr.spans()] == [f"s{i}" for i in range(12, 20)]
+        assert tr.spans(last=3)[0].name == "s17"
+
+    def test_thread_safety_under_contention(self):
+        tr = Tracer(capacity=10000)
+
+        def hammer(k):
+            for i in range(500):
+                with tr.span(f"t{k}"):
+                    pass
+
+        ts = [threading.Thread(target=hammer, args=(k,)) for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert tr.recorded == 2000
+
+    def test_chrome_export_shape(self):
+        tr = Tracer()
+        rid = tr.new_trace_id()
+        tr.record("x", 10.0, 10.5, rid)
+        doc = tr.to_chrome()
+        events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert len(events) == 1 and len(meta) == 1
+        (e,) = events
+        assert e["dur"] == pytest.approx(0.5e6)
+        assert e["args"]["trace_id"] == rid
+        assert meta[0]["name"] == "thread_name"
+        json.dumps(doc)  # serializable as-is
+
+    def test_trace_id_filter(self):
+        tr = Tracer()
+        tr.record("a", 0, 1, "rid-1")
+        tr.record("b", 0, 1, "rid-2")
+        assert [s.name for s in tr.spans(trace_id="rid-1")] == ["a"]
+
+
+# -------------------------------------------------------- format validator
+
+GOOD = """\
+# HELP x_total a counter
+# TYPE x_total counter
+x_total{endpoint="predict",outcome="ok"} 3
+# HELP h_seconds a histogram
+# TYPE h_seconds histogram
+h_seconds_bucket{le="0.1"} 1
+h_seconds_bucket{le="+Inf"} 2
+h_seconds_sum 0.5
+h_seconds_count 2
+"""
+
+
+class TestValidator:
+    def test_accepts_valid_exposition(self):
+        assert validate_prometheus(GOOD) == []
+
+    def test_parse_sample_unescapes_structure(self):
+        name, labels, value = parse_sample(
+            'm_total{a="x\\\\y",b="q\\"z",c="n\\nl"} 4')
+        assert name == "m_total" and value == 4.0
+        assert dict(labels) == {"a": "x\\\\y", "b": 'q\\"z', "c": "n\\nl"}
+
+    @pytest.mark.parametrize("bad, why", [
+        ("x_total 1\n", "no TYPE"),
+        ("# TYPE x_total counter\nx_total{le=} 1\n", "bad label"),
+        ("# TYPE x_total counter\nx_total oops\n", "bad value"),
+        ("# TYPE x_total counter\nx_total 1\nx_total 2\n", "dup series"),
+        ("# TYPE x_total wat\nx_total 1\n", "bad type"),
+        ('# TYPE h histogram\nh_bucket{le="+Inf"} 3\nh_sum 1\nh_count 2\n',
+         "+Inf != count"),
+        ('# TYPE x_total counter\nx_total{v="a\\qb"} 1\n', "bad escape"),
+        ("# HELP x_total bad \\q escape\n# TYPE x_total counter\n"
+         "x_total 1\n", "bad HELP escape"),
+    ])
+    def test_rejects_malformed(self, bad, why):
+        assert validate_prometheus(bad) != [], why
+
+    def test_fully_populated_serve_render_validates(self):
+        """Every ServeMetrics instrument populated — including labeled
+        families with hostile label values — renders valid 0.0.4."""
+        m = ServeMetrics()
+        m.requests.labels(endpoint="predict", outcome="ok").inc(2)
+        m.requests.labels(endpoint="stream", outcome="shed").inc()
+        m.responses.inc()
+        m.shed.inc()
+        m.timeouts.inc()
+        m.errors.inc()
+        m.degraded_batches.inc()
+        m.compile_hits.labels(bucket="64x96", iters="8", mode="batch").inc()
+        m.compile_misses.labels(bucket="64x96", iters="8",
+                                mode="stream").inc()
+        m.queue_depth.set(3)
+        m.batch_size.observe(4)
+        m.latency.observe(0.02)
+        m.batch_latency.observe(0.01)
+        m.stream_active.add(2)
+        m.stream_warm_frames.inc()
+        # Hostile label values: backslash, quote, newline must escape.
+        m.stream_cold_frames.labels(reason='a\\b"c\nd').inc()
+        m.stream_evicted.inc()
+        m.stream_expired.inc()
+        m.stream_frame_iters.observe(8)
+        m.stream_frame_latency.observe(0.05)
+        text = m.render()
+        assert validate_prometheus(text) == []
+        # The hostile value round-trips through the parser's escape rules.
+        line = [l for l in text.splitlines()
+                if l.startswith("stream_cold_frames_total{")][0]
+        _, labels, v = parse_sample(line)
+        assert v == 1.0
+        assert dict(labels)["reason"] == 'a\\\\b\\"c\\nd'
+
+    def test_family_label_validation(self):
+        r = MetricsRegistry()
+        fam = r.counter("f_total", "f", labels=("a", "b"))
+        with pytest.raises(ValueError, match="labels"):
+            fam.labels(a="1")
+        with pytest.raises(ValueError, match="labels"):
+            fam.labels(a="1", b="2", c="3")
+        assert fam.labels(a="1", b="2") is fam.labels(b="2", a="1")
+
+    def test_lint_flags_bad_names(self):
+        r = MetricsRegistry()
+        r.counter("requests", "missing suffix")
+        r.gauge("depth_total", "total on a gauge")
+        r.histogram("req_latency", "time histogram without unit")
+        r.counter("ok_total", "")
+        errs = "\n".join(lint_registry(r.entries()))
+        assert "requests: counter names" in errs
+        assert "depth_total: _total suffix" in errs
+        assert "req_latency: time histogram" in errs
+        assert "ok_total: empty HELP" in errs
+
+    def test_repo_bundles_pass_check_metrics(self):
+        """scripts/check_metrics.py is the tier-1 gate: serve + train
+        bundles coexist on one registry, lint-clean, render-valid."""
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from scripts.check_metrics import check
+
+        assert check() == []
+
+
+# --------------------------------------------------- bounded Timer + Gauge
+
+class TestBoundedInstruments:
+    def test_timer_accumulators_are_o1(self):
+        t = Timer()
+        for _ in range(10000):
+            with t("seg"):
+                pass
+        s = t.summary()["seg"]
+        assert s["count"] == 10000
+        assert s["min"] <= s["mean"] <= s["max"]
+        assert s["total"] >= s["mean"]
+        # The accumulator is 4 scalars, not a 10000-observation list.
+        assert len(t._acc["seg"]) == 4
+
+    def test_gauge_concurrent_add_loses_nothing(self):
+        m = ServeMetrics()
+
+        def bump():
+            for _ in range(1000):
+                m.stream_active.add(1)
+                m.stream_active.add(-1)
+
+        ts = [threading.Thread(target=bump) for _ in range(4)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        assert m.stream_active.value == 0.0
+
+
+# --------------------------------------------------------- logger satellite
+
+class TestLoggerJsonl:
+    def test_write_scalar_survives_without_tensorboard(self, tmp_path,
+                                                       monkeypatch):
+        from raftstereo_tpu.train import logger as logger_mod
+
+        monkeypatch.setattr(logger_mod, "_make_tb_writer", lambda d: None)
+        log = logger_mod.Logger(log_dir=str(tmp_path),
+                                jsonl_path=str(tmp_path / "m.jsonl"))
+        log.write_scalar("live_loss", 1.5, step=3)
+        log.write_scalar("lr", 2e-4, step=3)
+        log.close()
+        records = [json.loads(l) for l in
+                   (tmp_path / "m.jsonl").read_text().splitlines()]
+        assert {"step": 3, "live_loss": 1.5} in records
+        assert any(r.get("lr") == 2e-4 for r in records)
+
+
+# ------------------------------------------------------- telemetry exporter
+
+class TestTelemetryServer:
+    def test_endpoints(self):
+        from raftstereo_tpu.train.telemetry import TrainMetrics
+
+        tm = TrainMetrics()
+        tm.observe_step(step_s=0.1, data_s=0.05)
+        tm.observe_health({"data_samples_retried": 2.0,
+                           "watchdog_slow": 1.0})
+        tracer = Tracer()
+        tracer.record("step", 0.0, 0.1, tracer.new_trace_id(),
+                      attrs={"step": 1})
+        srv = TelemetryServer(tm.registry, tracer,
+                              vars_fn=lambda: {"config": {"name": "x"}},
+                              host="127.0.0.1").start()
+        try:
+            client = ServeClient("127.0.0.1", srv.port)
+            text = client.metrics_text()
+            assert validate_prometheus(text) == []
+            assert "train_steps_total 1" in text
+            assert "data_samples_retried 2" in text
+            assert "train_watchdog_slow_total 1" in text
+            trace = client.debug_trace(last=10)
+            names = [e["name"] for e in trace["traceEvents"]
+                     if e["ph"] == "X"]
+            assert names == ["step"]
+            threads = client.debug_threads()
+            assert "telemetry-http" in threads or "MainThread" in threads
+            dvars = client.debug_vars()
+            assert dvars["config"]["name"] == "x"
+            assert dvars["build"]["pid"] > 0
+            with pytest.raises(ServeError) as ei:
+                client._get_json("/nope")
+            assert ei.value.status == 404
+            client.close()
+        finally:
+            srv.close()
+
+    def test_data_wait_fraction_math(self):
+        from raftstereo_tpu.train.telemetry import TrainMetrics
+
+        tm = TrainMetrics()
+        tm.observe_step(step_s=0.3, data_s=0.1)
+        tm.observe_step(step_s=0.3, data_s=0.1)
+        assert tm.data_wait_frac.value == pytest.approx(0.25)
+        assert tm.steps.value == 2
+        assert tm.steps_per_sec.value > 0
+
+    def test_dump_threads_sees_this_thread(self):
+        out = dump_threads()
+        assert "test_dump_threads_sees_this_thread" in out
+
+
+# ----------------------------------------------------------- stream spans
+
+class _StubStreamEngine:
+    """StreamRunner contract stand-in: no model, no compiles."""
+
+    low = (16, 24)
+
+    def bucket_of(self, shape):
+        return (64, 96)
+
+    def low_hw(self, hw):
+        return self.low
+
+    def infer_stream_batch(self, pairs, iters, inits):
+        return [(np.zeros(p[0].shape[:2], np.float32),
+                 np.zeros(self.low, np.float32), False) for p in pairs]
+
+
+class TestStreamSpans:
+    def test_warp_forward_spans_and_cold_reasons(self):
+        from raftstereo_tpu.stream.runner import StreamRunner
+
+        cfg = StreamConfig(ladder=(8, 4), session_limit=4)
+        metrics = ServeMetrics()
+        tracer = Tracer()
+        runner = StreamRunner(_StubStreamEngine(), cfg, metrics,
+                              tracer=tracer)
+        img = np.zeros((60, 90, 3), np.float32)
+        r0 = runner.step("cam", 0, img, img, trace_id="rid-0")
+        r1 = runner.step("cam", 1, img, img, trace_id="rid-1")
+        assert not r0.warm and r1.warm
+        names0 = [s.name for s in tracer.spans(trace_id="rid-0")]
+        names1 = [s.name for s in tracer.spans(trace_id="rid-1")]
+        assert names0 == ["forward"]            # cold: no warp
+        assert names1 == ["warp", "forward"]    # warm: warp then forward
+        # Cold reasons land as labels; out-of-order re-runs cold.
+        runner.step("cam", 7, img, img)
+        text = metrics.render()
+        assert 'stream_cold_frames_total{reason="new"} 1' in text
+        assert 'stream_cold_frames_total{reason="out_of_order"} 1' in text
+
+
+# ------------------------------------------------------------------ end2end
+
+@pytest.fixture(scope="module")
+def obs_server():
+    """Tiny real server, warmed (one executable: iters == degraded_iters),
+    shared by the e2e tests so the XLA compile is paid once."""
+    from raftstereo_tpu.models import RAFTStereo
+
+    model = RAFTStereo(RAFTStereoConfig(**TINY))
+    variables = model.init(jax.random.key(0), (64, 96))
+    cfg = ServeConfig(port=0, bucket_multiple=32, buckets=((60, 90),),
+                      warmup=True, max_batch_size=2, max_wait_ms=5.0,
+                      queue_limit=16, request_timeout_ms=60000.0, iters=3,
+                      degraded_iters=3, degrade_queue_depth=16,
+                      trace_buffer=512)
+    metrics = ServeMetrics()
+    server = build_server(model, variables, cfg, metrics)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server
+    server.close()
+    thread.join(10)
+
+
+def _img(h=60, w=90, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (h, w, 3)).astype(np.float32)
+
+
+class TestEndToEnd:
+    def test_request_trace_roundtrip(self, obs_server):
+        """Acceptance gate: X-Request-Id on /predict; /debug/trace returns
+        valid Chrome trace-event JSON containing that id with queue-wait,
+        dispatch and host-fetch spans whose durations sum to <= the
+        observed request latency; /metrics passes the format validator;
+        span overhead < 2% of request latency; zero new XLA compiles."""
+        server = obs_server
+        compiled_before = set(server.engine.compiled_keys)
+        client = ServeClient("127.0.0.1", server.port, timeout=120)
+        t0 = time.perf_counter()
+        disp, meta = client.predict(_img(), _img(seed=1))
+        observed_latency = time.perf_counter() - t0
+        assert disp.shape == (60, 90)
+        rid = meta["request_id"]
+        assert rid  # header + meta both carry it
+
+        trace = client.debug_trace()
+        events = [e for e in trace["traceEvents"]
+                  if e["ph"] == "X" and e["args"].get("trace_id") == rid]
+        by_name = {e["name"]: e for e in events}
+        for required in ("admission", "queue_wait", "dispatch",
+                         "host_fetch", "request"):
+            assert required in by_name, sorted(by_name)
+        core = ["queue_wait", "dispatch", "host_fetch"]
+        total_s = sum(by_name[n]["dur"] for n in core) / 1e6
+        assert 0 < total_s <= observed_latency
+        # Phases are consistent: the engine phases sit inside the server's
+        # request window.
+        assert by_name["request"]["dur"] / 1e6 <= observed_latency
+
+        # /metrics: format-valid, labeled families populated.
+        text = client.metrics_text()
+        assert validate_prometheus(text) == []
+        assert 'serve_requests_total{endpoint="predict",outcome="ok"}' \
+            in text
+        assert 'serve_compile_cache_hits_total{bucket="64x96",iters="3",' \
+            in text
+
+        # Bad request -> 400 with its own request id, counted by outcome.
+        with pytest.raises(ServeError) as ei:
+            client.predict(_img(), _img(70, 100))
+        assert ei.value.request_id  # error replies keep their trace key
+        text = client.metrics_text()
+        assert ('serve_requests_total{endpoint="predict",'
+                'outcome="bad_request"} 1') in text
+
+        # Tracing added zero XLA compiles: warmup paid the only one.
+        assert set(server.engine.compiled_keys) == compiled_before
+        assert server.metrics.compile_misses.value == 1
+
+        # Overhead: per-span record cost x spans-per-request under 2% of
+        # the observed latency (measured, not assumed).
+        bench_tracer = Tracer(capacity=256)
+        bid = bench_tracer.new_trace_id()
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            bench_tracer.record("bench", 0.0, 1.0, bid,
+                                attrs={"bucket": "64x96"})
+        per_span = (time.perf_counter() - t0) / n
+        assert per_span < 200e-6  # sanity: recording is microseconds
+        spans_per_request = len(events)
+        assert spans_per_request * per_span < 0.02 * observed_latency
+        client.close()
+
+    def test_debug_vars_threads_profile(self, obs_server):
+        server = obs_server
+        client = ServeClient("127.0.0.1", server.port, timeout=120)
+        dvars = client.debug_vars()
+        assert dvars["config"]["max_batch_size"] == 2
+        assert dvars["config"]["iters"] == 3
+        assert dvars["trace"]["capacity"] == 512
+        assert dvars["build"]["pid"] > 0
+        threads = client.debug_threads()
+        assert "serve-batcher" in threads  # the deadlock-debug surface
+
+        # On-demand profiler: second capture while one runs -> 409;
+        # after it finishes a new one is accepted.
+        info = client.debug_profile(seconds=0.4)
+        assert info["seconds"] == 0.4
+        with pytest.raises(ServeError) as ei:
+            client.debug_profile(seconds=0.4)
+        assert ei.value.status == 409
+        deadline = time.time() + 10
+        while server.profiler.running and time.time() < deadline:
+            time.sleep(0.05)
+        assert not server.profiler.running
+        with pytest.raises(ServeError) as ei:
+            client.debug_profile(seconds=0)  # out of bounds -> 400
+        assert ei.value.status == 400
+        client.close()
+
+    def test_trace_query_filters(self, obs_server):
+        server = obs_server
+        client = ServeClient("127.0.0.1", server.port, timeout=120)
+        _, meta = client.predict(_img(), _img(seed=1))
+        rid = meta["request_id"]
+        only = client.debug_trace(trace_id=rid)
+        ids = {e["args"]["trace_id"] for e in only["traceEvents"]
+               if e["ph"] == "X"}
+        assert ids == {rid}
+        last2 = client.debug_trace(last=2)
+        assert len([e for e in last2["traceEvents"]
+                    if e["ph"] == "X"]) == 2
+        client.close()
+
+    def test_chrome_export_helper_matches_endpoint(self, obs_server):
+        spans = obs_server.tracer.spans(last=5)
+        doc = to_chrome_trace(spans)
+        assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) == 5

@@ -231,11 +231,14 @@ class TestEngine:
 
 class TestMetrics:
     def test_prometheus_render_parses(self):
+        from raftstereo_tpu.obs import validate_prometheus
+
         m = ServeMetrics()
-        m.requests.inc(3)
+        m.requests.labels(endpoint="predict", outcome="ok").inc(3)
         m.queue_depth.set(2)
         m.latency.observe(0.05)
         m.batch_size.observe(4)
+        m.compile_misses.labels(bucket="64x96", iters="8", mode="batch").inc()
         text = m.render()
         for line in text.strip().splitlines():
             if line.startswith("#"):
@@ -244,10 +247,15 @@ class TestMetrics:
             name, value = line.rsplit(" ", 1)
             float(value)  # every sample line ends in a number
             assert name
-        assert "serve_requests_total 3" in text
+        assert validate_prometheus(text) == []
+        assert 'serve_requests_total{endpoint="predict",outcome="ok"} 3' \
+            in text
+        assert m.requests.value == 3  # label-blind total
         assert "serve_queue_depth 2" in text
         assert 'serve_request_latency_seconds_bucket{le="+Inf"} 1' in text
         assert "serve_batch_size_count 1" in text
+        assert ('serve_compile_cache_misses_total{bucket="64x96",iters="8",'
+                'mode="batch"} 1') in text
 
     def test_duplicate_metric_name_rejected(self):
         from raftstereo_tpu.serve import MetricsRegistry

@@ -356,8 +356,13 @@ class TestEndToEnd:
             text = client.metrics_text()
 
             def sample(name):
-                return float([l for l in text.splitlines()
-                              if l.startswith(name + " ")][0].split()[-1])
+                # Labeled families render one series per label set; the
+                # label-blind total is their sum.
+                vals = [float(l.split()[-1]) for l in text.splitlines()
+                        if l.startswith(name + " ")
+                        or l.startswith(name + "{")]
+                assert vals, f"no samples for {name}"
+                return sum(vals)
 
             assert sample("stream_warm_frames_total") >= 4
             assert sample("stream_cold_frames_total") >= 6
