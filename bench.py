@@ -719,6 +719,24 @@ def main() -> None:
                         "(photometric jitter + eraser moved on-device, "
                         "host does decode + spatial aug only)")
     args = p.parse_args()
+
+    # Perf rounds must not land on top of known hazards: the smoke modes
+    # refuse to run while the static-analysis baseline has entries
+    # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
+    if args.quick or args.serve or args.stream:
+        from raftstereo_tpu.analysis import (baseline_entries,
+                                             default_baseline_path)
+        try:
+            n_dirty = sum(baseline_entries().values())
+        except ValueError as e:  # hand-edited baseline gone bad
+            sys.exit(f"bench: refusing to run: {e}")
+        if n_dirty:
+            sys.exit(f"bench: refusing to run: the static-analysis "
+                     f"baseline ({default_baseline_path()}) is dirty — "
+                     f"{n_dirty} known finding(s).  Fix them (or "
+                     "regenerate the baseline) before benchmarking; see "
+                     "docs/static_analysis.md.")
+
     explicit_hw = args.height is not None or args.width is not None
     explicit_iters = args.iters is not None
     explicit_reps = args.reps is not None

@@ -1,0 +1,295 @@
+"""Shared infrastructure for the RSA static-analysis checkers.
+
+Everything here is stdlib-only and import-side-effect free: the checkers
+parse source with ``ast``/``tokenize`` and never import the code under
+analysis, so ``python -m raftstereo_tpu.analysis`` is safe to run in any
+environment (CI, a TPU pod, a laptop without jax configured).
+
+Building blocks:
+
+* :class:`Finding` — one diagnostic, with a stable ``RSA###`` code, a
+  repo-relative ``path:line`` anchor and a *context* (the enclosing
+  ``Class.method`` qualname) that keys the baseline, so baselined findings
+  survive unrelated line drift.
+* :class:`SourceFile` — parsed module + its comment-derived side tables:
+  per-line ``# noqa: RSA###`` suppressions and ``# guarded_by: <lock>``
+  annotations (locks.py), extracted with ``tokenize`` so strings that
+  merely *contain* those markers don't count.
+* baseline load/save/apply — a checked-in multiset of known findings
+  (``code path context``, one line per occurrence) that lets the runner
+  gate on NEW findings only.  The shipped baseline is empty and the tier-1
+  suite keeps it that way (tests/test_analysis.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "SourceFile", "attach_parents", "qualname_of",
+           "iter_python_files", "load_baseline", "save_baseline",
+           "apply_baseline", "format_finding", "dotted_name",
+           "resolve_root", "module_functions", "literal_argnums"]
+
+_NOQA_RE = re.compile(r"#\s*noqa\s*:\s*(RSA\d{3}(?:\s*,\s*RSA\d{3})*)",
+                      re.IGNORECASE)
+_GUARDED_RE = re.compile(r"#\s*guarded_by\s*:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``code`` is the stable RSA### id, ``path``/``line``
+    the anchor, ``context`` the enclosing qualname used as the baseline
+    key (lines drift; qualnames rarely do)."""
+
+    code: str
+    path: str
+    line: int
+    message: str
+    context: str = "<module>"
+
+    @property
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.code, self.path, self.context)
+
+
+def format_finding(f: Finding) -> str:
+    return f"{f.path}:{f.line}: {f.code} [{f.context}] {f.message}"
+
+
+def attach_parents(tree: ast.AST) -> None:
+    """Give every node a ``.rsa_parent`` pointer (the checkers walk
+    ancestry for ``with`` containment and qualnames)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.rsa_parent = node  # type: ignore[attr-defined]
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, "rsa_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "rsa_parent", None)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing FunctionDef/AsyncFunctionDef/Lambda (not
+    counting ``node`` itself)."""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return anc
+    return None
+
+
+def qualname_of(node: ast.AST) -> str:
+    """``Class.method`` style context for a node (baseline key)."""
+    parts: List[str] = []
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(anc.name)
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        parts.insert(0, node.name)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class SourceFile:
+    """One parsed module plus its comment side tables."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath
+        with open(abspath, "r", encoding="utf-8") as fh:
+            self.source = fh.read()
+        self.tree = ast.parse(self.source, filename=relpath)
+        attach_parents(self.tree)
+        # line -> set of suppressed codes; line -> guarded_by lock name.
+        self.noqa: Dict[int, Set[str]] = {}
+        self.guarded_by: Dict[int, str] = {}
+        self._scan_comments()
+        # Import alias table: local name -> canonical module path
+        # ("np" -> "numpy", "jnp" -> "jax.numpy", "pl" -> ...pallas).
+        self.import_aliases: Dict[str, str] = {}
+        self._scan_imports()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _NOQA_RE.search(tok.string)
+                if m:
+                    codes = {c.strip().upper()
+                             for c in m.group(1).split(",")}
+                    self.noqa.setdefault(tok.start[0], set()).update(codes)
+                g = _GUARDED_RE.search(tok.string)
+                if g:
+                    self.guarded_by[tok.start[0]] = g.group(1)
+        except tokenize.TokenError:  # pragma: no cover - defensive
+            pass
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = \
+                        alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.import_aliases[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    def resolve(self, dotted: str) -> str:
+        """Canonicalize the leading segment of ``a.b.c`` through the
+        import table (``np.random.rand`` -> ``numpy.random.rand``)."""
+        head, _, rest = dotted.partition(".")
+        head = self.import_aliases.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+    def suppressed(self, code: str, line: int) -> bool:
+        return code in self.noqa.get(line, set())
+
+
+def resolve_root(sf: SourceFile, call_func: ast.AST) -> Optional[str]:
+    """Canonical dotted name of a call target, or None."""
+    name = dotted_name(call_func)
+    return sf.resolve(name) if name else None
+
+
+def module_functions(tree: ast.AST) -> Dict[str, ast.AST]:
+    """name -> FunctionDef for every def in the file (scope-flattened
+    approximation; good enough to resolve ``jax.jit(fn)`` references)."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def literal_argnums(call: ast.Call, keyword: str) -> Optional[List[int]]:
+    """The literal int positions of ``keyword=`` (e.g. ``static_argnums``
+    / ``donate_argnums``) on a call, or None when absent or not
+    statically known."""
+    for kw in call.keywords:
+        if kw.arg != keyword:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out: List[int] = []
+            for e in v.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)):
+                    return None
+                out.append(e.value)
+            return out
+        return None
+    return None
+
+
+# -------------------------------------------------------------- file walking
+
+def iter_python_files(paths: Sequence[str],
+                      repo_root: Optional[str] = None) -> List[Tuple[str,
+                                                                     str]]:
+    """(abspath, relpath) for every .py under ``paths`` (files or dirs),
+    sorted, skipping __pycache__.  ``relpath`` is relative to
+    ``repo_root`` (default: cwd) — the stable identity in findings and
+    the baseline."""
+    root = os.path.abspath(repo_root or os.getcwd())
+    out = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if not os.path.exists(ap):
+            # Loud, not an empty result: a typo'd path in a CI hook must
+            # not report the tree green with zero files analyzed.
+            raise FileNotFoundError(f"analysis target does not exist: {p}")
+        if os.path.isfile(ap):
+            out.append(ap)
+        else:
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                out.extend(os.path.join(dirpath, f) for f in filenames
+                           if f.endswith(".py"))
+    uniq = sorted(set(out))
+    return [(ap, os.path.relpath(ap, root).replace(os.sep, "/"))
+            for ap in uniq]
+
+
+# ----------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> "collections.Counter[Tuple[str, str, str]]":
+    """Baseline multiset from a ``code path context`` per-line file.
+    Missing file = empty baseline."""
+    counter: collections.Counter = collections.Counter()
+    if not os.path.exists(path):
+        return counter
+    with open(path, "r", encoding="utf-8") as fh:
+        for n, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 3 or not re.match(r"^RSA\d{3}$", parts[0]):
+                raise ValueError(
+                    f"{path}:{n}: malformed baseline entry {line!r} "
+                    "(expected 'RSA### path context')")
+            counter[(parts[0], parts[1], parts[2])] += 1
+    return counter
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    lines = sorted(" ".join(f.baseline_key) for f in findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(
+            "# RSA static-analysis baseline (docs/static_analysis.md).\n"
+            "# One 'RSA### path context' line per known finding; empty\n"
+            "# means the tree is clean.  Regenerate with:\n"
+            "#   python -m raftstereo_tpu.analysis --update-baseline\n")
+        for line in lines:
+            fh.write(line + "\n")
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: "collections.Counter[Tuple[str, str, str]]",
+                   ) -> Tuple[List[Finding], List[Tuple[str, str, str]]]:
+    """Split findings against the baseline multiset.
+
+    Returns ``(new_findings, stale_entries)``: findings not covered by
+    the baseline, and baseline entries no finding matched (fixed code
+    whose baseline line should be deleted).
+    """
+    remaining = collections.Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        if remaining.get(f.baseline_key, 0) > 0:
+            remaining[f.baseline_key] -= 1
+        else:
+            new.append(f)
+    stale = sorted(remaining.elements())
+    return new, stale

@@ -1,0 +1,172 @@
+"""RSA2xx — donation safety: use-after-donate and bad donate indices.
+
+``donate_argnums`` hands the argument's buffers to XLA for reuse; the
+Python reference still exists but the array is *deleted* — touching it
+afterwards raises ``RuntimeError: Array has been deleted`` (and on this
+container's broken persistent-cache path it SIGSEGVs, see CHANGES.md
+PR 2).  Donation bugs only trip at runtime on the donated call's SECOND
+use, so they routinely survive unit tests; this checker catches them at
+lint time:
+
+* RSA201 — a variable passed at a donated position is read again later
+  in the same function without being reassigned first.
+* RSA202 — ``donate_argnums`` names a position the wrapped function does
+  not have (when the callee is resolvable in the same module).
+
+Analysis is linear-flow within one function body (statement line order,
+reassignment clears the taint) — the same approximation every
+use-after-move lint makes.  Reads *before* a donation inside a loop body
+that re-executes are not modeled (documented in docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .core import (Finding, SourceFile, dotted_name, literal_argnums,
+                   module_functions, qualname_of)
+
+__all__ = ["check"]
+
+_JIT_NAMES = ("jax.jit", "jit", "jax.pmap", "pmap")
+
+
+def _is_jit(sf: SourceFile, func: ast.AST) -> bool:
+    dn = dotted_name(func)
+    if dn is None:
+        return False
+    resolved = sf.resolve(dn)
+    return any(resolved == n or resolved.endswith("." + n)
+               for n in _JIT_NAMES)
+
+
+def _donate_positions(call: ast.Call) -> Optional[List[int]]:
+    return literal_argnums(call, "donate_argnums")
+
+
+def _param_count(fn: ast.AST) -> int:
+    a = fn.args
+    return len(a.posonlyargs) + len(a.args)
+
+
+def _function_bodies(tree: ast.AST) -> List[ast.AST]:
+    """Module root + every def, for per-scope linear analysis."""
+    out: List[ast.AST] = [tree]
+    out.extend(n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    return out
+
+
+def _local_walk(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk a scope without descending into nested defs/lambdas (those
+    are separate scopes with their own bindings)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _enclosing_scope(node: ast.AST, tree: ast.AST) -> ast.AST:
+    cur = getattr(node, "rsa_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "rsa_parent", None)
+    return tree
+
+
+def check(sf: SourceFile) -> Iterator[Finding]:
+    defs = module_functions(sf.tree)
+
+    # Donating callables per declaring scope (name -> positions); a
+    # nested function resolves through its lexical scope chain, and two
+    # functions' same-named locals never collide.
+    by_scope: Dict[int, Dict[str, List[int]]] = {}
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call) and _is_jit(sf, node.func)):
+            continue
+        pos = _donate_positions(node)
+        if pos is None:
+            continue
+        # RSA202: positions beyond the wrapped function's signature
+        # (a *args callee accepts any index — skip it).
+        callee = node.args[0] if node.args else None
+        if isinstance(callee, ast.Name) and callee.id in defs \
+                and defs[callee.id].args.vararg is None:
+            n_params = _param_count(defs[callee.id])
+            for p in pos:
+                if p >= n_params:
+                    yield Finding(
+                        "RSA202", sf.path, node.lineno,
+                        f"donate_argnums position {p} is out of range: "
+                        f"`{callee.id}` takes {n_params} positional "
+                        "argument(s)",
+                        qualname_of(node))
+        parent = getattr(node, "rsa_parent", None)
+        if isinstance(parent, ast.Assign):
+            scope = _enclosing_scope(node, sf.tree)
+            for tgt in parent.targets:
+                if isinstance(tgt, ast.Name):
+                    by_scope.setdefault(id(scope), {})[tgt.id] = pos
+
+    if not by_scope:
+        return
+
+    for scope in _function_bodies(sf.tree):
+        # Lexical resolution: outer scopes first, the scope's own
+        # bindings win.
+        chain = [scope]
+        cur = scope
+        while cur is not sf.tree:
+            cur = _enclosing_scope(cur, sf.tree)
+            chain.append(cur)
+        donating: Dict[str, List[int]] = {}
+        for s in reversed(chain):
+            donating.update(by_scope.get(id(s), {}))
+        if not donating:
+            continue
+        # Linear event lists: (line, name) for donations / stores / loads.
+        donations: List[Tuple[int, str]] = []
+        stores: List[Tuple[int, str]] = []
+        loads: List[Tuple[int, str, ast.AST]] = []
+        for node in _local_walk(scope):
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Name):
+                pos = donating.get(node.func.id)
+                if pos:
+                    for p in pos:
+                        if p < len(node.args) and isinstance(node.args[p],
+                                                             ast.Name):
+                            donations.append((node.lineno,
+                                              node.args[p].id))
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    stores.append((node.lineno, node.id))
+                elif isinstance(node.ctx, ast.Load):
+                    loads.append((node.lineno, node.id, node))
+        if not donations:
+            continue
+        flagged = set()
+        for lline, name, node in loads:
+            for dline, dname in donations:
+                if dname != name or lline <= dline:
+                    continue
+                # A reassignment at or after the donating call (and
+                # before the read) clears the taint.
+                if any(sname == name and dline <= sline < lline
+                       for sline, sname in stores):
+                    continue
+                if (name, lline) in flagged:
+                    continue
+                flagged.add((name, lline))
+                yield Finding(
+                    "RSA201", sf.path, lline,
+                    f"`{name}` read after being donated (line {dline}): "
+                    "donated buffers are deleted by XLA — rebind the "
+                    "result or drop the donation",
+                    qualname_of(node))

@@ -1,0 +1,247 @@
+"""RSA1xx — jit/Pallas hygiene: impurity, host syncs and retrace hazards.
+
+The serving stack's latency guarantees assume "one compile per bucket"
+(serve/engine.py) and that traced code is pure.  These checkers catch the
+ways that goes wrong at lint time:
+
+* RSA101 — impure call inside a traced function (``time.*``,
+  ``np.random.*``, ``random.*``, ``print`` ...): executes once at trace
+  time, silently freezes into the executable, and diverges from eager.
+* RSA102 — host sync on a traced value (``float()``/``int()``/``bool()``,
+  ``np.asarray``/``np.array``, ``.item()``/``.tolist()``): forces a
+  device->host transfer mid-program, or fails outright under jit.
+* RSA103 — ``global``/``nonlocal`` mutation inside a traced function:
+  runs at trace time only, so the mutation happens once per *compile*,
+  not once per call.
+* RSA104 — unhashable literal (list/dict/set) passed in a
+  ``static_argnums`` position: raises at runtime on every call.
+* RSA105 — ``jax.jit(...)(...)`` built and invoked in one expression:
+  the wrapper (and its dispatch cache) is discarded per call, so every
+  call re-traces.
+* RSA106 — ``jax.jit`` created inside a ``for``/``while`` body: a fresh
+  wrapper per iteration re-traces per iteration (the classic
+  Python-scalar-closure silent retrace).
+
+Traced functions are discovered structurally: ``@jax.jit``-style
+decorators (including ``partial(jax.jit, ...)``), lambdas or same-module
+function names passed to ``jax.jit`` / ``jax.pmap`` / ``jax.vmap`` /
+``jax.grad`` / ``jax.checkpoint`` / ``pl.pallas_call`` /
+``shard_map``, and body/cond callables of ``lax.scan`` / ``while_loop``
+/ ``fori_loop`` / ``cond``.  Calls *out* of a traced function into other
+module code are not followed (documented limit — docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import (Finding, SourceFile, dotted_name, enclosing_function,
+                   literal_argnums, module_functions, qualname_of)
+
+__all__ = ["check"]
+
+# Canonical call roots that are jit-like wrappers (their first positional
+# argument is traced).  Key: resolved dotted suffix.
+_TRACING_WRAPPERS = ("jax.jit", "jit", "jax.pmap", "pmap", "jax.vmap",
+                     "vmap", "jax.grad", "grad", "jax.value_and_grad",
+                     "value_and_grad", "jax.checkpoint", "checkpoint",
+                     "jax.remat", "remat", "pallas_call", "shard_map")
+# (canonical-suffix, positional indices of traced callables)
+_BODY_TAKERS = (("lax.scan", (0,)), ("lax.while_loop", (0, 1)),
+                ("lax.fori_loop", (2,)), ("lax.cond", (1, 2)),
+                ("lax.switch", ()),)
+
+_JIT_NAMES = ("jax.jit", "jit")
+
+# RSA101: canonical dotted prefixes that are impure at trace time.
+_IMPURE_PREFIXES = ("time.", "random.", "numpy.random.", "os.urandom",
+                    "uuid.uuid", "datetime.datetime.now",
+                    "datetime.datetime.utcnow", "secrets.")
+_IMPURE_BUILTINS = ("print", "input", "open")
+
+# RSA102: host-sync calls.
+_SYNC_BUILTINS = ("float", "int", "bool", "complex")
+_SYNC_NUMPY = ("numpy.asarray", "numpy.array", "numpy.copy",
+               "numpy.float32", "numpy.float64", "numpy.int32",
+               "numpy.int64")
+_SYNC_METHODS = ("item", "tolist", "__array__")
+
+
+def _is_wrapper(sf: SourceFile, func: ast.AST,
+                names: Tuple[str, ...] = _TRACING_WRAPPERS) -> bool:
+    dn = dotted_name(func)
+    if dn is None:
+        return False
+    resolved = sf.resolve(dn)
+    return any(resolved == n or resolved.endswith("." + n) for n in names)
+
+
+def _partial_of_wrapper(sf: SourceFile, node: ast.AST) -> bool:
+    """``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)``."""
+    return (isinstance(node, ast.Call)
+            and _is_wrapper(sf, node.func, ("partial", "functools.partial"))
+            and node.args
+            and _is_wrapper(sf, node.args[0]))
+
+
+def _traced_roots(sf: SourceFile) -> List[ast.AST]:
+    """Every function/lambda node whose body executes under a trace."""
+    defs = module_functions(sf.tree)
+    roots: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Name):
+            node = defs.get(node.id)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and id(node) not in seen:
+            seen.add(id(node))
+            roots.append(node)
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_wrapper(sf, dec) or _partial_of_wrapper(sf, dec):
+                    add(node)
+        elif isinstance(node, ast.Call):
+            if _is_wrapper(sf, node.func) and node.args:
+                add(node.args[0])
+            for suffix, idxs in _BODY_TAKERS:
+                dn = dotted_name(node.func)
+                if dn is None:
+                    continue
+                resolved = sf.resolve(dn)
+                if resolved == suffix or resolved.endswith("." + suffix):
+                    for i in idxs:
+                        if i < len(node.args):
+                            add(node.args[i])
+    return roots
+
+
+def _walk_within(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk a traced function's body, including nested lambdas/defs (they
+    trace too when called)."""
+    yield from ast.walk(root)
+
+
+def _check_traced_body(sf: SourceFile, root: ast.AST) -> Iterator[Finding]:
+    ctx = qualname_of(root if not isinstance(root, ast.Lambda)
+                      else (enclosing_function(root) or root))
+    if isinstance(root, ast.Lambda) and ctx == "<module>":
+        ctx = "<lambda>"
+    for node in _walk_within(root):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            yield Finding(
+                "RSA103", sf.path, node.lineno,
+                f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                f" {', '.join(node.names)}` inside a traced function: the "
+                "mutation runs at trace time (once per compile), not per "
+                "call", ctx)
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func)
+        resolved = sf.resolve(dn) if dn else None
+        if resolved is not None:
+            if (any(resolved.startswith(p) for p in _IMPURE_PREFIXES)
+                    or resolved in _IMPURE_BUILTINS):
+                yield Finding(
+                    "RSA101", sf.path, node.lineno,
+                    f"impure call `{dn}(...)` inside a traced function: "
+                    "executes at trace time and freezes into the "
+                    "executable", ctx)
+                continue
+            if resolved in _SYNC_NUMPY:
+                yield Finding(
+                    "RSA102", sf.path, node.lineno,
+                    f"`{dn}(...)` inside a traced function forces a "
+                    "host sync (or fails on a tracer); use jnp instead",
+                    ctx)
+                continue
+            if (resolved in _SYNC_BUILTINS and node.args
+                    and not isinstance(node.args[0], ast.Constant)):
+                yield Finding(
+                    "RSA102", sf.path, node.lineno,
+                    f"`{dn}(...)` on a traced value is a host sync "
+                    "(ConcretizationError under jit); keep it as an "
+                    "array or hoist it out of the traced function", ctx)
+                continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS):
+            yield Finding(
+                "RSA102", sf.path, node.lineno,
+                f"`.{node.func.attr}()` inside a traced function is a "
+                "host sync; return the array instead", ctx)
+
+
+def _static_positions(call: ast.Call) -> Optional[List[int]]:
+    """Literal static_argnums of a jax.jit call, if statically known."""
+    return literal_argnums(call, "static_argnums")
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+def _check_call_sites(sf: SourceFile) -> Iterator[Finding]:
+    # name -> static positions, for `f = jax.jit(g, static_argnums=...)`.
+    static_of: Dict[str, List[int]] = {}
+    for node in ast.walk(sf.tree):
+        if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+                and _is_wrapper(sf, node.value.func, _JIT_NAMES)):
+            pos = _static_positions(node.value)
+            if pos:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        static_of[tgt.id] = pos
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ctx = qualname_of(enclosing_function(node) or node)
+        # RSA105: jax.jit(...)(...) in one expression.
+        if (isinstance(node.func, ast.Call)
+                and _is_wrapper(sf, node.func.func, _JIT_NAMES)):
+            yield Finding(
+                "RSA105", sf.path, node.lineno,
+                "jax.jit(...) built and called in one expression: the "
+                "wrapper is discarded after the call, so every call "
+                "re-traces — cache the jitted callable", ctx)
+        # RSA106: jax.jit created inside a loop body.
+        if _is_wrapper(sf, node.func, _JIT_NAMES):
+            fn = enclosing_function(node)
+            anc: Optional[ast.AST] = node
+            while anc is not None and anc is not fn:
+                anc = getattr(anc, "rsa_parent", None)
+                if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                    yield Finding(
+                        "RSA106", sf.path, node.lineno,
+                        "jax.jit(...) inside a loop body creates a fresh "
+                        "wrapper (and a fresh trace) per iteration — "
+                        "hoist and cache it; closures over loop "
+                        "variables silently retrace", ctx)
+                    break
+        # RSA104: unhashable literal in a static position.
+        positions: Optional[List[int]] = None
+        if isinstance(node.func, ast.Name):
+            positions = static_of.get(node.func.id)
+        elif (isinstance(node.func, ast.Call)
+              and _is_wrapper(sf, node.func.func, _JIT_NAMES)):
+            positions = _static_positions(node.func)
+        if positions:
+            for i in positions:
+                if i < len(node.args) and isinstance(node.args[i],
+                                                     _UNHASHABLE):
+                    yield Finding(
+                        "RSA104", sf.path, node.args[i].lineno,
+                        f"unhashable literal passed at static_argnums "
+                        f"position {i}: jit static args must be "
+                        "hashable (use a tuple)", ctx)
+
+
+def check(sf: SourceFile) -> Iterator[Finding]:
+    for root in _traced_roots(sf):
+        yield from _check_traced_body(sf, root)
+    yield from _check_call_sites(sf)

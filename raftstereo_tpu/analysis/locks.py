@@ -1,0 +1,240 @@
+"""RSA3xx — lock discipline over ``# guarded_by:`` annotations.
+
+The serve/stream/obs threads (HTTP handlers, the batcher worker, stream
+sessions, metric scrapes) share mutable state with ad-hoc locking; this
+checker makes the locking contract explicit and mechanical:
+
+* Annotate the attribute where it is initialized::
+
+      self._depth = 0  # guarded_by: _cv
+
+  Every later ``<base>._depth`` read or write — any base expression, so
+  ``self._depth`` in the owning class and ``srv.stream_inflight`` in an
+  HTTP handler are both covered — must then sit lexically inside
+  ``with <base>._cv:`` in the SAME function body.
+* Annotate a method on its ``def`` line when its CALLERS hold the lock
+  (the "caller must hold" contract)::
+
+      def _oldest_key(self):  # guarded_by: _cv
+
+Codes:
+
+* RSA301 — guarded attribute accessed outside its lock.
+* RSA302 — annotation names a lock attribute the class never assigns.
+* RSA303 — ``guarded_by`` comment on a line that declares nothing.
+
+Scope and limits (docs/static_analysis.md): the ``with`` containment is
+lexical per function — a nested ``def`` does not inherit the enclosing
+``with`` (it may run later, unlocked), which is the conservative
+direction; lambdas ARE transparent (they evaluate inline — ``key=``
+functions, dispatch thunks).  ``__init__``/``__post_init__`` of the declaring
+class are exempt (construction happens-before publication).  Accesses
+from other modules are out of scope; annotate at the owning class and
+keep cross-module callers on properties/methods.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile, qualname_of
+
+__all__ = ["check"]
+
+_CTOR_NAMES = ("__init__", "__post_init__", "__new__")
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return "<expr>"
+
+
+def _self_attr_target(stmt: ast.AST) -> Optional[Tuple[str, str]]:
+    """(base, attr) for ``<base>.attr = ...`` / ``attr: T = ...``
+    declarations (class-level AnnAssign covers dataclass fields)."""
+    if isinstance(stmt, ast.Assign) and stmt.targets:
+        tgt = stmt.targets[0]
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        tgt = stmt.target
+    else:
+        return None
+    if isinstance(tgt, ast.Attribute):
+        return _unparse(tgt.value), tgt.attr
+    if isinstance(tgt, ast.Name):  # class-body field declaration
+        return "", tgt.id
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.guarded: Dict[str, str] = {}        # attr -> lock attr
+        self.assigned_attrs: Set[str] = set()    # every self.X = ...
+        self.held_methods: Dict[int, Set[str]] = {}  # id(def) -> locks
+        self.decl_lines: Set[int] = set()        # annotated declarations
+
+
+def _def_header_lines(fn: ast.AST) -> range:
+    """Lines a def-line annotation may sit on: the signature lines."""
+    end = fn.body[0].lineno if fn.body else fn.lineno + 1
+    return range(fn.lineno, end)
+
+
+def _collect(sf: SourceFile) -> Tuple[List[_ClassInfo], List[Finding]]:
+    infos: List[_ClassInfo] = []
+    findings: List[Finding] = []
+    claimed_lines: Set[int] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = _ClassInfo(node)
+        # Declarations live where the attribute is INITIALIZED: the class
+        # body or a constructor.  An annotated assignment anywhere else
+        # declares nothing (RSA303) and its access is still checked —
+        # otherwise a guarded_by comment on a mutation site would exempt
+        # exactly the access it mislabels.
+        decl_stmts = list(node.body)
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                locks = {sf.guarded_by[ln]
+                         for ln in _def_header_lines(sub)
+                         if ln in sf.guarded_by}
+                if locks:
+                    info.held_methods[id(sub)] = locks
+                    claimed_lines.update(
+                        ln for ln in _def_header_lines(sub)
+                        if ln in sf.guarded_by)
+                if sub.name in _CTOR_NAMES:
+                    decl_stmts.extend(ast.walk(sub))
+            target = _self_attr_target(sub)
+            if target is not None:
+                info.assigned_attrs.add(target[1])
+        for sub in decl_stmts:
+            target = _self_attr_target(sub)
+            if target is None:
+                continue
+            _base, attr = target
+            # The annotation sits on the assignment line, or on a
+            # standalone comment directly above it (long lines).
+            for ln in (sub.lineno, sub.lineno - 1):
+                lock = sf.guarded_by.get(ln)
+                if lock is not None and ln not in claimed_lines:
+                    info.guarded[attr] = lock
+                    claimed_lines.add(ln)
+                    info.decl_lines.add(sub.lineno)
+                    break
+        for attr, lock in sorted(info.guarded.items()):
+            if lock not in info.assigned_attrs:
+                line = next((ln for ln, lk in sorted(sf.guarded_by.items())
+                             if lk == lock), node.lineno)
+                findings.append(Finding(
+                    "RSA302", sf.path, line,
+                    f"guarded_by names `{lock}`, but class "
+                    f"`{node.name}` never assigns `self.{lock}`",
+                    node.name))
+        if info.guarded:
+            infos.append(info)
+    # RSA303: guarded_by comments that attached to nothing.
+    for line, lock in sorted(sf.guarded_by.items()):
+        if line not in claimed_lines:
+            findings.append(Finding(
+                "RSA303", sf.path, line,
+                f"`# guarded_by: {lock}` is not on an attribute "
+                "assignment or a def line — the annotation guards "
+                "nothing", "<module>"))
+    return infos, findings
+
+
+def _enclosing_def(node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing def.  Lambdas are transparent: they evaluate
+    inline (``min(..., key=lambda ...)``, dispatch thunks) so they
+    inherit the surrounding lock scope; a nested ``def`` is deferred
+    work and does NOT."""
+    cur = getattr(node, "rsa_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "rsa_parent", None)
+    return None
+
+
+def _locks_held(node: ast.AST, base: str,
+                held_methods: Dict[int, Set[str]]) -> Set[str]:
+    """Lock attr names held at ``node`` for accesses on ``base``:
+    ``with <base>.<lock>:`` blocks in the same function body (lambdas
+    transparent), plus the function's own def-line contract (self-based
+    only)."""
+    held: Set[str] = set()
+    fn = _enclosing_def(node)
+    cur = getattr(node, "rsa_parent", None)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                expr = item.context_expr
+                if (isinstance(expr, ast.Attribute)
+                        and _unparse(expr.value) == base):
+                    held.add(expr.attr)
+        cur = getattr(cur, "rsa_parent", None)
+    if fn is not None and base == "self":
+        held |= held_methods.get(id(fn), set())
+    return held
+
+
+def _inside_ctor_of(node: ast.AST, cls: ast.ClassDef) -> bool:
+    cur = getattr(node, "rsa_parent", None)
+    fn = None
+    while cur is not None:
+        if fn is None and isinstance(cur, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+            fn = cur
+        if isinstance(cur, ast.ClassDef):
+            return (cur is cls and fn is not None
+                    and fn.name in _CTOR_NAMES)
+        cur = getattr(cur, "rsa_parent", None)
+    return False
+
+
+def check(sf: SourceFile) -> Iterator[Finding]:
+    infos, findings = _collect(sf)
+    yield from findings
+    if not infos:
+        return
+    # Module-wide guard map: attr -> every (lock, declaring class).
+    # Several classes may declare the same attr (Counter._value and
+    # Gauge._value): an access is fine if it satisfies ANY declaration —
+    # its own class's constructor, a declaration line, or holding one of
+    # the declared locks.
+    guard: Dict[str, List[Tuple[str, _ClassInfo]]] = {}
+    held_methods: Dict[int, Set[str]] = {}
+    for info in infos:
+        held_methods.update(info.held_methods)
+        for attr, lock in info.guarded.items():
+            guard.setdefault(attr, []).append((lock, info))
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        entries = guard.get(node.attr)
+        if entries is None:
+            continue
+        base = _unparse(node.value)
+        held = _locks_held(node, base, held_methods)
+        if any(
+            (base == "self" and _inside_ctor_of(node, info.node))
+            # The declaring assignment itself (claimed in _collect) —
+            # NOT any line that merely carries a guarded_by comment.
+            or node.lineno in info.decl_lines
+            or lock in held
+                for lock, info in entries):
+            continue
+        lock, info = entries[0]
+        kind = ("write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                else "read")
+        yield Finding(
+            "RSA301", sf.path, node.lineno,
+            f"{kind} of `{base}.{node.attr}` outside `with "
+            f"{base}.{lock}:` (declared guarded_by {lock} on class "
+            f"`{info.node.name}`)",
+            qualname_of(node))

@@ -1,0 +1,65 @@
+"""RSA5xx — the metric-name/exposition lint, behind the analysis runner.
+
+This is the runtime half of the suite (imports the metrics bundles, so
+it needs the package importable — unlike the AST checkers): it
+instantiates ``ServeMetrics`` + ``TrainMetrics`` on ONE registry (a name
+collision between the bundles fails here instead of when both are
+mounted on one process), runs the naming lint, populates one child per
+labeled family and validates the full Prometheus 0.0.4 render.
+
+Formerly ``scripts/check_metrics.py`` (PR 5); that script is now a thin
+shim over this module so tier-1 has a single lint entry point
+(``python -m raftstereo_tpu.analysis``).
+
+Codes:
+
+* RSA501 — metric-name lint violation (obs/prom.py ``lint_registry``).
+* RSA502 — rendered exposition fails the format validator.
+* RSA503 — serve/train bundles collide on one registry.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .core import Finding
+
+__all__ = ["run_metrics_lint"]
+
+# Findings anchor at the bundle definitions — the registry names are
+# declared there, so that is where a violation is fixed.
+_SERVE_PATH = "raftstereo_tpu/serve/metrics.py"
+_TRAIN_PATH = "raftstereo_tpu/train/telemetry.py"
+
+
+def run_metrics_lint() -> List[Finding]:
+    """Instantiate + lint + render-validate the repo's metric bundles."""
+    from ..obs import lint_registry, validate_prometheus
+    from ..serve.metrics import MetricsRegistry, ServeMetrics
+    from ..train.telemetry import TrainMetrics
+
+    findings: List[Finding] = []
+    registry = MetricsRegistry()
+    try:
+        serve = ServeMetrics(registry)
+        TrainMetrics(registry)
+    except ValueError as e:  # duplicate registration across bundles
+        return [Finding("RSA503", _TRAIN_PATH, 1,
+                        f"bundle collision: {e}", "metrics")]
+    for msg in lint_registry(registry.entries()):
+        path = _TRAIN_PATH if msg.split(":")[0].startswith("train") \
+            else _SERVE_PATH
+        findings.append(Finding("RSA501", path, 1, msg, "metrics"))
+
+    # Populate one child per labeled family (families render no samples
+    # until first use) and validate the full exposition.
+    serve.requests.labels(endpoint="predict", outcome="ok").inc()
+    serve.compile_misses.labels(bucket="64x96", iters="8",
+                                mode="batch").inc()
+    serve.compile_hits.labels(bucket="64x96", iters="8",
+                              mode="stream").inc()
+    serve.stream_cold_frames.labels(reason="new").inc()
+    serve.latency.observe(0.01)
+    for msg in validate_prometheus(registry.render()):
+        findings.append(Finding("RSA502", _SERVE_PATH, 1, msg, "metrics"))
+    return findings

@@ -11,6 +11,15 @@ backoff, a bounded quarantine that replaces persistently-bad indices with
 deterministically resampled ones (counted in :attr:`DataLoader.stats`,
 never silently), and a timeout on batch results with a worker-pool recycle
 so one hung decoder cannot stall training forever.
+
+Concurrency model (checked by the RSA3xx lock-discipline pass,
+docs/static_analysis.md): the loader is multi*process*, not
+multi-threaded — workers communicate via the pool only, and
+``quarantined``/``stats``/``epoch`` are mutated exclusively by the single
+consumer thread iterating the loader, so no attribute here carries a
+``# guarded_by:`` annotation.  The one cross-process value,
+``_worker_counter``, is an ``mp.Value`` updated under its own
+``get_lock()`` in ``_init_worker``.
 """
 
 from __future__ import annotations

@@ -91,9 +91,9 @@ class Tracer:
     def __init__(self, capacity: int = 4096):
         assert capacity >= 1, capacity
         self.capacity = capacity
-        self._spans: deque = deque(maxlen=capacity)
-        self._recorded = 0
         self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=capacity)  # guarded_by: _lock
+        self._recorded = 0  # guarded_by: _lock
         self._tls = threading.local()
 
     # ------------------------------------------------------------------ ids
@@ -173,7 +173,8 @@ class Tracer:
     @property
     def recorded(self) -> int:
         """Spans ever recorded (including ones the ring has dropped)."""
-        return self._recorded
+        with self._lock:  # vs a concurrent record() increment
+            return self._recorded
 
     @property
     def dropped(self) -> int:

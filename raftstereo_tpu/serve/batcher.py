@@ -119,10 +119,10 @@ class DynamicBatcher:
         self.metrics = metrics or ServeMetrics()
         self.tracer = tracer  # obs.Tracer or None (tracing is optional)
         self._cv = threading.Condition()
-        self._queues: Dict[_Key, Deque[_Request]] = {}
-        self._depth = 0
-        self._seq = 0
-        self._closed = False
+        self._queues: Dict[_Key, Deque[_Request]] = {}  # guarded_by: _cv
+        self._depth = 0  # guarded_by: _cv
+        self._seq = 0  # guarded_by: _cv
+        self._closed = False  # guarded_by: _cv
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- lifecycle
@@ -160,7 +160,8 @@ class DynamicBatcher:
 
     @property
     def queue_depth(self) -> int:
-        return self._depth
+        with self._cv:  # vs a concurrent submit/close mutating the count
+            return self._depth
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                iters: Optional[int] = None,
@@ -193,7 +194,7 @@ class DynamicBatcher:
 
     # --------------------------------------------------------------- worker
 
-    def _oldest_key(self) -> _Key:
+    def _oldest_key(self) -> _Key:  # guarded_by: _cv
         """Key whose head request has waited longest (caller holds lock)."""
         return min(self._queues, key=lambda k: self._queues[k][0].seq)
 

@@ -50,17 +50,18 @@ class BatchEngine:
         self.variables = variables
         self.cfg = config
         self.metrics = metrics
-        self._fns: Dict[object, object] = {}  # iters | ("stream", iters)
-        # Compiled keys: (h, w, iters) for the plain forward and
-        # (h, w, iters, "stream") for the warm-start (flow_init) forward.
-        self._compiled: Set[Tuple] = set()
+        self._fns: Dict[object, object] = {}  # guarded_by: _lock
+        # (keyed iters | ("stream", iters))
         self._lock = threading.RLock()
         # Fine-grained lock for _compiled only: stat readers (/healthz)
         # must not block behind _lock, which is held across a whole device
         # dispatch (seconds) or compile (minutes).
         self._stats_lock = threading.Lock()
-        self.last_batch_runtime: float = float("nan")
-        self.last_included_compile: bool = True
+        # Compiled keys: (h, w, iters) for the plain forward and
+        # (h, w, iters, "stream") for the warm-start (flow_init) forward.
+        self._compiled: Set[Tuple] = set()  # guarded_by: _stats_lock
+        self.last_batch_runtime: float = float("nan")  # guarded_by: _lock
+        self.last_included_compile: bool = True  # guarded_by: _lock
         # Per-thread phase timing of the most recent dispatch THIS thread
         # ran (the batcher worker and concurrent stream handlers each read
         # their own): thread-local because an attribute would be overwritten
@@ -105,14 +106,14 @@ class BatchEngine:
 
     # -------------------------------------------------------------- execution
 
-    def _fn(self, iters: int):
+    def _fn(self, iters: int):  # guarded_by: _lock
         if iters not in self._fns:
             self._fns[iters] = jax.jit(
                 lambda v, a, b, it=iters: self.model.forward(
                     v, a, b, iters=it, test_mode=True))
         return self._fns[iters]
 
-    def _stream_fn(self, iters: int):
+    def _stream_fn(self, iters: int):  # guarded_by: _lock
         """Warm-start forward: takes a (B, H/f, W/f, 1) flow_init.  Cold
         frames pass zeros — bitwise-identical to the plain forward (tested
         in tests/test_model.py / tests/test_stream.py), so one executable
@@ -139,7 +140,9 @@ class BatchEngine:
             bh, bw = self.bucket_of((h, w, 3))
             for iters in iters_list:
                 key = (bh, bw, iters)
-                if key in self._compiled:
+                # is_warm, not a bare `in self._compiled`: membership is
+                # guarded by _stats_lock (RSA301).
+                if self.is_warm((bh, bw), iters):
                     continue
                 zero = np.zeros((h, w, 3), np.float32)
                 t0 = time.perf_counter()
@@ -161,7 +164,7 @@ class BatchEngine:
             bh, bw = self.bucket_of((h, w, 3))
             for iters in ladder:
                 key = (bh, bw, iters, "stream")
-                if key in self._compiled:
+                if self.is_stream_warm((bh, bw), iters):
                     continue
                 zero = np.zeros((h, w, 3), np.float32)
                 t0 = time.perf_counter()
@@ -236,7 +239,8 @@ class BatchEngine:
             t_compute = time.perf_counter()
             out = [np.asarray(o, np.float32) for o in out_dev]
             t_fetch = time.perf_counter()
-            self.last_batch_runtime = t_fetch - start
+            runtime = t_fetch - start
+            self.last_batch_runtime = runtime
             self.last_included_compile = miss
             with self._stats_lock:
                 self._compiled.add(key)
@@ -247,7 +251,9 @@ class BatchEngine:
             "compile": miss,
         }
         if self.metrics is not None and not miss:
-            self.metrics.batch_latency.observe(self.last_batch_runtime)
+            # The local, not self.last_batch_runtime: the lock is released
+            # and a concurrent dispatch may have overwritten it (RSA301).
+            self.metrics.batch_latency.observe(runtime)
         return out, miss
 
     def infer_batch(self, pairs: Sequence[Tuple[np.ndarray, np.ndarray]],

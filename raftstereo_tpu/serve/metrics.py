@@ -40,8 +40,8 @@ class Counter:
     """Monotonic counter (Prometheus ``counter``)."""
 
     def __init__(self):
-        self._value = 0
         self._lock = threading.Lock()
+        self._value = 0  # guarded_by: _lock
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
@@ -49,7 +49,8 @@ class Counter:
 
     @property
     def value(self) -> int:
-        return self._value
+        with self._lock:  # a torn read mid-inc would render a bogus sample
+            return self._value
 
 
 class Gauge:
@@ -61,8 +62,8 @@ class Gauge:
     """
 
     def __init__(self):
-        self._value = 0.0
         self._lock = threading.Lock()
+        self._value = 0.0  # guarded_by: _lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -74,7 +75,8 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class LabelFamily:
@@ -90,8 +92,9 @@ class LabelFamily:
         assert label_names, "a family needs at least one label"
         self._make = make_child
         self.label_names = tuple(label_names)
-        self._children: Dict[Tuple[str, ...], object] = {}
         self._lock = threading.Lock()
+        # child instruments by label values  # guarded_by: _lock
+        self._children: Dict[Tuple[str, ...], object] = {}
 
     def labels(self, **kv):
         if set(kv) != set(self.label_names):
@@ -134,8 +137,9 @@ class MetricsRegistry:
     """Ordered name -> instrument registry with Prometheus text rendering."""
 
     def __init__(self):
-        self._entries: List[Tuple[str, str, str, object]] = []
         self._lock = threading.Lock()
+        # (kind, name, help, instrument)  # guarded_by: _lock
+        self._entries: List[Tuple[str, str, str, object]] = []
 
     def _register(self, kind: str, name: str, help_: str, obj):
         with self._lock:

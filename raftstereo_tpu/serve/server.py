@@ -428,8 +428,8 @@ class StereoServer(ThreadingHTTPServer):
         # Admission control for the session path (which bypasses the
         # batcher queue): frames concurrently decoded-and-waiting on the
         # session/engine locks, shed with 503 beyond queue_limit.
-        self.stream_inflight = 0
         self.stream_inflight_lock = threading.Lock()
+        self.stream_inflight = 0  # guarded_by: stream_inflight_lock
         # Caps the number of request bodies being buffered/decoded at
         # once (each transiently costs ~3x its size); excess connections
         # queue on the semaphore instead of multiplying host RSS.

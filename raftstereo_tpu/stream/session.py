@@ -71,6 +71,7 @@ class SessionStore:
         self.metrics = metrics
         self._now = now_fn
         self._lock = threading.Lock()
+        # guarded_by: _lock
         self._sessions: "collections.OrderedDict[str, Session]" = \
             collections.OrderedDict()
 

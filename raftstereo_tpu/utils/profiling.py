@@ -138,13 +138,13 @@ class LatencyHistogram:
         self.bounds: Tuple[float, ...] = (
             tuple(sorted(bounds)) if bounds is not None
             else _log_spaced_bounds(lo, hi, per_decade))
-        # One count per bound plus the +Inf overflow bucket.
-        self._counts = [0] * (len(self.bounds) + 1)
-        self._count = 0
-        self._sum = 0.0
-        self._min = math.inf
-        self._max = -math.inf
         self._lock = threading.Lock()
+        # One count per bound plus the +Inf overflow bucket.
+        self._counts = [0] * (len(self.bounds) + 1)  # guarded_by: _lock
+        self._count = 0  # guarded_by: _lock
+        self._sum = 0.0  # guarded_by: _lock
+        self._min = math.inf  # guarded_by: _lock
+        self._max = -math.inf  # guarded_by: _lock
 
     def observe(self, value: float) -> None:
         i = bisect.bisect_left(self.bounds, value)
@@ -157,11 +157,13 @@ class LatencyHistogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:  # vs a concurrent observe() read-modify-write
+            return self._count
 
     @property
     def total(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def _snapshot(self):
         """Counts/count/sum/min/max from ONE lock acquisition — derived
@@ -250,9 +252,9 @@ class Timer:
     """
 
     def __init__(self):
-        # name -> [count, total, min, max]
-        self._acc: Dict[str, List[float]] = {}
         self._lock = threading.Lock()
+        # name -> [count, total, min, max]  # guarded_by: _lock
+        self._acc: Dict[str, List[float]] = {}
 
     @contextlib.contextmanager
     def __call__(self, name: str) -> Iterator[None]:
@@ -304,8 +306,8 @@ class OnDemandProfiler:
         self.log_dir = log_dir
         self.max_seconds = max_seconds
         self._lock = threading.Lock()
-        self._until: Optional[float] = None
-        self._captures = 0
+        self._until: Optional[float] = None  # guarded_by: _lock
+        self._captures = 0  # guarded_by: _lock
 
     @property
     def running(self) -> bool:

@@ -1,18 +1,15 @@
-"""Metric-name lint: keep the telemetry namespace scrapeable and consistent.
+"""Metric-name lint shim — the real pass lives in the analysis suite.
 
-Instantiates every metrics bundle in the codebase (``ServeMetrics``,
-``TrainMetrics``) onto ONE shared registry — so a name collision between
-the serve and train namespaces fails here instead of when someone finally
-mounts both on one process — then checks:
+Since the static-analysis PR the metric lint is one pass of
+``raftstereo_tpu.analysis`` (``analysis/metrics_lint.py``, codes
+RSA501-503) so tier-1 invokes a single entry point::
 
-* naming conventions (counters end ``_total``, time histograms end
-  ``_seconds``, no ``_total`` on non-counters, non-empty HELP);
-* a fully populated render passes the Prometheus 0.0.4 format validator
-  (raftstereo_tpu/obs/prom.py).
+    python -m raftstereo_tpu.analysis    # everything, incl. this lint
 
-Wired into tier-1 via tests/test_obs.py; runnable standalone:
+This script stays as a compatibility wrapper with the original
+contract (``check() -> [violation, ...]``, exit 1 + report on any)::
 
-    python scripts/check_metrics.py   # exit 1 + report on any violation
+    python scripts/check_metrics.py
 """
 
 from __future__ import annotations
@@ -28,29 +25,10 @@ if _REPO not in sys.path:
 
 
 def check() -> List[str]:
-    """Run all lint passes; returns the list of violations (empty = ok)."""
-    from raftstereo_tpu.obs import lint_registry, validate_prometheus
-    from raftstereo_tpu.serve.metrics import MetricsRegistry, ServeMetrics
-    from raftstereo_tpu.train.telemetry import TrainMetrics
+    """Run the metric lint; returns the list of violations (empty = ok)."""
+    from raftstereo_tpu.analysis.metrics_lint import run_metrics_lint
 
-    errors: List[str] = []
-    registry = MetricsRegistry()
-    try:
-        serve = ServeMetrics(registry)
-        TrainMetrics(registry)
-    except ValueError as e:  # duplicate registration across bundles
-        return [f"bundle collision: {e}"]
-    errors += lint_registry(registry.entries())
-
-    # Populate one child per labeled family (families render no samples
-    # until first use) and validate the full exposition.
-    serve.requests.labels(endpoint="predict", outcome="ok").inc()
-    serve.compile_misses.labels(bucket="64x96", iters="8", mode="batch").inc()
-    serve.compile_hits.labels(bucket="64x96", iters="8", mode="stream").inc()
-    serve.stream_cold_frames.labels(reason="new").inc()
-    serve.latency.observe(0.01)
-    errors += validate_prometheus(registry.render())
-    return errors
+    return [f.message for f in run_metrics_lint()]
 
 
 def main() -> int:

@@ -1,0 +1,25 @@
+"""BAD compile-cache-key fixture (exact RSA4xx codes/lines asserted in
+tests/test_analysis.py).  Parsed only, never executed."""
+
+
+class Engine:
+    def __init__(self):
+        self._compiled = set()
+
+    def _dispatch(self, key, call):
+        self._compiled.add(key)
+        return call()
+
+    def infer_quantized(self, pairs, iters, precision):
+        h, w = 64, 96
+        key = (h, w, iters)             # precision is NOT in the key
+        return self._dispatch(key, lambda: (pairs, precision))  # RSA401
+
+    def infer_fixed(self, pairs, iters):
+        return self._dispatch(("flagship",), lambda: pairs)     # RSA402
+
+    def warmup_modes(self, buckets, mode):
+        for h, w in buckets:
+            if (h, w) in self._compiled:    # mode missing: RSA401
+                continue
+            self.infer_fixed([], 8)

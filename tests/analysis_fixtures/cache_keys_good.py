@@ -1,0 +1,24 @@
+"""GOOD compile-cache-key fixture: every key-relevant input reaches the
+cache key — zero findings expected.  Parsed only, never executed."""
+
+
+class Engine:
+    def __init__(self):
+        self._compiled = set()
+
+    def _dispatch(self, key, call):
+        self._compiled.add(key)
+        return call()
+
+    def infer_quantized(self, pairs, iters, precision):
+        h, w = 64, 96
+        key = (h, w, iters, precision)
+        return self._dispatch(key, lambda: pairs)
+
+    def warmup_modes(self, buckets, iters_list, mode):
+        for h, w in buckets:
+            for iters in iters_list:        # transitive flow: iters_list
+                key = (h, w, iters, mode)
+                if key in self._compiled:
+                    continue
+                self._dispatch(key, lambda: None)

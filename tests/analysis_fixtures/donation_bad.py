@@ -1,0 +1,20 @@
+"""BAD donation fixture (exact RSA2xx codes/lines asserted in
+tests/test_analysis.py).  Parsed only, never executed."""
+
+import jax
+
+
+def _step(state, batch):
+    return state
+
+
+def train_once(state, batch):
+    step = jax.jit(_step, donate_argnums=(0,))
+    new_state = step(state, batch)          # donates `state`
+    stale_loss = state.loss                 # line 14: RSA201
+    return new_state, stale_loss
+
+
+def bad_index(state, batch):
+    step = jax.jit(_step, donate_argnums=(5,))   # line 19: RSA202
+    return step(state, batch)

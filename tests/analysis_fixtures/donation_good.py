@@ -1,0 +1,21 @@
+"""GOOD donation fixture: donated buffers are rebound before any reuse —
+zero findings expected.  Parsed only, never executed."""
+
+import jax
+
+
+def _step(state, batch):
+    return state
+
+
+def train_once(state, batch):
+    step = jax.jit(_step, donate_argnums=(0,))
+    new_state = step(state, batch)
+    return new_state, new_state.loss        # reads the RESULT, not state
+
+
+def train_loop(state, batches):
+    step = jax.jit(_step, donate_argnums=(0,))
+    for batch in batches:
+        state = step(state, batch)          # rebinds: taint cleared
+    return state

@@ -1,0 +1,53 @@
+"""BAD jit-hygiene fixture (tests/test_analysis.py asserts the exact
+RSA1xx codes and line numbers below).  Parsed by the AST checkers only —
+never imported, never executed."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def impure_step(x):
+    t0 = time.perf_counter()            # line 15: RSA101
+    noise = np.random.rand(4)           # line 16: RSA101
+    print("step at", t0)                # line 17: RSA101
+    return x + jnp.asarray(noise)
+
+
+@jax.jit
+def host_sync(x):
+    peak = float(x.max())               # line 23: RSA102
+    arr = np.asarray(x)                 # line 24: RSA102
+    last = x[-1].item()                 # line 25: RSA102
+    return x * peak + arr.sum() + last
+
+
+_CALLS = 0
+
+
+@jax.jit
+def counts_calls(x):
+    global _CALLS                       # line 34: RSA103
+    _CALLS += 1
+    return x
+
+
+def run_static(fn, xs):
+    jitted = jax.jit(fn, static_argnums=(1,))
+    return jitted(xs, [4, 8])           # line 41: RSA104 (unhashable)
+
+
+def per_call(x):
+    return jax.jit(lambda v: v * 2.0)(x)    # line 45: RSA105
+
+
+def per_iteration(xs):
+    outs = []
+    for scale in (1.0, 2.0, 4.0):
+        f = jax.jit(lambda v: v * scale)    # line 51: RSA106
+        outs.append(f(xs))
+    return outs

@@ -1,0 +1,41 @@
+"""GOOD jit-hygiene fixture: the same jobs as jit_bad.py done purely —
+zero findings expected.  Parsed only, never executed."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+_FNS = {}
+
+
+@jax.jit
+def pure_step(x, noise):
+    # Randomness and clocks stay outside the trace; arrays come in as
+    # arguments.
+    return x + noise
+
+
+def timed_step(x, noise):
+    t0 = time.perf_counter()          # impure, but NOT traced: fine
+    y = pure_step(x, noise)
+    return y, time.perf_counter() - t0
+
+
+@jax.jit
+def stays_on_device(x):
+    peak = jnp.max(x)                 # jnp, not float(): no host sync
+    return x * peak
+
+
+def cached_jit(iters):
+    # The engine idiom: one wrapper per config, cached, scalar bound via
+    # a default argument — no per-call wrapper, no silent retrace.
+    if iters not in _FNS:
+        _FNS[iters] = jax.jit(lambda v, it=iters: v * it)
+    return _FNS[iters]
+
+
+def run_static(fn, xs):
+    jitted = jax.jit(fn, static_argnums=(1,))
+    return jitted(xs, (4, 8))         # hashable tuple in static position

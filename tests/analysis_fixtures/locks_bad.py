@@ -1,0 +1,31 @@
+"""BAD lock-discipline fixture (exact RSA3xx codes/lines asserted in
+tests/test_analysis.py).  Parsed only, never executed."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []                # guarded_by: _lock
+        self._closed = False            # guarded_by: _lock
+        self._depth = 0                 # guarded_by: other_lock (RSA302)
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def size(self):
+        return len(self._items)         # line 19: RSA301 (read, no lock)
+
+    def close(self):
+        self._closed = True             # line 22: RSA301 (write, no lock)
+
+    def deferred(self):
+        with self._lock:
+            def later():
+                return self._items      # line 27: RSA301 (nested def
+        return later                    # escapes the with block)
+
+    def noop(self):
+        pass                            # guarded_by: _lock (RSA303)

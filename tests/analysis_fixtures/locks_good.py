@@ -1,0 +1,44 @@
+"""GOOD lock-discipline fixture: every guarded access holds its lock —
+zero findings expected.  Parsed only, never executed."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []                # guarded_by: _lock
+        self._depth = 0                 # guarded_by: _lock
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._depth += 1
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def _oldest(self):  # guarded_by: _lock
+        # Caller-holds-lock contract via the def-line annotation; the
+        # inline lambda inherits the scope (it evaluates inline).
+        return min(self._items, key=lambda it: self._items.count(it))
+
+    def drain(self):
+        with self._lock:
+            items, self._items = self._items, []
+        return items
+
+
+class Handler:
+    """Cross-object discipline: srv-style base expressions match too."""
+
+    def bump(self, srv):
+        with srv.inflight_lock:
+            srv.inflight += 1
+
+
+class Server:
+    def __init__(self):
+        self.inflight_lock = threading.Lock()
+        self.inflight = 0               # guarded_by: inflight_lock

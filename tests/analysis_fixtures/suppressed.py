@@ -1,0 +1,18 @@
+"""Suppression fixture: the same hazards as the bad fixtures, silenced
+per line with ``# noqa: RSA###`` — zero findings expected.  Parsed only,
+never executed."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def tolerated_impurity(x):
+    t0 = time.perf_counter()    # noqa: RSA101
+    peak = float(x.max())       # noqa: RSA102, RSA999
+    return x * peak + t0
+
+
+def per_call(x):
+    return jax.jit(lambda v: v * 2.0)(x)    # noqa: RSA105

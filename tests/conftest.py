@@ -35,6 +35,17 @@ def rng():
     return np.random.default_rng(1234)
 
 
+@pytest.fixture
+def retrace_guard():
+    """The runtime XLA compile-budget guard
+    (raftstereo_tpu/analysis/retrace_guard.py): tests declare a budget
+    with ``with retrace_guard(N, what=..., min_duration_s=...):`` and
+    fail if the block compiles more executables than declared."""
+    from raftstereo_tpu.analysis.retrace_guard import retrace_guard as guard
+
+    return guard
+
+
 @pytest.fixture(scope="session")
 def tiny_model():
     """Small-but-real model bundle (alt corr: O(H*W) memory, exercised by the

@@ -304,13 +304,16 @@ class TestServeConfig:
 # ------------------------------------------------------------------ end2end
 
 class TestEndToEnd:
-    def test_server_concurrent_mixed_shapes(self, serve_model):
+    def test_server_concurrent_mixed_shapes(self, serve_model,
+                                            retrace_guard):
         """Acceptance gate: concurrent mixed-shape traffic over real HTTP.
 
-        Asserts (1) each bucket compiled exactly once, (2) responses equal
-        the single-image Evaluator bitwise at the same iteration count,
-        (3) overload sheds instead of deadlocking, (4) /metrics reports
-        non-zero batch-size and latency histograms.
+        Asserts (1) each bucket compiled exactly once — enforced both at
+        the engine cache level and by the retrace guard counting actual
+        XLA compiles (budget 2 for the cold traffic, budget 0 once warm),
+        (2) responses equal the single-image Evaluator bitwise at the
+        same iteration count, (3) overload sheds instead of deadlocking,
+        (4) /metrics reports non-zero batch-size and latency histograms.
         """
         from raftstereo_tpu.eval import Evaluator
 
@@ -343,17 +346,25 @@ class TestEndToEnd:
                 except Exception as e:  # pragma: no cover - failure detail
                     errors.append(e)
 
-            threads = [threading.Thread(target=send, args=(i, s))
-                       for i in range(2) for s in shapes]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(120)
-            assert not errors, errors
-            assert len(results) == 6
-
             # (1) one compile per (bucket, iters): batch padding makes the
-            # executable independent of the coalesced batch size.
+            # executable independent of the coalesced batch size.  The
+            # retrace guard counts ACTUAL XLA compiles (model-scale via
+            # the 0.5 s floor): 2 buckets -> budget 2, however the 6
+            # requests interleave.
+            with retrace_guard(2, what="2 buckets compile exactly once",
+                               min_duration_s=0.5) as cold_report:
+                threads = [threading.Thread(target=send, args=(i, s))
+                           for i in range(2) for s in shapes]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(120)
+                assert not errors, errors
+                assert len(results) == 6
+            # EXACTLY 2, not just <= 2: if the 0.5 s floor ever rises
+            # above the real compile time, the warm budget-0 guards below
+            # would pass vacuously — this assert makes that loud.
+            assert cold_report.compiles == 2, cold_report.durations
             assert server.engine.compiled_keys == {(64, 96, 3),
                                                    (96, 128, 3)}
             assert metrics.compile_misses.value == 2
@@ -371,17 +382,23 @@ class TestEndToEnd:
                 np.testing.assert_array_equal(disp, expected)
 
             # (3) overload: a burst far past queue_limit must shed with
-            # clean 503s, and every accepted request completes.
-            burst_stats = run_load(
-                "127.0.0.1", port, lambda i: pairs[(60, 90)],
-                requests=30, concurrency=15, timeout=120)
-            assert burst_stats["shed"] > 0, burst_stats
-            assert burst_stats["ok"] + burst_stats["shed"] \
-                + burst_stats["timeout"] == 30
-            assert burst_stats["error"] == 0
-            # No new compiles: the burst reused the warm 64x96 executable.
-            assert metrics.compile_misses.value == 2
-            assert metrics.compile_hits.value >= 1
+            # clean 503s, and every accepted request completes.  Warm
+            # traffic must add ZERO model compiles — guarded for real,
+            # not just via the engine's own bookkeeping.
+            with retrace_guard(0, what="burst + explicit iters reuse "
+                                       "warm executables",
+                               min_duration_s=0.5):
+                burst_stats = run_load(
+                    "127.0.0.1", port, lambda i: pairs[(60, 90)],
+                    requests=30, concurrency=15, timeout=120)
+                assert burst_stats["shed"] > 0, burst_stats
+                assert burst_stats["ok"] + burst_stats["shed"] \
+                    + burst_stats["timeout"] == 30
+                assert burst_stats["error"] == 0
+                # No new compiles: the burst reused the warm 64x96
+                # executable.
+                assert metrics.compile_misses.value == 2
+                assert metrics.compile_hits.value >= 1
 
             # (4) observability: batch + latency histograms are non-zero
             # and the healthz endpoint agrees with engine state.

@@ -258,13 +258,17 @@ class TestWarmStartAcceptance:
 
 class TestEndToEnd:
     def test_http_session_parity_eviction_expiry_metrics(self, stream_model,
-                                                         stream_engine):
+                                                         stream_engine,
+                                                         retrace_guard):
         """One server, four acceptance checks: (1) a session over real
         HTTP is bitwise-identical to the offline runner on the same
         frames; (2) exceeding session_limit LRU-evicts and the evicted
         session's next frame is COLD, not an error; (3) an expired session
         falls back to a cold frame; (4) sequence-replay load-gen works and
-        everything is visible in /metrics + /healthz."""
+        everything is visible in /metrics + /healthz.  The PR 3 invariant
+        — streaming adds zero compiles beyond the ladder — runs under the
+        shared retrace guard: budget 2 covers exactly the two warmed
+        ladder levels, so ALL session traffic must be compile-free."""
         model, variables = stream_model
         scfg = StreamConfig(ladder=(12, 6), promote_threshold=2.0,
                             demote_threshold=0.1,
@@ -277,107 +281,120 @@ class TestEndToEnd:
             degraded_iters=6, max_body_mb=1.0, max_image_dim=128,
             stream=scfg, stream_warmup=True)
         metrics = ServeMetrics()
-        server = build_server(model, variables, cfg, metrics)
+        seq = _sequence(n=3)
+        # The offline parity baseline runs FIRST, on the module-shared
+        # engine, so its (possible) compiles stay outside the server's
+        # guarded budget when this test runs alone.
+        offline = run_sequence(stream_engine, seq.frames, scfg, warm=True)
+        with retrace_guard(2, what="stream warmup compiles the ladder",
+                           min_duration_s=0.5) as warmup_report:
+            server = build_server(model, variables, cfg, metrics)
+        # EXACTLY the two ladder levels — also proves the 0.5 s floor is
+        # below the real compile time, so the budget-0 traffic guard
+        # below cannot pass vacuously.
+        assert warmup_report.compiles == 2, warmup_report.durations
         port = server.port
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         try:
             client = ServeClient("127.0.0.1", port, timeout=120)
-            seq = _sequence(n=3)
 
-            # (1) parity: session over HTTP == offline runner, bitwise.
-            # seq_no omitted on the wire: in-order clients are implicit.
-            http_disps, metas = [], []
-            for left, right, _ in seq.frames:
-                disp, meta = client.predict(left, right,
-                                            session_id="cam0")
-                http_disps.append(disp)
-                metas.append(meta)
-            assert [m["warm"] for m in metas] == [False, True, True]
-            assert [m["iters"] for m in metas] == [12, 6, 6]
-            assert [m["seq_no"] for m in metas] == [0, 1, 2]
-            offline = run_sequence(stream_engine, seq.frames, scfg,
-                                   warm=True)
-            for got, want in zip(http_disps, offline["preds"]):
-                np.testing.assert_array_equal(got, want)
+            # All session traffic below reuses the two warmed ladder
+            # executables: zero further compiles allowed.
+            with retrace_guard(0, what="session traffic is compile-free",
+                               min_duration_s=0.5):
+                # (1) parity: session over HTTP == offline runner, bitwise.
+                # seq_no omitted on the wire: in-order clients are implicit.
+                http_disps, metas = [], []
+                for left, right, _ in seq.frames:
+                    disp, meta = client.predict(left, right,
+                                                session_id="cam0")
+                    http_disps.append(disp)
+                    metas.append(meta)
+                assert [m["warm"] for m in metas] == [False, True, True]
+                assert [m["iters"] for m in metas] == [12, 6, 6]
+                assert [m["seq_no"] for m in metas] == [0, 1, 2]
+                for got, want in zip(http_disps, offline["preds"]):
+                    np.testing.assert_array_equal(got, want)
 
-            # Explicit iters cannot ride a session (controller owns it).
-            from raftstereo_tpu.serve import ServeError
-            with pytest.raises(ServeError) as ei:
-                client.predict(*seq.frames[0][:2], iters=12,
-                               session_id="cam0")
-            assert ei.value.status == 400
+                # Explicit iters cannot ride a session (controller owns it).
+                from raftstereo_tpu.serve import ServeError
+                with pytest.raises(ServeError) as ei:
+                    client.predict(*seq.frames[0][:2], iters=12,
+                                   session_id="cam0")
+                assert ei.value.status == 400
 
-            # Out-of-sequence frame: cold restart, never an error.
-            disp, meta = client.predict(*seq.frames[0][:2],
-                                        session_id="cam0", seq_no=99)
-            assert not meta["warm"] and meta["iters"] == 12
+                # Out-of-sequence frame: cold restart, never an error.
+                disp, meta = client.predict(*seq.frames[0][:2],
+                                            session_id="cam0", seq_no=99)
+                assert not meta["warm"] and meta["iters"] == 12
 
-            # (2) LRU eviction at session_limit=2: cam0 + s1 live; s2
-            # evicts cam0; cam0's next frame is cold.
-            client.predict(*seq.frames[0][:2], session_id="s1")
-            client.predict(*seq.frames[0][:2], session_id="s2")
-            _, meta = client.predict(*seq.frames[1][:2],
-                                     session_id="cam0")
-            assert not meta["warm"]        # state was evicted -> cold
-            assert metrics.stream_evicted.value >= 1
+                # (2) LRU eviction at session_limit=2: cam0 + s1 live; s2
+                # evicts cam0; cam0's next frame is cold.
+                client.predict(*seq.frames[0][:2], session_id="s1")
+                client.predict(*seq.frames[0][:2], session_id="s2")
+                _, meta = client.predict(*seq.frames[1][:2],
+                                         session_id="cam0")
+                assert not meta["warm"]        # state was evicted -> cold
+                assert metrics.stream_evicted.value >= 1
 
-            # (3) TTL expiry: zero the TTL so the next touch of a live
-            # session expires it server-side — cold frame, 200 OK.
-            _, meta = client.predict(*seq.frames[0][:2], session_id="s3")
-            assert not meta["warm"]
-            _, meta = client.predict(*seq.frames[1][:2], session_id="s3")
-            assert meta["warm"]            # still live
-            server.stream.store.ttl_s = 0.0
-            _, meta = client.predict(*seq.frames[2][:2], session_id="s3")
-            assert not meta["warm"]        # expired -> cold, no error
-            server.stream.store.ttl_s = 300.0
-            assert metrics.stream_expired.value >= 1
+                # (3) TTL expiry: zero the TTL so the next touch of a live
+                # session expires it server-side — cold frame, 200 OK.
+                _, meta = client.predict(*seq.frames[0][:2], session_id="s3")
+                assert not meta["warm"]
+                _, meta = client.predict(*seq.frames[1][:2], session_id="s3")
+                assert meta["warm"]            # still live
+                server.stream.store.ttl_s = 0.0
+                _, meta = client.predict(*seq.frames[2][:2], session_id="s3")
+                assert not meta["warm"]        # expired -> cold, no error
+                server.stream.store.ttl_s = 300.0
+                assert metrics.stream_expired.value >= 1
 
-            # Admission control covers the session path too: with the
-            # in-flight count saturated, a frame sheds with 503 instead
-            # of queueing unboundedly on the engine lock.
-            server.stream_inflight = cfg.queue_limit
-            with pytest.raises(ServeError) as ei:
-                client.predict(*seq.frames[0][:2], session_id="cam0")
-            assert ei.value.status == 503
-            server.stream_inflight = 0
+                # Admission control covers the session path too: with the
+                # in-flight count saturated, a frame sheds with 503 instead
+                # of queueing unboundedly on the engine lock.
+                server.stream_inflight = cfg.queue_limit
+                with pytest.raises(ServeError) as ei:
+                    client.predict(*seq.frames[0][:2], session_id="cam0")
+                assert ei.value.status == 503
+                server.stream_inflight = 0
 
-            # (4) sequence-replay load-gen: 2 sessions x 2 frames.
-            stats = run_load("127.0.0.1", port,
-                             lambda i: seq.frames[i % 2][:2],
-                             requests=4, concurrency=2, sequence_len=2,
-                             timeout=120)
-            assert stats["ok"] == 4 and stats["error"] == 0
-            assert stats["warm_frames"] == 2 and stats["cold_frames"] == 2
+                # (4) sequence-replay load-gen: 2 sessions x 2 frames.
+                stats = run_load("127.0.0.1", port,
+                                 lambda i: seq.frames[i % 2][:2],
+                                 requests=4, concurrency=2, sequence_len=2,
+                                 timeout=120)
+                assert stats["ok"] == 4 and stats["error"] == 0
+                assert stats["warm_frames"] == 2 and stats["cold_frames"] == 2
 
-            # Observability: counters/gauges in /metrics, ladder+sessions
-            # in /healthz, stream compile keys in compiled_buckets.
-            text = client.metrics_text()
+                # Observability: counters/gauges in /metrics, ladder+sessions
+                # in /healthz, stream compile keys in compiled_buckets.
+                text = client.metrics_text()
 
-            def sample(name):
-                # Labeled families render one series per label set; the
-                # label-blind total is their sum.
-                vals = [float(l.split()[-1]) for l in text.splitlines()
-                        if l.startswith(name + " ")
-                        or l.startswith(name + "{")]
-                assert vals, f"no samples for {name}"
-                return sum(vals)
+                def sample(name):
+                    # Labeled families render one series per label set; the
+                    # label-blind total is their sum.
+                    vals = [float(l.split()[-1]) for l in text.splitlines()
+                            if l.startswith(name + " ")
+                            or l.startswith(name + "{")]
+                    assert vals, f"no samples for {name}"
+                    return sum(vals)
 
-            assert sample("stream_warm_frames_total") >= 4
-            assert sample("stream_cold_frames_total") >= 6
-            assert sample("stream_sessions_evicted_total") >= 1
-            assert sample("stream_sessions_expired_total") >= 1
-            assert sample("stream_sessions_active") >= 1
-            assert sample("stream_frame_iters_count") >= 10
-            health = client.healthz()
-            assert health["stream"]["ladder"] == [12, 6]
-            assert health["stream"]["session_limit"] == 2
-            assert sorted({k[2] for k in map(
-                tuple, health["compiled_buckets"]) if len(k) == 4}) == [6, 12]
-            # Stream warmup compiled the two ladder levels; the session
-            # traffic above added none.
-            assert metrics.compile_misses.value == 2
+                assert sample("stream_warm_frames_total") >= 4
+                assert sample("stream_cold_frames_total") >= 6
+                assert sample("stream_sessions_evicted_total") >= 1
+                assert sample("stream_sessions_expired_total") >= 1
+                assert sample("stream_sessions_active") >= 1
+                assert sample("stream_frame_iters_count") >= 10
+                health = client.healthz()
+                assert health["stream"]["ladder"] == [12, 6]
+                assert health["stream"]["session_limit"] == 2
+                assert sorted({k[2] for k in map(
+                    tuple, health["compiled_buckets"]) if len(k) == 4}) == [6, 12]
+                # Stream warmup compiled the two ladder levels; the session
+                # traffic above added none — the engine-level view of the
+                # budget the retrace guard just enforced for real.
+                assert metrics.compile_misses.value == 2
             client.close()
         finally:
             server.close()
