@@ -607,6 +607,91 @@ def bench_stream(height: int, width: int, frames: int, iters: int,
     return compare_warm_cold(engine, seq.frames, stream_cfg)["summary"]
 
 
+def bench_sched(height: int, width: int, long_iters: int, max_batch: int,
+                corr: str, compute_dtype: str, quick: bool):
+    """Iteration-level-scheduler smoke benchmark (mirrors --serve): a
+    mixed workload of long (``--iters``) and short (7/32 of it) requests
+    through the continuous-batching scheduler AND through the monolithic
+    micro-batcher path — same engine, same compile cache — reporting the
+    short jobs' p50/p99 both ways.  The short-job p99 gap IS the
+    head-of-line blocking the scheduler removes (docs/serving.md)."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from raftstereo_tpu.config import (RAFTStereoConfig, SchedConfig,
+                                       ServeConfig)
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.serve import (BatchEngine, DynamicBatcher,
+                                      IterationScheduler, ServeMetrics)
+
+    import jax
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        # CPU-feasible model, same shrink as the test suite's tiny configs.
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype, **model_kw)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+    long_iters = max(long_iters, 2)
+    short_iters = max(1, long_iters * 7 // 32)
+    serve_cfg = ServeConfig(
+        port=0, buckets=((height, width),), max_batch_size=max_batch,
+        max_wait_ms=2.0, queue_limit=max(4 * max_batch, 16),
+        iters=long_iters, degraded_iters=short_iters,
+        degrade_queue_depth=10 ** 6,  # degradation off: explicit iters only
+        sched=SchedConfig(iters_per_step=1,
+                          max_iters=max(64, long_iters)))
+    metrics = ServeMetrics()
+    engine = BatchEngine(model, variables, serve_cfg, metrics)
+    # Warm BOTH paths so neither measurement charges an XLA compile:
+    # monolithic (long + short executables) and the four phase executables.
+    engine.warmup(iters_list=[short_iters, long_iters])
+    engine.warmup_sched()
+    rng = np.random.default_rng(0)
+    pair = tuple(rng.integers(0, 255, (height, width, 3)).astype(np.float32)
+                 for _ in range(2))
+    n_long, n_short = (2, 6) if quick else (4, 12)
+
+    def run(submit):
+        """Submit longs, then shorts mid-flight; per-class latencies."""
+        t0 = _time.perf_counter()
+        longs = [submit(long_iters) for _ in range(n_long)]
+        _time.sleep(0.05)  # the longs are running when the shorts arrive
+        lat_short = []
+        for _ in range(n_short):
+            t = _time.perf_counter()
+            submit(short_iters).result(timeout=600)
+            lat_short.append((_time.perf_counter() - t) * 1e3)
+        for f in longs:
+            f.result(timeout=600)
+        wall = _time.perf_counter() - t0
+        return {
+            "short_p50_ms": round(float(np.percentile(lat_short, 50)), 3),
+            "short_p99_ms": round(float(np.percentile(lat_short, 99)), 3),
+            "wall_s": round(wall, 3),
+            "pairs_per_sec": round((n_long + n_short) / wall, 3),
+        }
+
+    with IterationScheduler(engine, serve_cfg, metrics) as sched:
+        sched_stats = run(lambda it: sched.submit(*pair, iters=it))
+    with DynamicBatcher(engine, serve_cfg, metrics) as batcher:
+        mono_stats = run(lambda it: batcher.submit(*pair, iters=it))
+    return {
+        "long_iters": long_iters, "short_iters": short_iters,
+        "n_long": n_long, "n_short": n_short,
+        "sched": sched_stats, "mono": mono_stats,
+        "short_p99_speedup": round(
+            mono_stats["short_p99_ms"] / max(sched_stats["short_p99_ms"],
+                                             1e-9), 3),
+    }
+
+
 def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
                            reps: int) -> float:
     """Run the reference PyTorch model (random weights) on CPU at the same
@@ -698,6 +783,13 @@ def main() -> None:
                         "max_batch_size)")
     p.add_argument("--serve_concurrency", type=int, default=4,
                    help="closed-loop load-gen workers for --serve")
+    p.add_argument("--sched", action="store_true",
+                   help="benchmark the iteration-level continuous-batching "
+                        "scheduler: a mixed workload of long (--iters) and "
+                        "short (7/32 of it) requests through the scheduler "
+                        "vs the monolithic micro-batcher path, reporting "
+                        "short-job p50/p99 both ways (the head-of-line "
+                        "blocking gap)")
     p.add_argument("--stream", action="store_true",
                    help="benchmark the temporal warm-start streaming "
                         "subsystem: N-frame synthetic video sequence, "
@@ -723,7 +815,7 @@ def main() -> None:
     # Perf rounds must not land on top of known hazards: the smoke modes
     # refuse to run while the static-analysis baseline has entries
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
-    if args.quick or args.serve or args.stream:
+    if args.quick or args.serve or args.stream or args.sched:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -744,8 +836,9 @@ def main() -> None:
         args.iters = 32
     if args.reps is None:
         args.reps = 20
-    if args.batch is None and not args.serve:
-        args.batch = 1  # --serve resolves its own default (8; 4 in --quick)
+    if args.batch is None and not args.serve and not args.sched:
+        args.batch = 1  # --serve/--sched resolve their own default
+        # (8; 4 in --quick)
     # Defaults keyed on the mode, resolved only when the flag was NOT
     # given — an explicit --height/--width always wins (also under --tiled,
     # also with --quick).
@@ -816,6 +909,32 @@ def main() -> None:
                   "wall_s", "concurrency"):
             if k in stats:
                 record[k] = stats[k]
+        print(json.dumps(record))
+        return
+
+    if args.sched:
+        h, w = args.height, args.width
+        batch = args.batch if args.batch is not None else 8
+        if args.quick:
+            # Tiny model + shape; still runs the full scheduler-vs-
+            # monolithic comparison with real join/leave traffic.  An
+            # explicitly given flag wins, same contract as --height.
+            if not explicit_hw:
+                h, w = 64, 96
+            batch = args.batch if args.batch is not None else 4
+            if not explicit_iters:
+                args.iters = 8
+        summary = bench_sched(h, w, args.iters, batch, args.corr,
+                              args.compute_dtype, quick=args.quick)
+        record = {
+            "metric": f"sched short-job p99 ms @{w}x{h}, mixed "
+                      f"{summary['short_iters']}/{summary['long_iters']}-"
+                      f"iter workload, iteration-level continuous batching",
+            "value": summary["sched"]["short_p99_ms"],
+            "unit": "ms",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
         print(json.dumps(record))
         return
 

@@ -24,8 +24,9 @@ import sys
 
 import numpy as np
 
-from ..config import (_parse_bucket, add_model_args, add_serve_args,
-                      add_stream_args, model_config_from_args,
+from ..config import (_parse_bucket, add_model_args, add_sched_args,
+                      add_serve_args, add_stream_args,
+                      model_config_from_args, sched_config_from_args,
                       serve_config_from_args, stream_config_from_args)
 from .common import load_variables, setup_logging
 
@@ -62,7 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="compile every (bucket, stream-ladder level) at "
                         "startup so mid-stream level switches never pay "
                         "an XLA compile")
+    p.add_argument("--sched", action="store_true",
+                   help="iteration-level continuous batching: requests "
+                        "join/leave one running batch per bucket at "
+                        "iteration boundaries (per-request deadline_ms/"
+                        "priority on /predict, no head-of-line blocking; "
+                        "docs/serving.md)")
     add_serve_args(p)
+    add_sched_args(p)
     add_stream_args(p)
     add_model_args(p)
     return p
@@ -101,8 +109,10 @@ def main(argv=None) -> int:
 
     config = model_config_from_args(args)
     stream_cfg = None if args.no_stream else stream_config_from_args(args)
+    sched_cfg = sched_config_from_args(args) if args.sched else None
     serve_cfg = serve_config_from_args(args, stream=stream_cfg,
-                                       stream_warmup=args.stream_warmup)
+                                       stream_warmup=args.stream_warmup,
+                                       sched=sched_cfg)
     model = RAFTStereo(config)
     if args.restore_ckpt:
         variables = load_variables(args.restore_ckpt, config, model)

@@ -227,6 +227,41 @@ class StreamConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SchedConfig:
+    """Iteration-level continuous batching (serve/sched/, docs/serving.md).
+
+    Replaces whole-request dispatch with iteration-granular scheduling:
+    the engine advances one running batch per shape bucket through
+    single-iteration step executables, and requests join/leave at
+    iteration boundaries — so a 32-iteration request never head-of-line
+    blocks a 7-iteration stream frame.  Frozen + hashable like the other
+    configs."""
+
+    # GRU iterations per scheduler boundary.  1 gives the finest
+    # join/leave granularity (lowest short-job latency); larger values
+    # amortize per-boundary dispatch overhead.  Per-request iteration
+    # targets must be divisible by it.
+    iters_per_step: int = 1
+    # Aging interval for the priority queue: a queued request is promoted
+    # one priority class for every starvation_ms it has waited, so low
+    # priority means "later", never "never".
+    starvation_ms: float = 2000.0
+    # Upper bound on a request's explicit per-request iteration target.
+    # Unlike the monolithic path, ANY value up to this cap is served from
+    # the same step executable — no per-iters compile to protect against.
+    max_iters: int = 64
+
+    def __post_init__(self):
+        assert self.iters_per_step >= 1, self.iters_per_step
+        assert self.starvation_ms > 0, self.starvation_ms
+        assert self.max_iters >= self.iters_per_step, (
+            self.max_iters, self.iters_per_step)
+        assert self.max_iters % self.iters_per_step == 0, (
+            f"max_iters {self.max_iters} not divisible by iters_per_step "
+            f"{self.iters_per_step}")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Serving-layer parameters (serve/): dynamic micro-batching, the
     shape-bucketed compile cache, admission control and graceful
@@ -283,6 +318,15 @@ class ServeConfig:
     stream: Optional[StreamConfig] = None
     stream_warmup: bool = False
 
+    # Iteration-level continuous batching (serve/sched/): when set, the
+    # server replaces the whole-request micro-batcher with the per-request
+    # scheduler — requests join/leave one running batch per bucket at
+    # iteration boundaries, ``/predict`` accepts ``deadline_ms`` +
+    # ``priority``, and session frames ride the same scheduler as
+    # high-priority short jobs instead of the batch-size-1 bypass.  None
+    # keeps the monolithic dispatch path.
+    sched: Optional[SchedConfig] = None
+
     # Observability (obs/, docs/observability.md): capacity of the span
     # ring buffer behind /debug/trace.  Spans are a few hundred bytes; the
     # ring bounds memory no matter the traffic.
@@ -307,6 +351,23 @@ class ServeConfig:
         assert self.divis_by >= 1 and self.bucket_multiple >= 1
         assert self.max_body_mb > 0 and self.max_image_dim >= 1
         assert self.trace_buffer >= 1, self.trace_buffer
+        if self.sched is not None:
+            assert self.iters % self.sched.iters_per_step == 0, (
+                f"iters {self.iters} not divisible by sched.iters_per_step "
+                f"{self.sched.iters_per_step}")
+            assert self.iters <= self.sched.max_iters, (
+                f"iters {self.iters} exceeds sched.max_iters "
+                f"{self.sched.max_iters}")
+            if self.stream is not None:
+                # Session frames ride the scheduler: every ladder level
+                # must be a reachable iteration target.
+                bad = [lv for lv in self.stream.ladder
+                       if lv % self.sched.iters_per_step
+                       or lv > self.sched.max_iters]
+                assert not bad, (
+                    f"stream ladder levels {bad} unreachable under sched "
+                    f"(iters_per_step {self.sched.iters_per_step}, "
+                    f"max_iters {self.sched.max_iters})")
 
 
 def _parse_bucket(text: str) -> Tuple[int, int]:
@@ -364,6 +425,31 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
                         "(docs/observability.md)")
 
 
+def add_sched_args(parser: argparse.ArgumentParser) -> None:
+    d = SchedConfig()
+    g = parser.add_argument_group("sched")
+    g.add_argument("--sched_iters_per_step", type=int,
+                   default=d.iters_per_step,
+                   help="GRU iterations per scheduler boundary (1 = finest "
+                        "join/leave granularity; per-request iteration "
+                        "targets must be divisible by it)")
+    g.add_argument("--sched_starvation_ms", type=float,
+                   default=d.starvation_ms,
+                   help="queued requests gain one priority class per this "
+                        "many ms waited, so low priority is never starved")
+    g.add_argument("--sched_max_iters", type=int, default=d.max_iters,
+                   help="cap on per-request iteration targets (any value "
+                        "up to it is served from the same step executable)")
+
+
+def sched_config_from_args(args: argparse.Namespace) -> SchedConfig:
+    return SchedConfig(
+        iters_per_step=args.sched_iters_per_step,
+        starvation_ms=args.sched_starvation_ms,
+        max_iters=args.sched_max_iters,
+    )
+
+
 def add_stream_args(parser: argparse.ArgumentParser) -> None:
     d = StreamConfig()
     g = parser.add_argument_group("stream")
@@ -409,10 +495,13 @@ def stream_config_from_args(args: argparse.Namespace) -> StreamConfig:
 
 def serve_config_from_args(args: argparse.Namespace,
                            stream: Optional[StreamConfig] = None,
-                           stream_warmup: bool = False) -> ServeConfig:
+                           stream_warmup: bool = False,
+                           sched: Optional[SchedConfig] = None
+                           ) -> ServeConfig:
     return ServeConfig(
         stream=stream,
         stream_warmup=stream_warmup,
+        sched=sched,
         host=args.host,
         port=args.port,
         divis_by=args.divis_by,

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import RAFTStereoConfig
-from ..ops.corr import make_corr_fn
+from ..ops.corr import build_corr_state, corr_fn_from_state, make_corr_fn
 from ..ops.image import coords_grid_x
 from ..ops.upsample import convex_upsample
 from .encoders import BasicEncoder, MultiBasicEncoder
@@ -169,9 +169,11 @@ class RAFTStereo:
             out["batch_stats"] = bs[name]
         return out
 
-    def forward(self, variables: Dict, image1: jax.Array, image2: jax.Array,
-                iters: int = 12, flow_init: Optional[jax.Array] = None,
-                test_mode: bool = False, unroll: int = 1):
+    def _encode(self, variables: Dict, image1: jax.Array,
+                image2: jax.Array):
+        """Encoder phase shared by ``forward`` and ``forward_prologue``:
+        normalization, context/feature encoders and the precomputed GRU
+        context biases (reference: core/raft_stereo.py:77-88)."""
         cfg = self.config
         dtype = self.dtype
         b = image1.shape[0]
@@ -179,7 +181,6 @@ class RAFTStereo:
         img1 = (2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
         img2 = (2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
 
-        # Encoders (reference: core/raft_stereo.py:77-88).
         if cfg.shared_backbone:
             outputs, trunk = self.cnet.apply(
                 self._split_vars(variables, "cnet"),
@@ -196,10 +197,16 @@ class RAFTStereo:
         net_list = [jnp.tanh(o[0]) for o in outputs]
         inp_list = [nn.relu(o[1]) for o in outputs]
         zqr_list = self.zqr.apply(self._split_vars(variables, "zqr"), inp_list)
+        return net_list, zqr_list, fmap1, fmap2
 
+    def _corr_setup(self, update_vars: Dict, test_mode: bool):
+        """Static correlation-lookup policy shared by the monolithic and
+        phase-split forwards: the volume dtype, whether the motion
+        encoder's convc1 is fused into the lookup kernel (and its
+        parameters), and the lane-friendly channel pad."""
+        cfg = self.config
         corr_dtype = (jnp.bfloat16 if cfg.corr_dtype == "bfloat16"
                       else jnp.float32)
-        update_vars = self._split_vars(variables, "update")
         # Test mode fuses the motion encoder's convc1 (1x1, cor_planes->64)
         # into the lookup kernel as a relu epilogue: the separate conv
         # re-read the correlation features at 75 GB/s (60 us/iter, round-5
@@ -211,7 +218,7 @@ class RAFTStereo:
         # forward), while fp32's module conv runs at flax default precision
         # — a different rounding than any Mosaic-loweable policy — and fp32
         # is the certified-parity path, which must keep one numeric form.
-        use_epi = (test_mode and dtype == jnp.bfloat16
+        use_epi = (test_mode and self.dtype == jnp.bfloat16
                    and corr_epilogue_active(cfg.corr_implementation))
         epi = (update_vars["params"]["encoder"]["convc1"] if use_epi
                else None)
@@ -219,20 +226,16 @@ class RAFTStereo:
         # features to a lane-multiple-friendly width in-kernel (36 lanes
         # made the motion encoder's 1x1 conv fusion memory-bound); the
         # motion encoder's padded conv accepts either width.
-        corr_fn = make_corr_fn(cfg.corr_implementation, fmap1, fmap2,
-                               cfg.corr_levels, cfg.corr_radius,
-                               dtype=corr_dtype,
-                               precision=cfg.corr_precision,
-                               out_dtype=dtype,
-                               out_channels=-(-cfg.cor_planes // 64) * 64,
-                               epilogue=epi)
+        return corr_dtype, use_epi, epi, -(-cfg.cor_planes // 64) * 64
 
-        h0, w0 = net_list[0].shape[1:3]
-        grid = coords_grid_x(b, h0, w0)
-        disp = jnp.zeros((b, h0, w0, 1), jnp.float32)
-        if flow_init is not None:
-            disp = disp + flow_init.astype(jnp.float32)
-
+    def _step_body(self, update_vars: Dict, zqr_list, corr_fn, grid,
+                   test_mode: bool, use_epi: bool):
+        """The per-iteration refinement body, identical between the
+        monolithic ``forward`` scan and the scheduler's single-iteration
+        step executable (``forward_step``) — sharing the code is what
+        makes the two paths bitwise-comparable."""
+        cfg = self.config
+        dtype = self.dtype
         sf = cfg.slow_fast_gru
         n = cfg.n_gru_layers
 
@@ -266,6 +269,35 @@ class RAFTStereo:
             up = convex_upsample(d, mask.astype(jnp.float32), cfg.factor)
             return (tuple(nets), d), up
 
+        return step
+
+    def forward(self, variables: Dict, image1: jax.Array, image2: jax.Array,
+                iters: int = 12, flow_init: Optional[jax.Array] = None,
+                test_mode: bool = False, unroll: int = 1):
+        cfg = self.config
+        b = image1.shape[0]
+
+        net_list, zqr_list, fmap1, fmap2 = self._encode(variables, image1,
+                                                        image2)
+        update_vars = self._split_vars(variables, "update")
+        corr_dtype, use_epi, epi, out_channels = self._corr_setup(
+            update_vars, test_mode)
+        corr_fn = make_corr_fn(cfg.corr_implementation, fmap1, fmap2,
+                               cfg.corr_levels, cfg.corr_radius,
+                               dtype=corr_dtype,
+                               precision=cfg.corr_precision,
+                               out_dtype=self.dtype,
+                               out_channels=out_channels,
+                               epilogue=epi)
+
+        h0, w0 = net_list[0].shape[1:3]
+        grid = coords_grid_x(b, h0, w0)
+        disp = jnp.zeros((b, h0, w0, 1), jnp.float32)
+        if flow_init is not None:
+            disp = disp + flow_init.astype(jnp.float32)
+
+        step = self._step_body(update_vars, zqr_list, corr_fn, grid,
+                               test_mode, use_epi)
         body = jax.checkpoint(step) if cfg.remat else step
         # ``unroll`` feeds lax.scan's unroll factor.  Perf-neutral by default
         # (1); bench.py's FLOP accounting compiles fully-unrolled variants
@@ -283,6 +315,85 @@ class RAFTStereo:
                                       cfg.factor)
             return disp, disp_up
         return ys  # (iters, B, H*f, W*f, 1)
+
+    # ------------------------------------------------- phase-split forward
+    #
+    # The same test-mode computation as ``forward``, split into three
+    # separately-compilable phases so a scheduler can advance a running
+    # batch one iteration at a time and let requests join/leave at
+    # iteration boundaries (serve/sched/, docs/serving.md):
+    #
+    #   state = forward_prologue(v, i1, i2, flow_init)   # encode + corr
+    #   state = forward_step(v, state, iters=k)          # k GRU iterations
+    #   low, up = forward_epilogue(v, state)             # mask + upsample
+    #
+    # ``prologue -> step x (N/k) -> epilogue`` is bitwise-identical to
+    # ``forward(iters=N, test_mode=True)`` at the same batch shape: the
+    # scan body is the SAME function (``_step_body``), the correlation
+    # state is built by the same ops (ops/corr.build_corr_state), and the
+    # epilogue repeats the post-scan code (asserted in tests/test_sched.py).
+
+    def forward_prologue(self, variables: Dict, image1: jax.Array,
+                         image2: jax.Array,
+                         flow_init: Optional[jax.Array] = None) -> Dict:
+        """Encode + correlation build + initial refinement state.
+
+        Returns the carried state: a dict pytree whose leaves all keep the
+        batch as their leading axis (so a scheduler can merge per-slot
+        state across requests with a (B,)-mask select).  ``flow_init`` is
+        a (B, H/factor, W/factor, 1) warm-start disparity; None and zeros
+        produce bitwise-identical results (same property as
+        ``jitted_infer_init``), so one prologue executable serves cold
+        requests and warm stream frames alike."""
+        cfg = self.config
+        net_list, zqr_list, fmap1, fmap2 = self._encode(variables, image1,
+                                                        image2)
+        corr_dtype, _, _, _ = self._corr_setup(
+            self._split_vars(variables, "update"), test_mode=True)
+        corr_state = build_corr_state(cfg.corr_implementation, fmap1, fmap2,
+                                      cfg.corr_levels, dtype=corr_dtype,
+                                      precision=cfg.corr_precision)
+        b, h0, w0 = net_list[0].shape[:3]
+        disp = jnp.zeros((b, h0, w0, 1), jnp.float32)
+        if flow_init is not None:
+            disp = disp + flow_init.astype(jnp.float32)
+        return {"nets": tuple(net_list),
+                "zqr": tuple(tuple(z) for z in zqr_list),
+                "corr": tuple(corr_state),
+                "disp": disp}
+
+    def forward_step(self, variables: Dict, state: Dict,
+                     iters: int = 1) -> Dict:
+        """Advance the carried state by ``iters`` GRU iterations (the
+        scheduler's single-iteration step executable; test-mode only)."""
+        cfg = self.config
+        update_vars = self._split_vars(variables, "update")
+        _, use_epi, epi, out_channels = self._corr_setup(update_vars,
+                                                         test_mode=True)
+        corr_fn = corr_fn_from_state(cfg.corr_implementation, state["corr"],
+                                     cfg.corr_levels, cfg.corr_radius,
+                                     precision=cfg.corr_precision,
+                                     out_dtype=self.dtype,
+                                     out_channels=out_channels,
+                                     epilogue=epi)
+        disp = state["disp"]
+        b, h0, w0 = disp.shape[:3]
+        grid = coords_grid_x(b, h0, w0)
+        step = self._step_body(update_vars, state["zqr"], corr_fn, grid,
+                               test_mode=True, use_epi=use_epi)
+        (nets, disp), _ = jax.lax.scan(step, (tuple(state["nets"]), disp),
+                                       None, length=iters)
+        return dict(state, nets=tuple(nets), disp=disp)
+
+    def forward_epilogue(self, variables: Dict, state: Dict):
+        """Final mask head + convex upsampling: ``(disp_low, disp_up)`` —
+        the same post-scan code as the monolithic test-mode ``forward``."""
+        update_vars = self._split_vars(variables, "update")
+        mask = self.update.apply(update_vars, state["nets"][0],
+                                 method="upsample_mask")
+        disp_up = convex_upsample(state["disp"], mask.astype(jnp.float32),
+                                  self.config.factor)
+        return state["disp"], disp_up
 
     # ------------------------------------------------------------- interface
 

@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import functools
 import warnings
-from typing import Callable, List
+from typing import Callable, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -89,6 +89,20 @@ def _tap_offsets(radius: int) -> jax.Array:
     return jnp.arange(-radius, radius + 1, dtype=jnp.float32)
 
 
+def _reg_lookup(pyramid: Sequence[jax.Array], radius: int,
+                coords: jax.Array) -> jax.Array:
+    """Tap lookup over a precomputed volume pyramid — the shared body of
+    ``make_reg_corr_fn`` and the state-passing ``corr_fn_from_state``, so
+    the monolithic and phase-split executables run identical ops."""
+    offsets = _tap_offsets(radius)
+    x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
+    out = []
+    for i, vol in enumerate(pyramid):
+        taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
+        out.append(linear_sample_1d(vol, taps))
+    return jnp.concatenate(out, axis=-1)
+
+
 def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                      radius: int, dtype=jnp.float32,
                      precision: str = "highest") -> CorrFn:
@@ -97,17 +111,8 @@ def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                                fmap2.astype(jnp.float32), dtype=dtype,
                                precision=precision)
     pyramid = build_corr_pyramid(volume, num_levels)
-    offsets = _tap_offsets(radius)
 
-    def corr_fn(coords: jax.Array) -> jax.Array:
-        x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
-        out = []
-        for i, vol in enumerate(pyramid):
-            taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
-            out.append(linear_sample_1d(vol, taps))
-        return jnp.concatenate(out, axis=-1)
-
-    return corr_fn
+    return lambda coords: _reg_lookup(pyramid, radius, coords)
 
 
 def build_fmap2_pyramid(fmap2: jax.Array, num_levels: int) -> List[jax.Array]:
@@ -127,46 +132,51 @@ def build_fmap2_pyramid(fmap2: jax.Array, num_levels: int) -> List[jax.Array]:
     return pyramid
 
 
+def _alt_lookup(fmap1: jax.Array, f2_pyramid: Sequence[jax.Array],
+                radius: int, precision: str,
+                coords: jax.Array) -> jax.Array:
+    """On-demand tap correlation over an fmap2 pyramid — the shared body of
+    ``make_alt_corr_fn`` and the state-passing ``corr_fn_from_state``.
+    ``fmap1``/``f2_pyramid`` must already be fp32."""
+    c = fmap1.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(c))
+    offsets = _tap_offsets(radius)
+    x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
+    out = []
+    for i, f2 in enumerate(f2_pyramid):
+        taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
+        w2 = f2.shape[2]
+        x0 = jnp.floor(taps)
+        dx = taps - x0
+        i0 = x0.astype(jnp.int32)
+        i1 = i0 + 1
+        # Flatten the (W1, K) tap grid into the W axis for one gather.
+        b, h, w1, k = taps.shape
+
+        def take(idx):
+            idxc = jnp.clip(idx, 0, w2 - 1).reshape(b, h, w1 * k)
+            g = jnp.take_along_axis(f2, idxc[..., None], axis=2)
+            return g.reshape(b, h, w1, k, c)
+        v0 = take(i0)
+        v1 = take(i1)
+        v0 = jnp.where(((i0 >= 0) & (i0 <= w2 - 1))[..., None], v0, 0)
+        v1 = jnp.where(((i1 >= 0) & (i1 <= w2 - 1))[..., None], v1, 0)
+        f2_taps = v0 * (1.0 - dx)[..., None] + v1 * dx[..., None]
+        corr = jnp.einsum("bhwc,bhwkc->bhwk", fmap1, f2_taps,
+                          precision=_PRECISIONS[precision]) * scale
+        out.append(corr)
+    return jnp.concatenate(out, axis=-1)
+
+
 def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                      radius: int, precision: str = "highest") -> CorrFn:
     """On-demand backend: O(H*W) memory, recomputes correlation only at the
     sampled taps (reference: PytorchAlternateCorrBlock1D, core/corr.py:64-107).
     """
     fmap1 = fmap1.astype(jnp.float32)
-    fmap2 = fmap2.astype(jnp.float32)
-    c = fmap1.shape[-1]
-    scale = 1.0 / jnp.sqrt(jnp.float32(c))
-
-    f2_pyramid = build_fmap2_pyramid(fmap2, num_levels)
-    offsets = _tap_offsets(radius)
-
-    def corr_fn(coords: jax.Array) -> jax.Array:
-        x = coords[..., 0].astype(jnp.float32)          # (B, H, W1)
-        out = []
-        for i, f2 in enumerate(f2_pyramid):
-            taps = x[..., None] / (2.0 ** i) + offsets  # (B, H, W1, K)
-            w2 = f2.shape[2]
-            x0 = jnp.floor(taps)
-            dx = taps - x0
-            i0 = x0.astype(jnp.int32)
-            i1 = i0 + 1
-            # Flatten the (W1, K) tap grid into the W axis for one gather.
-            b, h, w1, k = taps.shape
-            def take(idx):
-                idxc = jnp.clip(idx, 0, w2 - 1).reshape(b, h, w1 * k)
-                g = jnp.take_along_axis(f2, idxc[..., None], axis=2)
-                return g.reshape(b, h, w1, k, c)
-            v0 = take(i0)
-            v1 = take(i1)
-            v0 = jnp.where(((i0 >= 0) & (i0 <= w2 - 1))[..., None], v0, 0)
-            v1 = jnp.where(((i1 >= 0) & (i1 <= w2 - 1))[..., None], v1, 0)
-            f2_taps = v0 * (1.0 - dx)[..., None] + v1 * dx[..., None]
-            corr = jnp.einsum("bhwc,bhwkc->bhwk", fmap1, f2_taps,
-                              precision=_PRECISIONS[precision]) * scale
-            out.append(corr)
-        return jnp.concatenate(out, axis=-1)
-
-    return corr_fn
+    f2_pyramid = build_fmap2_pyramid(fmap2.astype(jnp.float32), num_levels)
+    return lambda coords: _alt_lookup(fmap1, f2_pyramid, radius, precision,
+                                      coords)
 
 
 @functools.lru_cache(maxsize=None)
@@ -402,6 +412,121 @@ def corr_epilogue_active(implementation: str) -> bool:
     encoder's convc1 is fused into the lookup kernel (pallas_alt only)."""
     return (corr_epilogue_enabled
             and resolve_implementation(implementation) == "pallas_alt")
+
+
+def build_corr_state(implementation: str, fmap1: jax.Array,
+                     fmap2: jax.Array, num_levels: int,
+                     dtype=jnp.float32,
+                     precision: str = "highest") -> Tuple[jax.Array, ...]:
+    """Backend-specific correlation state as a FLAT TUPLE of batch-leading
+    arrays — the carried-state form of ``make_corr_fn``'s closure, for
+    executables that split one request across several XLA programs (the
+    iteration-level scheduler's prologue/step split, serve/sched/).
+
+    Every leaf keeps the batch as its leading axis so per-slot selects
+    (``jnp.where`` over a (B,) mask) compose requests into a running batch
+    without touching other slots' values.  The Pallas backends' flatten/
+    lane-pad relayout therefore happens per lookup instead of once here —
+    exact (reshape/zero-pad only), at some per-step HBM cost on TPU; the
+    GRU-megakernel roadmap item subsumes that cost.
+
+    The arrays are built by the SAME ops as ``make_corr_fn`` at the same
+    dtypes, so a lookup through ``corr_fn_from_state`` is bitwise-equal to
+    the monolithic closure's (asserted in tests/test_sched.py).
+    """
+    implementation = resolve_implementation(implementation)
+    if implementation == "reg":
+        volume = build_corr_volume(fmap1.astype(jnp.float32),
+                                   fmap2.astype(jnp.float32),
+                                   dtype=jnp.float32, precision=precision)
+        return tuple(build_corr_pyramid(volume, num_levels))
+    if implementation == "alt":
+        return ((fmap1.astype(jnp.float32),)
+                + tuple(build_fmap2_pyramid(fmap2.astype(jnp.float32),
+                                            num_levels)))
+    if implementation == "pallas":
+        volume = build_corr_volume(fmap1.astype(jnp.float32),
+                                   fmap2.astype(jnp.float32), dtype=dtype,
+                                   precision=precision)
+        return tuple(build_corr_pyramid(volume, num_levels))
+    if implementation == "pallas_alt":
+        # astype before the per-lookup flatten: elementwise, so the order
+        # swap vs make_pallas_alt_corr_fn's construct() is exact.
+        f1 = fmap1.astype(jnp.float32).astype(dtype)
+        f2p = [x.astype(dtype) for x in
+               build_fmap2_pyramid(fmap2.astype(jnp.float32), num_levels)]
+        return (f1,) + tuple(f2p)
+    raise ValueError(f"unknown corr implementation: {implementation}")
+
+
+def corr_fn_from_state(implementation: str, state: Sequence[jax.Array],
+                       num_levels: int, radius: int,
+                       precision: str = "highest", out_dtype=jnp.float32,
+                       out_channels: int = 0, epilogue=None) -> CorrFn:
+    """Rebuild a lookup function over ``build_corr_state`` output.
+
+    Static parameters (radius/precision/out_*/epilogue) are passed per
+    call — the state itself is a pure array pytree, so it can live on
+    device between step executables.  Semantics match ``make_corr_fn``
+    for the same backend (the epilogue/out_channels knobs are honored
+    exactly where that function honors them: pallas_alt only).
+    """
+    implementation = resolve_implementation(implementation)
+    if implementation == "reg":
+        pyramid = tuple(state)
+        fn = lambda coords: _reg_lookup(pyramid, radius, coords)  # noqa: E731
+    elif implementation == "alt":
+        f1, f2p = state[0], tuple(state[1:])
+        fn = lambda coords: _alt_lookup(f1, f2p, radius, precision,  # noqa: E731
+                                        coords)
+    elif implementation == "pallas":
+        from .pallas_corr import (pad_vol_lane, pallas_lookup_pyramid_flat,
+                                  preflatten_volume)
+        volumes = tuple(state)
+        offsets = _tap_offsets(radius)
+
+        def fn(coords):
+            pyr = [pad_vol_lane(preflatten_volume(v)) for v in volumes]
+            w2s = tuple(v.shape[2] for v in pyr)
+            vcat = jnp.concatenate(pyr, axis=2)
+            x = coords[..., 0].astype(jnp.float32)
+            taps = jnp.concatenate(
+                [x[..., None] / (2.0 ** i) + offsets
+                 for i in range(len(w2s))], axis=-1)
+            return pallas_lookup_pyramid_flat(vcat, taps, w2s)
+    elif implementation == "pallas_alt":
+        from .pallas_alt import (pad_w2_lane,
+                                 pallas_alt_pyramid_radial_epi_flat,
+                                 pallas_alt_pyramid_radial_flat,
+                                 preflatten_fmap1, preflatten_fmap2)
+        f1, f2_levels = state[0], tuple(state[1:])
+        scales = tuple(1.0 / 2.0 ** i for i in range(num_levels))
+        epi = None
+        if epilogue is not None:
+            epi = (epilogue["kernel"][0, 0].astype(out_dtype),
+                   epilogue["bias"].reshape(1, 1, -1).astype(out_dtype))
+
+        def fn(coords):
+            f1flat = preflatten_fmap1(f1)
+            f2p = [pad_w2_lane(preflatten_fmap2(x)) for x in f2_levels]
+            w2s = tuple(f2.shape[1] for f2 in f2p)
+            f2cat = jnp.concatenate(f2p, axis=1)
+            xl = coords[..., 0].astype(jnp.float32)[..., None]
+            if epi is not None:
+                return pallas_alt_pyramid_radial_epi_flat(
+                    f1flat, f2cat, xl, w2s, radius, epi[0], epi[1],
+                    precision=precision, out_dtype=out_dtype,
+                    level_scales=scales)
+            return pallas_alt_pyramid_radial_flat(
+                f1flat, f2cat, xl, w2s, radius, precision=precision,
+                out_dtype=out_dtype, out_channels=out_channels,
+                level_scales=scales)
+        return fn
+    else:
+        raise ValueError(f"unknown corr implementation: {implementation}")
+    if jnp.dtype(out_dtype) == jnp.float32:
+        return fn
+    return lambda coords: fn(coords).astype(out_dtype)
 
 
 def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,

@@ -9,6 +9,15 @@ Layers, bottom-up:
 * ``batcher.DynamicBatcher``— deadline-aware micro-batching, bounded-queue
                               admission control, per-request timeouts, and
                               load-adaptive GRU-iteration degradation.
+* ``sched``                 — iteration-level continuous batching
+                              (``--sched``): a per-request scheduler over
+                              the engine's prologue/step/epilogue phase
+                              executables — requests join/leave one
+                              running batch per bucket at iteration
+                              boundaries (priorities with anti-starvation
+                              aging, deadline-aware anytime early exit,
+                              no head-of-line blocking; docs/serving.md
+                              "Scheduling").
 * ``metrics``               — counters / gauges / latency histograms with
                               Prometheus text exposition.
 * ``server.StereoServer``   — stdlib HTTP front-end: ``/predict``,
@@ -50,6 +59,7 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     ServeMetrics,
 )
+from .sched import IterationScheduler, SchedResult  # noqa: F401
 from .server import (  # noqa: F401
     StereoServer,
     build_server,

@@ -100,21 +100,29 @@ class ServeClient:
     def predict(self, left: np.ndarray, right: np.ndarray,
                 iters: Optional[int] = None,
                 session_id: Optional[str] = None,
-                seq_no: Optional[int] = None
+                seq_no: Optional[int] = None,
+                deadline_ms: Optional[float] = None,
+                priority: Optional[str] = None
                 ) -> Tuple[np.ndarray, Dict]:
         """One stereo pair -> ((H, W) disparity, meta dict).
 
         ``session_id`` marks the pair as a frame of a video stream: the
         server warm-starts it from the session's previous frame
         (docs/streaming.md).  ``seq_no`` is the frame's position in the
-        stream; omit it for an in-order client.  Raises ``ServeError`` on
-        any non-200 status (503 = shed / 504 = timeout are expected under
-        overload; callers count them).
+        stream; omit it for an in-order client.  ``deadline_ms`` /
+        ``priority`` (high/normal/low) are honored by servers running the
+        iteration-level scheduler (``--sched``, docs/serving.md).  Raises
+        ``ServeError`` on any non-200 status (503 = shed / 504 = timeout
+        are expected under overload; callers count them).
         """
         payload = {"left": encode_array(np.asarray(left, np.float32)),
                    "right": encode_array(np.asarray(right, np.float32))}
         if iters is not None:
             payload["iters"] = int(iters)
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        if priority is not None:
+            payload["priority"] = str(priority)
         if session_id is not None:
             payload["session_id"] = str(session_id)
             if seq_no is not None:

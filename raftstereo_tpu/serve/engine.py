@@ -74,6 +74,12 @@ class BatchEngine:
         return BucketPadder(shape, divis_by=self.cfg.divis_by,
                             bucket_multiple=self.cfg.bucket_multiple)
 
+    def padder_of(self, shape: Sequence[int]) -> BucketPadder:
+        """The padder an image of ``shape`` dispatches through — public for
+        callers that unpad engine outputs themselves (the iteration-level
+        scheduler unpads per leaving slot, serve/sched/scheduler.py)."""
+        return self._padder(shape)
+
     def bucket_of(self, shape: Sequence[int]) -> Tuple[int, int]:
         """The padded (H, W) an image of ``shape`` executes at."""
         return self._padder(shape).bucket_hw
@@ -123,6 +129,51 @@ class BatchEngine:
             self._fns[key] = self.model.jitted_infer_init(iters)
         return self._fns[key]
 
+    def _sched_prologue_fn(self):  # guarded_by: _lock
+        """Compiled phase 1/3 of the split forward (encode + corr build):
+        (variables, img1, img2, flow_init) -> carried state.  Cold slots
+        pass zero flow_inits — bitwise-identical to flow_init=None, so one
+        executable serves plain requests and warm stream frames."""
+        key = ("sched", "prologue")
+        if key not in self._fns:
+            self._fns[key] = jax.jit(
+                lambda v, a, b, f: self.model.forward_prologue(
+                    v, a, b, flow_init=f))
+        return self._fns[key]
+
+    def _sched_step_fn(self, iters_per_step: int):  # guarded_by: _lock
+        """Compiled single-boundary step: advances the whole running batch
+        by ``iters_per_step`` GRU iterations."""
+        key = ("sched", "step", iters_per_step)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(
+                lambda v, s, it=iters_per_step: self.model.forward_step(
+                    v, s, iters=it))
+        return self._fns[key]
+
+    def _sched_epilogue_fn(self):  # guarded_by: _lock
+        """Compiled phase 3/3: final mask head + convex upsample."""
+        key = ("sched", "epilogue")
+        if key not in self._fns:
+            self._fns[key] = jax.jit(
+                lambda v, s: self.model.forward_epilogue(v, s))
+        return self._fns[key]
+
+    def _sched_join_fn(self):  # guarded_by: _lock
+        """Compiled per-slot merge: leaves of ``incoming`` replace leaves
+        of ``running`` where the (B,) mask is True.  Every state leaf is
+        batch-leading (models/raft_stereo.forward_prologue), so a join
+        touches exactly the joining slots' rows."""
+        key = ("sched", "join")
+        if key not in self._fns:
+            def join(running, incoming, mask):
+                def sel(x, y):
+                    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+                    return jnp.where(m, y, x)
+                return jax.tree.map(sel, running, incoming)
+            self._fns[key] = jax.jit(join)
+        return self._fns[key]
+
     def warmup(self, buckets=None, iters_list=None) -> List[Tuple[int, int,
                                                                   int]]:
         """Compile the configured buckets before serving traffic.
@@ -133,8 +184,11 @@ class BatchEngine:
         Returns the (h, w, iters) keys warmed.
         """
         buckets = list(buckets or self.cfg.buckets)
-        iters_list = list(iters_list
-                          or {self.cfg.iters, self.cfg.degraded_iters})
+        # sorted, not set-ordered: the default {iters, degraded_iters} set
+        # iterates in hash order, which made compile order and warmup logs
+        # vary run to run.
+        iters_list = sorted(iters_list
+                            or {self.cfg.iters, self.cfg.degraded_iters})
         warmed = []
         for h, w in buckets:
             bh, bw = self.bucket_of((h, w, 3))
@@ -162,7 +216,9 @@ class BatchEngine:
         warmed = []
         for h, w in buckets:
             bh, bw = self.bucket_of((h, w, 3))
-            for iters in ladder:
+            # sorted for reproducible compile order/logs, same policy as
+            # ``warmup`` (the ladder is descending by construction).
+            for iters in sorted(ladder):
                 key = (bh, bw, iters, "stream")
                 if self.is_stream_warm((bh, bw), iters):
                     continue
@@ -307,3 +363,169 @@ class BatchEngine:
         return [(padder.unpad(up[i:i + 1])[0, ..., 0],
                  low[i, :, :, 0].copy(), miss)
                 for i, padder in enumerate(padders)]
+
+    # ------------------------------------------- iteration-level scheduling
+    #
+    # The phase executables behind serve/sched/ (docs/serving.md): the
+    # split forward runs as prologue -> step x N -> epilogue, with the
+    # carried state device-resident between boundaries.  All four phases
+    # live in the same compile cache under arity-4 keys
+    # (h, w, iters_per_step, phase) — iters_per_step is 0 for the phases
+    # it cannot affect — so /healthz, the RSA401 checker and the warmup
+    # accounting see them like every other executable.
+
+    def _sched_keys(self, hw: Tuple[int, int],
+                    iters_per_step: int) -> List[Tuple]:
+        return [(hw[0], hw[1], 0, "sched_prologue"),
+                (hw[0], hw[1], iters_per_step, "sched_step"),
+                (hw[0], hw[1], 0, "sched_epilogue"),
+                (hw[0], hw[1], 0, "sched_join")]
+
+    def is_sched_warm(self, hw: Tuple[int, int],
+                      iters_per_step: int) -> bool:
+        """Whether all four phase executables are compiled for (bucket,
+        iters_per_step)."""
+        with self._stats_lock:
+            return all(k in self._compiled
+                       for k in self._sched_keys(hw, iters_per_step))
+
+    def _dispatch_state(self, key, call):
+        """``_dispatch`` minus the host fetch: the scheduler's carried
+        state stays on device between iteration boundaries, so completion
+        here means block_until_ready, not a host copy.  Same lock
+        serialization and compile-cache bookkeeping."""
+        labels = dict(bucket=f"{key[0]}x{key[1]}", iters=str(key[2]),
+                      mode=key[3])
+        with self._lock:
+            with self._stats_lock:
+                miss = key not in self._compiled
+            if self.metrics is not None:
+                (self.metrics.compile_misses if miss
+                 else self.metrics.compile_hits).labels(**labels).inc()
+            start = time.perf_counter()
+            out = call()
+            jax.block_until_ready(out)
+            t_done = time.perf_counter()
+            self.last_batch_runtime = t_done - start
+            self.last_included_compile = miss
+            with self._stats_lock:
+                self._compiled.add(key)
+        # Consume the pad window: only the prologue has one, and leaving
+        # it set would stamp the stale window onto this thread's later
+        # step/join/epilogue segments.
+        pad = getattr(self._seg, "pad", None)
+        self._seg.pad = None
+        self._seg.last = {
+            "pad": pad,
+            "dispatch": (start, t_done),
+            "host_fetch": (t_done, t_done),
+            "compile": miss,
+        }
+        return out, miss
+
+    def infer_sched_prologue(self, pairs: Sequence[Tuple[np.ndarray,
+                                                         np.ndarray]],
+                             flow_inits: Sequence[Optional[np.ndarray]],
+                             slots: Sequence[int]):
+        """Run the prologue for joining requests, each placed at its
+        assigned batch slot (remaining slots are zero images — dead
+        weight, exactly like batch padding rows).
+
+        ``flow_inits`` follows ``infer_stream_batch``: an optional padded
+        low-res warm-start per pair, None = cold (zeros).  Returns
+        ``(hw, state, included_compile)`` with ``state`` device-resident.
+        """
+        assert len(pairs) == len(flow_inits) == len(slots), (
+            len(pairs), len(flow_inits), len(slots))
+        assert pairs, "empty join group"
+        bsz = self.cfg.max_batch_size
+        assert len(set(slots)) == len(slots) and all(
+            0 <= s < bsz for s in slots), f"bad slots {slots}"
+        t_pad0 = time.perf_counter()
+        padders = [self._padder(p[0].shape) for p in pairs]
+        hw = padders[0].bucket_hw
+        assert all(p.bucket_hw == hw for p in padders), (
+            "mixed buckets in one join group: "
+            f"{sorted({p.bucket_hw for p in padders})}")
+        lh, lw = self.low_hw(hw)
+        # Host-side assembly, ONE transfer at dispatch: out-of-jit
+        # ``.at[slot].set`` would copy the whole (B, H, W, 3) batch
+        # buffer once per joiner (same rationale as _pad_pairs).
+        i1 = np.zeros((bsz, hw[0], hw[1], 3), np.float32)
+        i2 = np.zeros((bsz, hw[0], hw[1], 3), np.float32)
+        fi = np.zeros((bsz, lh, lw, 1), np.float32)
+        for (im1, im2), padder, init, slot in zip(pairs, padders,
+                                                  flow_inits, slots):
+            p1, p2 = padder.pad(jnp.asarray(im1, jnp.float32)[None],
+                                jnp.asarray(im2, jnp.float32)[None])
+            i1[slot] = np.asarray(p1[0], np.float32)
+            i2[slot] = np.asarray(p2[0], np.float32)
+            if init is not None:
+                init = np.asarray(init, np.float32)
+                assert init.shape == (lh, lw), (
+                    f"flow_init {init.shape} != low-res bucket shape "
+                    f"{(lh, lw)} (bucket {hw})")
+                fi[slot, :, :, 0] = init
+        self._seg.pad = (t_pad0, time.perf_counter())
+        key = (hw[0], hw[1], 0, "sched_prologue")
+        state, miss = self._dispatch_state(
+            key, lambda: self._sched_prologue_fn()(self.variables, i1, i2,
+                                                   fi))
+        return hw, state, miss
+
+    def infer_sched_step(self, hw: Tuple[int, int], state,
+                         iters_per_step: int):
+        """Advance the running batch by one boundary (``iters_per_step``
+        GRU iterations); returns ``(state, included_compile)``."""
+        key = (hw[0], hw[1], iters_per_step, "sched_step")
+        return self._dispatch_state(
+            key, lambda: self._sched_step_fn(iters_per_step)(
+                self.variables, state))
+
+    def infer_sched_join(self, hw: Tuple[int, int], running, incoming,
+                         mask: np.ndarray):
+        """Merge ``incoming`` into ``running`` where ``mask`` (B,) is
+        True; returns ``(state, included_compile)``."""
+        m = jnp.asarray(mask, bool)
+        assert m.shape == (self.cfg.max_batch_size,), m.shape
+        key = (hw[0], hw[1], 0, "sched_join")
+        return self._dispatch_state(
+            key, lambda: self._sched_join_fn()(running, incoming, m))
+
+    def infer_sched_epilogue(self, hw: Tuple[int, int], state):
+        """Final mask + upsample for the whole batch, fetched to host:
+        ``(disp_low (B, H/f, W/f, 1), disp_up (B, H, W, 1),
+        included_compile)`` — the scheduler unpads per leaving slot
+        (``padder_of``)."""
+        key = (hw[0], hw[1], 0, "sched_epilogue")
+        (low, up), miss = self._dispatch_state(
+            key, lambda: self._sched_epilogue_fn()(self.variables, state))
+        return (np.asarray(low, np.float32), np.asarray(up, np.float32),
+                miss)
+
+    def warmup_sched(self, buckets=None,
+                     iters_per_step: int = 1) -> List[Tuple]:
+        """Compile all four phase executables for every configured bucket
+        before scheduled traffic, so joins/steps/leaves never stall a
+        running batch behind an XLA compile.  Sorted like ``warmup`` for
+        reproducible compile order.  Returns the keys warmed."""
+        buckets = list(buckets or self.cfg.buckets)
+        bsz = self.cfg.max_batch_size
+        warmed = []
+        for h, w in buckets:
+            bh, bw = self.bucket_of((h, w, 3))
+            if self.is_sched_warm((bh, bw), iters_per_step):
+                continue
+            zero = np.zeros((h, w, 3), np.float32)
+            t0 = time.perf_counter()
+            hw, state, _ = self.infer_sched_prologue([(zero, zero)], [None],
+                                                     [0])
+            state, _ = self.infer_sched_step(hw, state, iters_per_step)
+            state, _ = self.infer_sched_join(hw, state, state,
+                                             np.zeros(bsz, bool))
+            self.infer_sched_epilogue(hw, state)
+            logger.info("sched warmup: bucket %dx%d iters_per_step=%d "
+                        "compiled in %.1fs", bh, bw, iters_per_step,
+                        time.perf_counter() - t0)
+            warmed.extend(self._sched_keys((bh, bw), iters_per_step))
+        return warmed

@@ -267,6 +267,38 @@ class ServeMetrics:
             "stream_frame_latency_seconds",
             "per-frame wall-clock (warp + forward + host fetch), "
             "compile-free frames only")
+        # Iteration-level continuous batching (serve/sched/,
+        # docs/serving.md).
+        self.sched_slots_active = r.gauge(
+            "sched_slots_active",
+            "occupied slots across the scheduler's running batches")
+        self.sched_occupancy = r.gauge(
+            "sched_occupancy",
+            "occupied fraction (0-1) of the running batches' slots")
+        self.sched_queue_depth = r.gauge(
+            "sched_queue_depth",
+            "requests waiting for a slot, by priority class "
+            "(high/normal/low)",
+            labels=("priority",))
+        self.sched_joins = r.counter(
+            "sched_joins_total",
+            "requests that joined a running batch at an iteration boundary")
+        self.sched_leaves = r.counter(
+            "sched_leaves_total",
+            "requests that left a running batch (target iterations reached "
+            "or deadline early exit)")
+        self.sched_early_exits = r.counter(
+            "sched_early_exits_total",
+            "deadline-aware early exits: requests answered with the "
+            "anytime result before their target iterations "
+            "(meta.degraded=true)")
+        self.sched_steps = r.counter(
+            "sched_steps_total",
+            "single-boundary step executions across running batches")
+        self.sched_step_latency = r.histogram(
+            "sched_step_latency_seconds",
+            "engine wall-clock per scheduler step (every occupied slot "
+            "advances iters_per_step iterations), compile-free steps only")
 
     def render(self) -> str:
         return self.registry.render()

@@ -1,0 +1,74 @@
+"""Pure scheduling policy: priority ordering with aging, and the
+boundary join/leave decisions.
+
+Everything here is a deterministic function of (request metadata, clock
+reading) — no engine, no threads — which is what makes the policy unit-
+testable with an injected clock (tests/test_sched.py), the same design
+as the ``SessionStore``'s ``now_fn`` and the stream controller's pure
+ladder walk.
+
+Priority model: three classes, ``high`` < ``normal`` < ``low`` in
+numeric class value; joins are granted in (effective class, FIFO seq)
+order.  The *effective* class improves by one for every
+``starvation_s`` a request has waited, so low priority is a latency
+preference, never starvation: any queued request eventually out-ranks a
+steady stream of fresh high-priority work.
+
+Deadline model: ``deadline_ms`` is relative to submit.  A running
+request leaves a batch early — with the anytime result it has refined
+so far and ``degraded=True`` — when finishing one more boundary would
+overrun its deadline (``now - t_enqueue + step_est_s > deadline_s``).
+RAFT-Stereo's anytime property (accuracy rises smoothly with iteration
+count; PAPERS.md, Lipson et al.) is what makes the early answer useful
+rather than garbage.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__all__ = ["PRIORITIES", "priority_class", "effective_class",
+           "queue_sort_key", "should_exit"]
+
+# Class value by name; lower value = scheduled sooner.
+PRIORITIES = ("high", "normal", "low")
+
+
+def priority_class(name: str) -> int:
+    """Numeric class for a priority name; raises ValueError on junk (the
+    server maps that to HTTP 400)."""
+    try:
+        return PRIORITIES.index(name)
+    except ValueError:
+        raise ValueError(
+            f"priority {name!r} not one of {list(PRIORITIES)}") from None
+
+
+def effective_class(cls: int, waited_s: float, starvation_s: float) -> int:
+    """Class after aging: one promotion per ``starvation_s`` waited,
+    floored at the highest class."""
+    return max(0, cls - int(waited_s // starvation_s))
+
+
+def queue_sort_key(cls: int, t_enqueue: float, seq: int, now: float,
+                   starvation_s: float) -> Tuple[int, int]:
+    """Sort key for the join queue: (effective class, arrival seq) —
+    strict priority between classes, FIFO within one."""
+    return (effective_class(cls, now - t_enqueue, starvation_s), seq)
+
+
+def should_exit(done_iters: int, target_iters: int, t_enqueue: float,
+                deadline_s: Optional[float], now: float,
+                step_est_s: float) -> Tuple[bool, bool]:
+    """Boundary leave decision for one occupied slot: ``(leave, early)``.
+
+    ``leave`` when the target is reached, or when the deadline cannot
+    survive one more boundary (``early=True`` — the caller returns the
+    anytime result with ``degraded=True`` meta).  Callers evaluate this
+    only after a step, so ``done_iters`` is always at least one
+    boundary's worth and the early answer is a real refinement."""
+    if done_iters >= target_iters:
+        return True, False
+    if deadline_s is not None and (now - t_enqueue) + step_est_s > deadline_s:
+        return True, True
+    return False, False

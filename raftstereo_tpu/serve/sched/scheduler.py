@@ -1,0 +1,502 @@
+"""Iteration-level continuous batching over the engine's phase executables.
+
+One :class:`IterationScheduler` replaces whole-request dispatch with a
+per-request scheduler in the LLM-continuous-batching mold: per shape
+bucket it maintains ONE running batch (``max_batch_size`` slots of
+device-resident carried state), advances it one boundary at a time
+through the engine's single-iteration step executable, and lets requests
+join free slots and leave finished ones at iteration boundaries.  A
+32-iteration request therefore never head-of-line blocks a 7-iteration
+stream frame: the short job joins the same running batch at the next
+boundary and leaves ~7 boundaries later, while the long job keeps
+iterating in its own slot.
+
+Engine contract (``serve/engine.py``; tests substitute stubs):
+
+* ``bucket_of(shape) -> (h, w)`` and ``padder_of(shape)``;
+* ``infer_sched_prologue(pairs, flow_inits, slots) -> (hw, state, c)``;
+* ``infer_sched_join(hw, running, incoming, mask) -> (state, c)``;
+* ``infer_sched_step(hw, state, iters_per_step) -> (state, c)``;
+* ``infer_sched_epilogue(hw, state) -> (low, up, c)``.
+
+Correctness: per-bucket batch shape is FIXED, so joining/leaving changes
+slot occupancy, not math — a request scheduled iteratively is bitwise-
+identical to the same request through the monolithic executable at equal
+iteration count (asserted in tests/test_sched.py).
+
+Admission mirrors the micro-batcher it replaces: bounded queue
+(``Overloaded`` beyond ``queue_limit``), per-request timeout while
+queued, ``ShuttingDown`` on stop — the batcher's exception types are
+reused so the HTTP layer keeps one error mapping.  Policy decisions
+(priority aging, deadline early exit) are pure functions in
+``policy.py`` and the clock is injectable, so the scheduling behaviour
+unit-tests deterministically with no device (tests/test_sched.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...config import SchedConfig, ServeConfig
+from ..batcher import Future, Overloaded, RequestTimedOut, ShuttingDown
+from .policy import (PRIORITIES, priority_class, queue_sort_key,
+                     should_exit)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["IterationScheduler", "SchedResult"]
+
+
+@dataclasses.dataclass
+class SchedResult:
+    """One answered request: the disparity plus how it was scheduled."""
+
+    disparity: np.ndarray      # (H, W) float32, dataset sign convention
+    disp_low: np.ndarray       # PADDED (H/f, W/f) low-res field — the
+    # session state a stream forward-warps into the next frame's
+    # flow_init (same contract as infer_stream_batch's middle output)
+    iters: int                 # iterations actually run
+    target_iters: int
+    degraded: bool             # True = deadline early exit (anytime result)
+    priority: str
+    batch_slots: int           # occupied slots when this request left
+    latency_s: float
+    included_compile: bool
+
+
+@dataclasses.dataclass
+class _QueueItem:
+    image1: np.ndarray
+    image2: np.ndarray
+    flow_init: Optional[np.ndarray]
+    target_iters: int
+    deadline_s: Optional[float]
+    cls: int
+    priority: str
+    future: Future
+    t_enqueue: float
+    seq: int
+    bucket: Tuple[int, int]
+    trace_id: Optional[str] = None
+
+
+class _Slot:
+    """One occupied slot of a running batch (worker-thread state)."""
+
+    def __init__(self, item: _QueueItem, padder, compile_seen: bool):
+        self.item = item
+        self.padder = padder
+        self.done_iters = 0
+        self.compile_seen = compile_seen
+
+
+class _RunningBatch:
+    """Per-bucket running batch: device state + slot table (worker-thread
+    state; readers go through ``IterationScheduler.stats``)."""
+
+    def __init__(self, hw: Tuple[int, int], n_slots: int):
+        self.hw = hw
+        self.state = None          # device pytree, set at first join
+        self.slots: List[Optional[_Slot]] = [None] * n_slots
+        self.step_est_s = 0.0      # EMA of boundary latency (deadline est)
+
+    def occupied(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    def free(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+
+class IterationScheduler:
+    """Thread-safe request queue + single scheduling worker over an
+    engine's phase executables."""
+
+    def __init__(self, engine, config: ServeConfig, metrics=None,
+                 tracer=None, now_fn=time.perf_counter):
+        self.engine = engine
+        self.cfg = config
+        self.sched_cfg: SchedConfig = config.sched or SchedConfig()
+        self.metrics = metrics
+        self.tracer = tracer  # obs.Tracer or None (tracing is optional)
+        self._now = now_fn    # injectable clock (policy + latency + spans)
+        self._cv = threading.Condition()
+        self._queue: List[_QueueItem] = []  # guarded_by: _cv
+        self._seq = 0  # guarded_by: _cv
+        self._closed = False  # guarded_by: _cv
+        self._drain = True  # guarded_by: _cv
+        # Snapshot for /healthz + /debug/vars.
+        self._stats = {"active_slots": 0, "buckets": {}}  # guarded_by: _cv
+        # The running batches are worker-thread-confined (only the
+        # scheduling loop touches them); readers use stats().
+        self._running: Dict[Tuple[int, int], _RunningBatch] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "IterationScheduler":
+        assert self._thread is None, "scheduler already started"
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-sched")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop the worker.  ``drain=True`` finishes everything queued and
+        running first; ``drain=False`` fails queued requests immediately
+        with ``ShuttingDown`` and the worker fails running slots."""
+        with self._cv:
+            self._closed = True
+            self._drain = drain
+            if not drain:
+                for it in self._queue:
+                    if self.metrics is not None:
+                        self.metrics.sched_queue_depth.labels(
+                            priority=it.priority).add(-1)
+                    it.future._resolve(exc=ShuttingDown("scheduler stopped"))
+                self._queue.clear()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "IterationScheduler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- admission
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:  # vs a concurrent submit/close mutating the list
+            return len(self._queue)
+
+    def active_slots(self) -> int:
+        with self._cv:
+            return int(self._stats["active_slots"])
+
+    def stats(self) -> Dict[str, object]:
+        """Snapshot for /healthz and /debug/vars (one round stale at
+        most)."""
+        with self._cv:
+            by_prio = {p: 0 for p in PRIORITIES}
+            for it in self._queue:
+                by_prio[it.priority] += 1
+            return {
+                "iters_per_step": self.sched_cfg.iters_per_step,
+                "queue_depth": len(self._queue),
+                "queue_depth_by_priority": by_prio,
+                "active_slots": self._stats["active_slots"],
+                "buckets": dict(self._stats["buckets"]),
+            }
+
+    def submit(self, image1: np.ndarray, image2: np.ndarray, *,
+               iters: Optional[int] = None,
+               flow_init: Optional[np.ndarray] = None,
+               priority: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
+        """Enqueue one stereo pair; returns a ``Future`` resolving to a
+        :class:`SchedResult`.
+
+        ``iters`` may be ANY multiple of ``iters_per_step`` up to
+        ``max_iters`` (default ``cfg.iters``) — the step executable is
+        iteration-count-agnostic, so arbitrary targets cost no compile.
+        Raises ``ValueError`` on a bad target/priority (HTTP 400),
+        ``Overloaded`` beyond ``queue_limit`` (503), ``ShuttingDown``
+        after stop."""
+        sc = self.sched_cfg
+        target = int(iters) if iters is not None else self.cfg.iters
+        if not 1 <= target <= sc.max_iters:
+            raise ValueError(
+                f"iters {target} outside [1, {sc.max_iters}]")
+        if target % sc.iters_per_step:
+            raise ValueError(
+                f"iters {target} not divisible by iters_per_step "
+                f"{sc.iters_per_step}")
+        cls = priority_class(priority or "normal")
+        deadline_s = None
+        if deadline_ms is not None:
+            deadline_s = float(deadline_ms) / 1000.0
+            if deadline_s <= 0:
+                raise ValueError(f"deadline_ms {deadline_ms} must be > 0")
+        bucket = self.engine.bucket_of(image1.shape)
+        fut = Future()
+        with self._cv:
+            if self._closed:
+                raise ShuttingDown("scheduler stopped")
+            if len(self._queue) >= self.cfg.queue_limit:
+                if self.metrics is not None:
+                    self.metrics.shed.inc()
+                raise Overloaded(
+                    f"queue full ({len(self._queue)}/"
+                    f"{self.cfg.queue_limit})")
+            self._seq += 1
+            self._queue.append(_QueueItem(
+                image1, image2, flow_init, target, deadline_s, cls,
+                PRIORITIES[cls], fut, self._now(), self._seq, bucket,
+                trace_id))
+            if self.metrics is not None:
+                self.metrics.sched_queue_depth.labels(
+                    priority=PRIORITIES[cls]).add(1)
+            self._cv.notify_all()
+        return fut
+
+    # --------------------------------------------------------------- worker
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._closed and not self._queue
+                       and not self._running):
+                    self._cv.wait()
+                if self._closed:
+                    if not self._drain:
+                        self._fail_running(ShuttingDown("scheduler "
+                                                        "stopped"))
+                        return
+                    if not self._queue and not self._running:
+                        return
+            try:
+                self.run_once()
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("scheduler round failed")
+
+    def _fail_running(self, exc: BaseException) -> None:
+        for rb in self._running.values():
+            for i in rb.occupied():
+                rb.slots[i].item.future._resolve(exc=exc)
+        self._running.clear()
+
+    def run_once(self) -> bool:
+        """One scheduling round: admit joiners at the boundary, advance
+        every running batch one boundary, release finished slots.
+        Returns whether any work was done (tests drive this directly with
+        an injected clock; the worker thread just loops it)."""
+        now = self._now()
+        joins = self._select_joins(now)
+        for bucket, items in joins.items():
+            self._join(bucket, items)
+        did_work = bool(joins)
+        for bucket, rb in list(self._running.items()):
+            if not rb.occupied():
+                del self._running[bucket]
+                continue
+            did_work = True
+            self._step(rb)
+            self._leave(rb)
+            if not rb.occupied():
+                del self._running[bucket]
+        self._update_stats()
+        return did_work
+
+    # ---------------------------------------------------------- round phases
+
+    def _select_joins(self, now: float) -> Dict[Tuple[int, int],
+                                                List[_QueueItem]]:
+        """Pop this boundary's joiners under the queue lock: time out
+        stale requests, order the rest by (aged priority, FIFO), grant
+        free slots per bucket."""
+        sc = self.sched_cfg
+        timeout_s = self.cfg.request_timeout_ms / 1000.0
+        joins: Dict[Tuple[int, int], List[_QueueItem]] = {}
+        with self._cv:
+            keep: List[_QueueItem] = []
+            for it in self._queue:
+                if now - it.t_enqueue > timeout_s:
+                    if self.metrics is not None:
+                        self.metrics.timeouts.inc()
+                        self.metrics.sched_queue_depth.labels(
+                            priority=it.priority).add(-1)
+                    if self.tracer is not None and it.trace_id is not None:
+                        self.tracer.record(
+                            "queue_wait", it.t_enqueue, now, it.trace_id,
+                            attrs={"outcome": "timeout"})
+                    it.future._resolve(exc=RequestTimedOut(
+                        f"queued {now - it.t_enqueue:.3f}s > "
+                        f"{timeout_s:.3f}s limit"))
+                else:
+                    keep.append(it)
+            keep.sort(key=lambda it: queue_sort_key(
+                it.cls, it.t_enqueue, it.seq, now,
+                sc.starvation_ms / 1000.0))
+            free: Dict[Tuple[int, int], int] = {}
+            granted: List[_QueueItem] = []
+            for it in keep:
+                if it.bucket not in free:
+                    rb = self._running.get(it.bucket)
+                    free[it.bucket] = (len(rb.free()) if rb is not None
+                                       else self.cfg.max_batch_size)
+                if free[it.bucket] > 0:
+                    free[it.bucket] -= 1
+                    granted.append(it)
+                    joins.setdefault(it.bucket, []).append(it)
+            for it in granted:
+                keep.remove(it)
+                if self.metrics is not None:
+                    self.metrics.sched_queue_depth.labels(
+                        priority=it.priority).add(-1)
+            self._queue = keep
+        return joins
+
+    def _join(self, bucket: Tuple[int, int],
+              items: List[_QueueItem]) -> None:
+        """Prologue the joiners at their assigned slots and merge them
+        into the bucket's running batch."""
+        rb = self._running.get(bucket)
+        if rb is None:
+            rb = self._running[bucket] = _RunningBatch(
+                bucket, self.cfg.max_batch_size)
+        slots = rb.free()[:len(items)]
+        assert len(slots) == len(items), (slots, len(items))
+        try:
+            hw, incoming, miss = self.engine.infer_sched_prologue(
+                [(it.image1, it.image2) for it in items],
+                [it.flow_init for it in items], slots)
+            assert hw == bucket, (hw, bucket)
+            # Before the join dispatch overwrites it: the prologue's own
+            # timing window, for the per-request sched_prologue spans.
+            seg = getattr(self.engine, "last_segments", None)
+            if rb.state is None:
+                rb.state = incoming
+            else:
+                mask = np.zeros(self.cfg.max_batch_size, bool)
+                mask[slots] = True
+                rb.state, join_miss = self.engine.infer_sched_join(
+                    bucket, rb.state, incoming, mask)
+                miss = miss or join_miss
+        except Exception as e:  # fail the joiners, keep the batch alive
+            if self.metrics is not None:
+                self.metrics.errors.inc(len(items))
+            for it in items:
+                it.future._resolve(exc=e)
+            return
+        now = self._now()
+        for it, slot in zip(items, slots):
+            rb.slots[slot] = _Slot(it, self.engine.padder_of(
+                it.image1.shape), miss)
+            if self.tracer is not None and it.trace_id is not None:
+                self.tracer.record(
+                    "queue_wait", it.t_enqueue, now, it.trace_id,
+                    attrs={"bucket": f"{bucket[0]}x{bucket[1]}",
+                           "slot": slot, "priority": it.priority})
+                if seg is not None:
+                    self.tracer.record(
+                        "sched_prologue", *seg["dispatch"], it.trace_id,
+                        attrs={"compile": seg["compile"]})
+        if self.metrics is not None:
+            self.metrics.sched_joins.inc(len(items))
+
+    def _step(self, rb: _RunningBatch) -> None:
+        """Advance every occupied slot by one boundary."""
+        ips = self.sched_cfg.iters_per_step
+        t0 = self._now()
+        try:
+            rb.state, miss = self.engine.infer_sched_step(rb.hw, rb.state,
+                                                          ips)
+        except Exception as e:  # fail the whole batch, drop its state
+            occ = rb.occupied()
+            if self.metrics is not None:
+                self.metrics.errors.inc(len(occ))
+            for i in occ:
+                rb.slots[i].item.future._resolve(exc=e)
+                rb.slots[i] = None
+            rb.state = None
+            return
+        dt = self._now() - t0
+        # EMA of compile-free boundary latency: the deadline-exit estimate.
+        if not miss:
+            rb.step_est_s = (dt if rb.step_est_s == 0.0
+                             else 0.7 * rb.step_est_s + 0.3 * dt)
+        if self.metrics is not None:
+            self.metrics.sched_steps.inc()
+            if not miss:
+                self.metrics.sched_step_latency.observe(dt)
+        for i in rb.occupied():
+            s = rb.slots[i]
+            s.done_iters += ips
+            s.compile_seen = s.compile_seen or miss
+            if self.tracer is not None and s.item.trace_id is not None:
+                self.tracer.record(
+                    "iteration", t0, t0 + dt, s.item.trace_id,
+                    attrs={"i": s.done_iters, "iters_per_step": ips,
+                           "compile": miss})
+
+    def _leave(self, rb: _RunningBatch) -> None:
+        """Release every slot whose target is reached or whose deadline
+        cannot survive another boundary (the anytime early exit)."""
+        now = self._now()
+        leavers = []
+        for i in rb.occupied():
+            s = rb.slots[i]
+            leave, early = should_exit(
+                s.done_iters, s.item.target_iters, s.item.t_enqueue,
+                s.item.deadline_s, now, rb.step_est_s)
+            if leave:
+                leavers.append((i, early))
+        if not leavers:
+            return
+        try:
+            low, up, miss = self.engine.infer_sched_epilogue(rb.hw,
+                                                             rb.state)
+        except Exception as e:
+            if self.metrics is not None:
+                self.metrics.errors.inc(len(leavers))
+            for i, _ in leavers:
+                rb.slots[i].item.future._resolve(exc=e)
+                rb.slots[i] = None
+            return
+        n_occupied = len(rb.occupied())
+        seg = getattr(self.engine, "last_segments", None)
+        done = self._now()
+        for i, early in leavers:
+            s = rb.slots[i]
+            it = s.item
+            # .copy() on both slices: results outlive the padded batch
+            # arrays (same rationale as infer_stream_batch).
+            disp = s.padder.unpad(up[i:i + 1])[0, ..., 0].copy()
+            disp_low = low[i, :, :, 0].copy()
+            latency = done - it.t_enqueue
+            if self.tracer is not None and it.trace_id is not None and \
+                    seg is not None:
+                self.tracer.record(
+                    "sched_epilogue", *seg["dispatch"], it.trace_id,
+                    attrs={"early": early, "iters": s.done_iters})
+            if self.metrics is not None:
+                self.metrics.sched_leaves.inc()
+                if early:
+                    self.metrics.sched_early_exits.inc()
+                self.metrics.responses.inc()
+                self.metrics.latency.observe(latency)
+            it.future._resolve(value=SchedResult(
+                disparity=disp, disp_low=disp_low, iters=s.done_iters,
+                target_iters=it.target_iters, degraded=early,
+                priority=it.priority, batch_slots=n_occupied,
+                latency_s=latency,
+                included_compile=s.compile_seen or miss))
+            rb.slots[i] = None
+
+    def _update_stats(self) -> None:
+        buckets = {}
+        total = 0
+        for bucket, rb in self._running.items():
+            n = len(rb.occupied())
+            total += n
+            buckets[f"{bucket[0]}x{bucket[1]}"] = {
+                "active_slots": n,
+                "occupancy": round(n / self.cfg.max_batch_size, 4),
+                "step_est_ms": round(rb.step_est_s * 1e3, 3),
+            }
+        with self._cv:
+            self._stats = {"active_slots": total, "buckets": buckets}
+        if self.metrics is not None:
+            self.metrics.sched_slots_active.set(total)
+            cap = max(1, len(buckets)) * self.cfg.max_batch_size
+            self.metrics.sched_occupancy.set(
+                round(total / cap, 4) if buckets else 0.0)

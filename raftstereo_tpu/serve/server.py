@@ -12,7 +12,12 @@ Endpoints:
   "meta": {...}}``, 503 ``overloaded`` when admission control sheds, 504
   on a per-request timeout, 400 on a malformed body.  Every reply carries
   an ``X-Request-Id`` header (also ``meta.request_id``) — the trace id of
-  the request's spans in ``/debug/trace``.
+  the request's spans in ``/debug/trace``.  Under the iteration-level
+  scheduler (``--sched``, docs/serving.md) the body also accepts
+  ``deadline_ms`` (deadline-aware early exit: the reply carries the
+  anytime result with ``meta.degraded`` true) and ``priority``
+  (``high``/``normal``/``low``), and ``iters`` may be any multiple of
+  ``iters_per_step`` up to ``max_iters``.
 * ``GET /metrics`` — Prometheus text exposition (serve/metrics.py).
 * ``GET /healthz`` — JSON liveness: queue depth, compiled buckets, config.
 * ``GET /debug/trace?last=N`` — recent spans as downloadable Chrome
@@ -50,6 +55,7 @@ from ..utils.profiling import OnDemandProfiler, ProfilerBusy
 from .batcher import DynamicBatcher, Overloaded, RequestTimedOut, ShuttingDown
 from .engine import BatchEngine
 from .metrics import ServeMetrics
+from .sched import IterationScheduler
 
 logger = logging.getLogger(__name__)
 
@@ -140,11 +146,13 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/healthz":
             health = {
                 "status": "ok",
-                "queue_depth": srv.batcher.queue_depth,
+                "queue_depth": srv.queue_depth,
                 "compiled_buckets": sorted(srv.engine.compiled_keys),
                 "max_batch_size": srv.config.max_batch_size,
                 "iters": srv.config.iters,
             }
+            if srv.scheduler is not None:
+                health["sched"] = srv.scheduler.stats()
             if srv.stream is not None:
                 health["stream"] = {
                     "ladder": list(srv.config.stream.ladder),
@@ -170,10 +178,12 @@ class _Handler(BaseHTTPRequestHandler):
                 "build": build_info(),
                 "engine": {
                     "compiled_buckets": sorted(srv.engine.compiled_keys),
-                    "queue_depth": srv.batcher.queue_depth,
+                    "queue_depth": srv.queue_depth,
                     "stream_sessions": (len(srv.stream.store)
                                         if srv.stream is not None else None),
                 },
+                "sched": (srv.scheduler.stats()
+                          if srv.scheduler is not None else None),
                 "trace": {"capacity": srv.tracer.capacity,
                           "recorded": srv.tracer.recorded,
                           "dropped": srv.tracer.dropped},
@@ -251,6 +261,8 @@ class _Handler(BaseHTTPRequestHandler):
                 iters = payload.get("iters")
                 session_id = payload.get("session_id")
                 seq_no = payload.get("seq_no")
+                deadline_ms = payload.get("deadline_ms")
+                priority = payload.get("priority")
             except Exception as e:
                 self._finish(400, {"error": f"bad request: {e}"},
                              endpoint, rid, t_req0)
@@ -266,6 +278,16 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError(
                     f"image side {max(left.shape[:2])} exceeds "
                     f"max_image_dim {srv.config.max_image_dim}")
+            if srv.scheduler is None and (deadline_ms is not None
+                                          or priority is not None):
+                raise ValueError(
+                    "deadline_ms/priority require the iteration-level "
+                    "scheduler (start the server with --sched)")
+            if session_id is not None and (deadline_ms is not None
+                                           or priority is not None):
+                raise ValueError(
+                    "session frames are scheduled as high-priority short "
+                    "jobs; deadline_ms/priority cannot be set per frame")
             if session_id is not None:
                 # Streaming frame: validated here, then dispatched outside
                 # this block (the session path bypasses the micro-batcher).
@@ -284,35 +306,68 @@ class _Handler(BaseHTTPRequestHandler):
                     seq_no = int(seq_no)
                 if not srv.config.cold_buckets:
                     hw = srv.engine.bucket_of(left.shape)
-                    missing = [lv for lv in srv.config.stream.ladder
-                               if not srv.engine.is_stream_warm(hw, lv)]
-                    if missing:
-                        raise ValueError(
-                            f"shape {tuple(left.shape[:2])} -> bucket {hw} "
-                            f"stream levels {missing} not warmed; configure "
-                            f"--buckets and --stream_warmup")
+                    if srv.scheduler is not None:
+                        # Scheduled frames ride the phase executables:
+                        # every ladder level is served by the same step
+                        # executable, so warmth is per bucket, not level.
+                        if not srv.engine.is_sched_warm(
+                                hw, srv.config.sched.iters_per_step):
+                            raise ValueError(
+                                f"shape {tuple(left.shape[:2])} -> bucket "
+                                f"{hw} not sched-warmed; configure "
+                                f"--buckets")
+                    else:
+                        missing = [lv for lv in srv.config.stream.ladder
+                                   if not srv.engine.is_stream_warm(hw, lv)]
+                        if missing:
+                            raise ValueError(
+                                f"shape {tuple(left.shape[:2])} -> bucket "
+                                f"{hw} stream levels {missing} not warmed; "
+                                f"configure --buckets and --stream_warmup")
             if iters is not None:
-                # Only the configured (warmed) iteration levels: arbitrary
-                # client values would each compile a fresh executable under
-                # the engine lock — a trivially triggered latency DoS.
                 iters = int(iters)
-                allowed = {srv.config.iters, srv.config.degraded_iters}
-                if iters not in allowed:
-                    raise ValueError(
-                        f"iters {iters} not served; choose from "
-                        f"{sorted(allowed)}")
+                if srv.scheduler is not None:
+                    # Iteration-level scheduling serves ANY target from
+                    # the same step executable — only the cap and the
+                    # boundary granularity constrain it (no per-iters
+                    # compile to protect against).
+                    sc = srv.config.sched
+                    if not 1 <= iters <= sc.max_iters \
+                            or iters % sc.iters_per_step:
+                        raise ValueError(
+                            f"iters {iters} not served; must be a "
+                            f"multiple of {sc.iters_per_step} in "
+                            f"[1, {sc.max_iters}]")
+                else:
+                    # Only the configured (warmed) iteration levels:
+                    # arbitrary client values would each compile a fresh
+                    # executable under the engine lock — a trivially
+                    # triggered latency DoS.
+                    allowed = {srv.config.iters, srv.config.degraded_iters}
+                    if iters not in allowed:
+                        raise ValueError(
+                            f"iters {iters} not served; choose from "
+                            f"{sorted(allowed)}")
             if session_id is None and not srv.config.cold_buckets:
                 # Production setting (plain requests; session frames have
-                # their own stream-executable check above): shapes outside
-                # the warmed buckets are rejected up front — an on-demand
+                # their own executable check above): shapes outside the
+                # warmed buckets are rejected up front — an on-demand
                 # compile would stall every queued request behind it.
                 hw = srv.engine.bucket_of(left.shape)
-                want = iters if iters is not None else srv.config.iters
-                if not srv.engine.is_warm(hw, want):
-                    raise ValueError(
-                        f"shape {tuple(left.shape[:2])} -> bucket {hw} "
-                        f"(iters {want}) not warmed; configure it in "
-                        f"--buckets")
+                if srv.scheduler is not None:
+                    if not srv.engine.is_sched_warm(
+                            hw, srv.config.sched.iters_per_step):
+                        raise ValueError(
+                            f"shape {tuple(left.shape[:2])} -> bucket "
+                            f"{hw} not sched-warmed; configure it in "
+                            f"--buckets")
+                else:
+                    want = iters if iters is not None else srv.config.iters
+                    if not srv.engine.is_warm(hw, want):
+                        raise ValueError(
+                            f"shape {tuple(left.shape[:2])} -> bucket {hw} "
+                            f"(iters {want}) not warmed; configure it in "
+                            f"--buckets")
         except Exception as e:
             self._finish(400, {"error": f"bad request: {e}"},
                          endpoint, rid, t_req0)
@@ -345,6 +400,23 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 res = srv.stream.step(session_id, seq_no, left, right,
                                       trace_id=rid)
+            except Overloaded as e:
+                # Sched mode: the frame is a scheduler job and admission
+                # can shed it there too — same backpressure contract as
+                # the plain path (503 + Retry-After, never a 500).
+                self._finish(503, {"error": "overloaded",
+                                   "detail": str(e)},
+                             endpoint, rid, t_req0, {"Retry-After": "1"})
+                return
+            except RequestTimedOut as e:
+                self._finish(504, {"error": "timeout", "detail": str(e)},
+                             endpoint, rid, t_req0)
+                return
+            except (TimeoutError, ShuttingDown) as e:
+                self._finish(503, {"error": "unavailable",
+                                   "detail": str(e)},
+                             endpoint, rid, t_req0)
+                return
             except Exception as e:
                 self._finish(500, {"error": f"inference failed: {e}"},
                              endpoint, rid, t_req0)
@@ -367,12 +439,25 @@ class _Handler(BaseHTTPRequestHandler):
         # would get a spurious 503 while the server finishes the compile
         # and discards the result.
         hw = srv.engine.bucket_of(left.shape)
-        levels = ([iters] if iters is not None
-                  else [srv.config.iters, srv.config.degraded_iters])
-        warm = all(srv.engine.is_warm(hw, lv) for lv in levels)
+        if srv.scheduler is not None:
+            warm = srv.engine.is_sched_warm(
+                hw, srv.config.sched.iters_per_step)
+        else:
+            levels = ([iters] if iters is not None
+                      else [srv.config.iters, srv.config.degraded_iters])
+            warm = all(srv.engine.is_warm(hw, lv) for lv in levels)
         slack = 60.0 if warm else 600.0
         try:
-            fut = srv.batcher.submit(left, right, iters, trace_id=rid)
+            if srv.scheduler is not None:
+                fut = srv.scheduler.submit(
+                    left, right, iters=iters, priority=priority,
+                    deadline_ms=deadline_ms, trace_id=rid)
+            else:
+                fut = srv.batcher.submit(left, right, iters, trace_id=rid)
+        except ValueError as e:  # bad priority/deadline/target (sched)
+            self._finish(400, {"error": f"bad request: {e}"},
+                         endpoint, rid, t_req0)
+            return
         except Overloaded as e:
             self._finish(503, {"error": "overloaded", "detail": str(e)},
                          endpoint, rid, t_req0, {"Retry-After": "1"})
@@ -382,8 +467,9 @@ class _Handler(BaseHTTPRequestHandler):
                          endpoint, rid, t_req0)
             return
         try:
-            # The batcher enforces request_timeout_ms at dispatch; the
-            # slack covers whatever can run ahead (batch or cold compile).
+            # The batcher/scheduler enforces request_timeout_ms while
+            # queued; the slack covers whatever can run ahead (batch or
+            # cold compile).
             res = fut.result(
                 timeout=srv.config.request_timeout_ms / 1000.0 + slack)
         except RequestTimedOut as e:
@@ -398,11 +484,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._finish(500, {"error": f"inference failed: {e}"},
                          endpoint, rid, t_req0)
             return
+        if srv.scheduler is not None:
+            meta = {"iters": res.iters, "target_iters": res.target_iters,
+                    "degraded": res.degraded, "priority": res.priority,
+                    "batch_slots": res.batch_slots,
+                    "latency_ms": round(res.latency_s * 1e3, 3)}
+        else:
+            meta = {"iters": res.iters, "degraded": res.degraded,
+                    "batch_size": res.batch_size,
+                    "latency_ms": round(res.latency_s * 1e3, 3)}
         self._finish(200, {
             "disparity": encode_array(res.disparity),
-            "meta": {"iters": res.iters, "degraded": res.degraded,
-                     "batch_size": res.batch_size,
-                     "latency_ms": round(res.latency_s * 1e3, 3)},
+            "meta": meta,
         }, endpoint, rid, t_req0)
 
 
@@ -416,11 +509,16 @@ class StereoServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, config: ServeConfig, engine: BatchEngine,
-                 batcher: DynamicBatcher, metrics: ServeMetrics,
-                 stream=None, tracer: Optional[Tracer] = None):
+                 batcher: Optional[DynamicBatcher], metrics: ServeMetrics,
+                 stream=None, tracer: Optional[Tracer] = None,
+                 scheduler: Optional[IterationScheduler] = None):
+        assert (batcher is None) != (scheduler is None), (
+            "exactly one of batcher (monolithic dispatch) or scheduler "
+            "(iteration-level continuous batching) must be set")
         self.config = config
         self.engine = engine
         self.batcher = batcher
+        self.scheduler = scheduler
         self.metrics = metrics
         self.stream = stream  # stream.runner.StreamRunner or None
         self.tracer = tracer or Tracer(capacity=config.trace_buffer)
@@ -441,11 +539,20 @@ class StereoServer(ThreadingHTTPServer):
     def port(self) -> int:
         return self.server_address[1]
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for dispatch, whichever front-end is active."""
+        return (self.scheduler.queue_depth if self.scheduler is not None
+                else self.batcher.queue_depth)
+
     def close(self) -> None:
         """Stop accepting, drain the queue, release the socket."""
         self.shutdown()
         self.server_close()
-        self.batcher.stop(drain=True)
+        if self.batcher is not None:
+            self.batcher.stop(drain=True)
+        if self.scheduler is not None:
+            self.scheduler.stop(drain=True)
 
 
 def build_server(model, variables, config: ServeConfig,
@@ -460,22 +567,38 @@ def build_server(model, variables, config: ServeConfig,
     metrics = metrics or ServeMetrics()
     tracer = tracer or Tracer(capacity=config.trace_buffer)
     engine = BatchEngine(model, variables, config, metrics)
-    if config.warmup:
+    scheduler = None
+    if config.sched is not None:
+        # Iteration-level continuous batching: the scheduler IS the
+        # dispatch path — the micro-batcher is not started, admission
+        # control lives in scheduler.submit, and session frames ride the
+        # same scheduler as high-priority short jobs.  Warmth is the four
+        # phase executables per bucket, not per iteration level.
+        if config.warmup:
+            engine.warmup_sched(iters_per_step=config.sched.iters_per_step)
+        scheduler = IterationScheduler(engine, config, metrics,
+                                       tracer=tracer).start()
+    elif config.warmup:
         engine.warmup()
     stream = None
     if config.stream is not None:
         from ..stream.runner import StreamRunner  # local: avoids an
         # import cycle (stream.runner's engine builder imports this pkg)
-        stream = StreamRunner(engine, config.stream, metrics, tracer=tracer)
-        if config.stream_warmup:
+        stream = StreamRunner(engine, config.stream, metrics, tracer=tracer,
+                              scheduler=scheduler)
+        if config.stream_warmup and scheduler is None:
             engine.warmup_stream(ladder=config.stream.ladder)
-    batcher = DynamicBatcher(engine, config, metrics, tracer=tracer).start()
+    batcher = None
+    if scheduler is None:
+        batcher = DynamicBatcher(engine, config, metrics,
+                                 tracer=tracer).start()
     server = StereoServer(config, engine, batcher, metrics, stream=stream,
-                          tracer=tracer)
+                          tracer=tracer, scheduler=scheduler)
     logger.info("serving on %s:%d (buckets=%s, max_batch=%d, iters=%d/%d, "
-                "stream=%s)",
+                "stream=%s, sched=%s)",
                 config.host, server.port,
                 sorted(engine.compiled_keys) or "lazy",
                 config.max_batch_size, config.iters, config.degraded_iters,
-                list(config.stream.ladder) if config.stream else "off")
+                list(config.stream.ladder) if config.stream else "off",
+                "on" if scheduler is not None else "off")
     return server

@@ -60,11 +60,17 @@ class StreamRunner:
     """
 
     def __init__(self, engine, cfg: StreamConfig, metrics=None,
-                 store: Optional[SessionStore] = None, tracer=None):
+                 store: Optional[SessionStore] = None, tracer=None,
+                 scheduler=None):
         self.engine = engine
         self.cfg = cfg
         self.metrics = metrics
         self.tracer = tracer  # obs.Tracer or None (tracing is optional)
+        # Iteration-level scheduler (serve/sched/): when set, frames are
+        # submitted as HIGH-priority short jobs through the shared
+        # scheduler instead of dispatching batch-size-1 on the engine —
+        # so a long plain request never head-of-line blocks a stream.
+        self.scheduler = scheduler
         self.controller = AdaptiveIterController(cfg)
         self.store = store or SessionStore(cfg.session_limit,
                                            cfg.session_ttl_s, metrics)
@@ -110,19 +116,37 @@ class StreamRunner:
             else:
                 init, iters, cold_reason = None, ctl.cold_iters, "resized"
             t_fwd0 = time.perf_counter()
-            disp, low, compiled = self.engine.infer_stream_batch(
-                [(left, right)], iters, [init])[0]
-            if tracer is not None:
-                seg = getattr(self.engine, "last_segments", None)
-                fwd_end = (seg["dispatch"][1] if seg
-                           else time.perf_counter())
-                tracer.record("forward", t_fwd0, fwd_end, trace_id,
-                              attrs={"session_id": session_id,
-                                     "seq_no": seq_no, "iters": iters,
-                                     "warm": warm, "compile": compiled})
-                if seg is not None:
-                    tracer.record("host_fetch", *seg["host_fetch"],
-                                  trace_id)
+            if self.scheduler is not None:
+                # High-priority short job through the shared scheduler:
+                # the frame joins the running batch at the next iteration
+                # boundary (its join/step/epilogue spans are recorded by
+                # the scheduler under this trace id).
+                res = self.scheduler.submit(
+                    left, right, iters=iters, flow_init=init,
+                    priority="high", trace_id=trace_id).result(timeout=600)
+                disp, low, compiled = (res.disparity, res.disp_low,
+                                       res.included_compile)
+                if tracer is not None:
+                    tracer.record("forward", t_fwd0, time.perf_counter(),
+                                  trace_id,
+                                  attrs={"session_id": session_id,
+                                         "seq_no": seq_no, "iters": iters,
+                                         "warm": warm, "compile": compiled,
+                                         "sched": True})
+            else:
+                disp, low, compiled = self.engine.infer_stream_batch(
+                    [(left, right)], iters, [init])[0]
+                if tracer is not None:
+                    seg = getattr(self.engine, "last_segments", None)
+                    fwd_end = (seg["dispatch"][1] if seg
+                               else time.perf_counter())
+                    tracer.record("forward", t_fwd0, fwd_end, trace_id,
+                                  attrs={"session_id": session_id,
+                                         "seq_no": seq_no, "iters": iters,
+                                         "warm": warm, "compile": compiled})
+                    if seg is not None:
+                        tracer.record("host_fetch", *seg["host_fetch"],
+                                      trace_id)
             if warm:
                 delta = float(np.mean(np.abs(low - init)))
                 sess.ema = ctl.update_ema(sess.ema, delta)

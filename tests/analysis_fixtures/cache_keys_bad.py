@@ -23,3 +23,15 @@ class Engine:
             if (h, w) in self._compiled:    # mode missing: RSA401
                 continue
             self.infer_fixed([], 8)
+
+    def infer_step(self, state, iters_per_step):
+        h, w = 64, 96
+        key = (h, w, "sched_step")      # iters_per_step NOT in the key
+        return self._dispatch(key, lambda: (state, iters_per_step))  # RSA401
+
+    def warmup_phases(self, buckets, iters_per_step):
+        for h, w in buckets:
+            key = (h, w, 0, "sched_prologue")
+            if key in self._compiled:   # iters_per_step missing: RSA401
+                continue
+            self._dispatch(key, lambda: None)

@@ -22,3 +22,22 @@ class Engine:
                 if key in self._compiled:
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_prologue(self, pairs):
+        # Phase executables with no key-relevant params still need a
+        # shape-derived (non-constant) key.
+        h, w = 64, 96
+        key = (h, w, 0, "sched_prologue")
+        return self._dispatch(key, lambda: pairs)
+
+    def infer_step(self, state, iters_per_step):
+        h, w = 64, 96
+        key = (h, w, iters_per_step, "sched_step")
+        return self._dispatch(key, lambda: state)
+
+    def warmup_phases(self, buckets, iters_per_step):
+        for h, w in buckets:
+            key = (h, w, iters_per_step, "sched_step")
+            if key in self._compiled:
+                continue
+            self._dispatch(key, lambda: None)

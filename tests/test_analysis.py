@@ -90,11 +90,20 @@ class TestCacheKeys:
     def test_bad_fixture_exact_codes_and_lines(self):
         findings = _run("cache_keys_bad.py")
         assert _codes_lines(findings) == [
-            ("RSA401", 16), ("RSA402", 19), ("RSA401", 23)]
+            ("RSA401", 16), ("RSA402", 19), ("RSA401", 23),
+            ("RSA401", 30), ("RSA401", 35)]
         assert "precision" in findings[0].message
         assert "mode" in findings[2].message
+        # The scheduler's phase-executable keys (serve/engine.py): a step
+        # key missing iters_per_step, and a warmup membership test whose
+        # key omits it.
+        assert "iters_per_step" in findings[3].message
+        assert "iters_per_step" in findings[4].message
 
     def test_good_fixture_is_clean(self):
+        # Includes the phase-executable shapes: prologue (no key-relevant
+        # params, shape-derived key), step keyed by iters_per_step, and a
+        # warmup loop whose membership test carries it.
         assert _run("cache_keys_good.py") == []
 
 
