@@ -565,6 +565,86 @@ def bench_serve(height: int, width: int, iters: int, max_batch: int,
     return stats
 
 
+def bench_cluster(height: int, width: int, iters: int, replicas: int,
+                  max_batch: int, requests: int, concurrency: int,
+                  corr: str, compute_dtype: str, quick: bool):
+    """Replicated-serving smoke benchmark (mirrors --serve): N engine
+    replicas on N virtual CPU devices (or real chips) behind ONE HTTP
+    server — the in-process cluster dispatcher spreads cold traffic by
+    least outstanding work and pins session frames (serve/cluster/,
+    docs/serving.md "Cluster").  Drives mixed cold + session traffic and
+    reports achieved pairs/sec plus the per-replica dispatch split (a
+    single hot replica means placement is broken)."""
+    import threading
+
+    from raftstereo_tpu.config import (ClusterConfig, RAFTStereoConfig,
+                                       ServeConfig, StreamConfig)
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.serve import (build_server, run_load,
+                                      synthetic_pair_pool)
+
+    import jax
+
+    if len(jax.devices()) < replicas:
+        sys.exit(f"bench: --cluster needs {replicas} devices, have "
+                 f"{len(jax.devices())} (on CPU set XLA_FLAGS="
+                 f"--xla_force_host_platform_device_count={replicas})")
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        # CPU-feasible model, same shrink as the test suite's tiny configs.
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype, **model_kw)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+    iters = max(iters, 2)
+    serve_cfg = ServeConfig(
+        port=0, buckets=((height, width),), max_batch_size=max_batch,
+        max_wait_ms=5.0, queue_limit=max(4 * max_batch, 16),
+        iters=iters, degraded_iters=iters,  # one warmup compile/replica
+        degrade_queue_depth=max(4 * max_batch, 16),
+        stream=StreamConfig(ladder=(iters, max(1, iters // 2)),
+                            demote_threshold=0.0, promote_threshold=1e6,
+                            cold_reset_threshold=2e6),
+        stream_warmup=True,
+        cluster=ClusterConfig(replicas=replicas))
+    server = build_server(model, variables, serve_cfg)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        # Mixed traffic, the cluster acceptance shape: a cold burst
+        # spread by least-outstanding-work, then session sequences that
+        # must stay pinned (client retries ride out transient 503s the
+        # way a router-fronted deployment would).
+        cold = run_load(serve_cfg.host, server.port,
+                        synthetic_pair_pool(height, width),
+                        requests=requests, concurrency=concurrency,
+                        retries=2)
+        seq_len = max(2, requests // 4)
+        stream = run_load(serve_cfg.host, server.port,
+                          synthetic_pair_pool(height, width),
+                          requests=requests, concurrency=concurrency,
+                          sequence_len=seq_len, retries=2)
+        per_replica = {
+            f"{labels[0]}/{labels[1]}": child.value
+            for labels, child in
+            server.cluster.cluster_metrics.dispatch.series()}
+    finally:
+        server.close()
+        thread.join(10)
+    return {
+        "replicas": replicas,
+        "cold": cold,
+        "stream": stream,
+        "dispatch_by_replica": per_replica,
+        "pairs_per_sec": round(
+            (cold["ok"] + stream["ok"])
+            / max(cold["wall_s"] + stream["wall_s"], 1e-9), 4),
+    }
+
+
 def bench_stream(height: int, width: int, frames: int, iters: int,
                  corr: str, compute_dtype: str, quick: bool):
     """Streaming smoke benchmark (mirrors --serve): replay an N-frame
@@ -790,6 +870,16 @@ def main() -> None:
                         "vs the monolithic micro-batcher path, reporting "
                         "short-job p50/p99 both ways (the head-of-line "
                         "blocking gap)")
+    p.add_argument("--cluster", action="store_true",
+                   help="benchmark replicated serving: N engine replicas "
+                        "(one per device; --replicas, default 2) behind "
+                        "one server, mixed cold + session traffic, "
+                        "reporting pairs/sec and the per-replica "
+                        "dispatch split (docs/serving.md \"Cluster\")")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="engine replicas for --cluster (needs that many "
+                        "devices; on CPU set XLA_FLAGS="
+                        "--xla_force_host_platform_device_count)")
     p.add_argument("--stream", action="store_true",
                    help="benchmark the temporal warm-start streaming "
                         "subsystem: N-frame synthetic video sequence, "
@@ -815,7 +905,8 @@ def main() -> None:
     # Perf rounds must not land on top of known hazards: the smoke modes
     # refuse to run while the static-analysis baseline has entries
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
-    if args.quick or args.serve or args.stream or args.sched:
+    if args.quick or args.serve or args.stream or args.sched \
+            or args.cluster:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -836,9 +927,10 @@ def main() -> None:
         args.iters = 32
     if args.reps is None:
         args.reps = 20
-    if args.batch is None and not args.serve and not args.sched:
-        args.batch = 1  # --serve/--sched resolve their own default
-        # (8; 4 in --quick)
+    if args.batch is None and not args.serve and not args.sched \
+            and not args.cluster:
+        args.batch = 1  # --serve/--sched/--cluster resolve their own
+        # default (8; 4 or 2 in --quick)
     # Defaults keyed on the mode, resolved only when the flag was NOT
     # given — an explicit --height/--width always wins (also under --tiled,
     # also with --quick).
@@ -878,7 +970,51 @@ def main() -> None:
     # platform before JAX_PLATFORMS from the shell can apply — push it
     # through jax.config so `JAX_PLATFORMS=cpu python bench.py` works.
     from raftstereo_tpu.utils import apply_env_platform
+
+    if args.cluster and "jax" not in sys.modules \
+            and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # A CPU host shows one device by default; fan it out so N
+        # replicas exist to place on (no-op under a real TPU runtime,
+        # where JAX_PLATFORMS selects the chips).  Must happen before
+        # the first jax import freezes XLA_FLAGS.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.replicas}"
+        ).strip()
     apply_env_platform()
+
+    if args.cluster:
+        h, w = args.height, args.width
+        batch = args.batch if args.batch is not None else 8
+        requests = args.reps
+        if args.quick:
+            # Tiny model + shape; still crosses HTTP + dispatcher +
+            # per-replica warmup with enough traffic to hit BOTH
+            # replicas.  An explicitly given flag wins, as ever.  The
+            # floor is lower than --serve's 12: the mode runs TWO load
+            # phases (cold + sessions) on N warmed replicas, so 8 each
+            # already exercises every path.
+            if not explicit_hw:
+                h, w = 64, 96
+            batch = args.batch if args.batch is not None else 2
+            requests = max(args.reps, 8)
+            if not explicit_iters:
+                args.iters = min(args.iters, 2)
+        summary = bench_cluster(h, w, args.iters, args.replicas, batch,
+                                requests, args.serve_concurrency,
+                                args.corr, args.compute_dtype,
+                                quick=args.quick)
+        record = {
+            "metric": f"cluster pairs/sec @{w}x{h}, {args.replicas} "
+                      f"replicas, mixed cold+session traffic over HTTP",
+            "value": summary["pairs_per_sec"],
+            "unit": "pairs/sec",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
 
     if args.serve:
         h, w = args.height, args.width

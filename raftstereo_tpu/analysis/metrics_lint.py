@@ -35,13 +35,18 @@ _TRAIN_PATH = "raftstereo_tpu/train/telemetry.py"
 def run_metrics_lint() -> List[Finding]:
     """Instantiate + lint + render-validate the repo's metric bundles."""
     from ..obs import lint_registry, validate_prometheus
-    from ..serve.metrics import MetricsRegistry, ServeMetrics
+    from ..serve.metrics import (ClusterMetrics, MetricsRegistry,
+                                 ServeMetrics)
     from ..train.telemetry import TrainMetrics
 
     findings: List[Finding] = []
     registry = MetricsRegistry()
     try:
         serve = ServeMetrics(registry)
+        # The cluster dispatcher mounts its families on the SAME
+        # registry as the serve bundle (server /metrics is one render),
+        # so collisions between the two must fail here.
+        cluster = ClusterMetrics(registry)
         TrainMetrics(registry)
     except ValueError as e:  # duplicate registration across bundles
         return [Finding("RSA503", _TRAIN_PATH, 1,
@@ -60,6 +65,11 @@ def run_metrics_lint() -> List[Finding]:
                               mode="stream").inc()
     serve.stream_cold_frames.labels(reason="new").inc()
     serve.latency.observe(0.01)
+    cluster.set_states({"ready": 1})
+    cluster.queue_depth.labels(replica="r0").set(0)
+    cluster.dispatch.labels(replica="r0", outcome="ok").inc()
+    cluster.probe_failures.labels(replica="r0").inc()
+    cluster.router_latency.observe(0.001)
     for msg in validate_prometheus(registry.render()):
         findings.append(Finding("RSA502", _SERVE_PATH, 1, msg, "metrics"))
     return findings

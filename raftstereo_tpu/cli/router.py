@@ -1,0 +1,65 @@
+"""Cluster front-end: route /predict over N backend stereo servers.
+
+Start two backends (possibly on different hosts/chips), then the router:
+
+    python -m raftstereo_tpu.cli.serve --port 8080 ... &
+    python -m raftstereo_tpu.cli.serve --port 8090 ... &
+    python -m raftstereo_tpu.cli.router --port 8000 \
+        --backends 127.0.0.1:8080 127.0.0.1:8090
+
+Clients talk to the router exactly like a single server (`serve
+--loadgen`, `serve/client.py`): cold requests spread over the ready
+backends with failover, session frames pin to one backend, and
+``GET /metrics`` exposes the ``cluster_*`` autoscaling families.
+``POST /debug/drain`` with ``{"backend": "b0"}`` drains one backend for
+maintenance/scale-in.  Semantics: docs/serving.md "Cluster".
+
+The router is model-free: it never imports the engine/model stack
+(jax/flax/weights — the serve package exports lazily to keep it that
+way), holds no device state, and starts in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from ..config import add_router_args, router_config_from_args
+from .common import setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_router_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    cfg = router_config_from_args(args)
+
+    from ..serve.cluster import build_router
+
+    router = build_router(cfg)
+    print(json.dumps({
+        "routing": f"http://{cfg.host}:{router.port}",
+        "backends": [f"{h}:{p}" for h, p in cfg.backends],
+        "endpoints": ["/predict", "/metrics", "/healthz", "/debug/trace",
+                      "/debug/threads", "/debug/vars", "/debug/drain"],
+    }), flush=True)
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+    finally:
+        router.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,10 +24,11 @@ import sys
 
 import numpy as np
 
-from ..config import (_parse_bucket, add_model_args, add_sched_args,
-                      add_serve_args, add_stream_args,
-                      model_config_from_args, sched_config_from_args,
-                      serve_config_from_args, stream_config_from_args)
+from ..config import (_parse_bucket, add_cluster_args, add_model_args,
+                      add_sched_args, add_serve_args, add_stream_args,
+                      cluster_config_from_args, model_config_from_args,
+                      sched_config_from_args, serve_config_from_args,
+                      stream_config_from_args)
 from .common import load_variables, setup_logging
 
 logger = logging.getLogger(__name__)
@@ -69,9 +70,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "iteration boundaries (per-request deadline_ms/"
                         "priority on /predict, no head-of-line blocking; "
                         "docs/serving.md)")
+    p.add_argument("--warmup_async", action="store_true",
+                   help="serve /healthz immediately (live) and warm in "
+                        "the background; ready flips true when warmup "
+                        "finishes — what a router-fronted restart wants "
+                        "(docs/serving.md \"Cluster\")")
     add_serve_args(p)
     add_sched_args(p)
     add_stream_args(p)
+    add_cluster_args(p)
     add_model_args(p)
     return p
 
@@ -110,9 +117,11 @@ def main(argv=None) -> int:
     config = model_config_from_args(args)
     stream_cfg = None if args.no_stream else stream_config_from_args(args)
     sched_cfg = sched_config_from_args(args) if args.sched else None
+    cluster_cfg = cluster_config_from_args(args)
     serve_cfg = serve_config_from_args(args, stream=stream_cfg,
                                        stream_warmup=args.stream_warmup,
-                                       sched=sched_cfg)
+                                       sched=sched_cfg,
+                                       cluster=cluster_cfg)
     model = RAFTStereo(config)
     if args.restore_ckpt:
         variables = load_variables(args.restore_ckpt, config, model)
@@ -121,7 +130,8 @@ def main(argv=None) -> int:
         variables = model.init(jax.random.key(0))
         logger.warning("No --restore_ckpt: serving RANDOM weights")
 
-    server = build_server(model, variables, serve_cfg)
+    server = build_server(model, variables, serve_cfg,
+                          warmup_async=args.warmup_async)
     print(json.dumps({"serving": f"http://{serve_cfg.host}:{server.port}",
                       "endpoints": ["/predict", "/metrics", "/healthz",
                                     "/debug/trace", "/debug/profile",

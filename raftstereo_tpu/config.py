@@ -262,6 +262,96 @@ class SchedConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Replicated multi-chip serving (serve/cluster/, docs/serving.md
+    "Cluster").
+
+    When set on a :class:`ServeConfig`, the server runs N independent
+    engine replicas — one per device from ``parallel.mesh`` (or N
+    thread-backed replicas on the CPU host platform under
+    ``--xla_force_host_platform_device_count``) — behind a dispatcher
+    that places cold work on the least-loaded ready replica and pins
+    session/scheduled work to one replica (warm-start state and running
+    batches must stay put).  Frozen + hashable like the other configs."""
+
+    # Engine replicas.  None = one per visible device.
+    replicas: Optional[int] = None
+    # Bound on the session -> replica pin table (LRU beyond it; a
+    # re-routed session degrades to a cold frame, never an error).
+    session_pin_limit: int = 4096
+    # Consecutive engine failures after which a replica is marked
+    # ``failed`` and stops receiving new work (existing futures already
+    # carry their error; the dispatcher never retries state-carrying
+    # work on another replica).
+    fail_threshold: int = 3
+    # Warm replicas concurrently (one thread each; every engine owns its
+    # compile cache and lock, so warmups never contend).
+    warmup_parallel: bool = True
+
+    def __post_init__(self):
+        assert self.replicas is None or self.replicas >= 1, self.replicas
+        assert self.session_pin_limit >= 1, self.session_pin_limit
+        assert self.fail_threshold >= 1, self.fail_threshold
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Front-end HTTP router over N backend stereo servers
+    (serve/cluster/router.py, ``python -m raftstereo_tpu.cli.router``).
+
+    The router owns no model: it probes each backend's ``/healthz``
+    (``live``/``ready``/``draining``), places cold ``/predict`` traffic
+    on the least-outstanding ready backend with bounded
+    retry-with-backoff failover on backend failure (cold inference is
+    idempotent), pins session frames to one backend (warm-start state is
+    backend-local), and exports the ``cluster_*`` autoscaling metric
+    families."""
+
+    host: str = "127.0.0.1"
+    port: int = 8081  # 0 = ephemeral (tests bind a free port)
+    # (host, port) of each backend stereo server.
+    backends: Tuple[Tuple[str, int], ...] = ()
+    # Health probing: poll each backend's /healthz on this cadence; a
+    # backend is unroutable after fail_after consecutive probe failures
+    # (an in-flight connection error marks it unroutable immediately).
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    fail_after: int = 2
+    # Failover for idempotent cold requests: total attempts are
+    # retries + 1, spaced by retry_backoff_ms * 2^attempt with +-50%
+    # jitter.  Session frames never retry a possibly-processed send
+    # (a duplicate would advance the session state) — they re-pin on
+    # connect-time failure only.
+    retries: int = 2
+    retry_backoff_ms: float = 50.0
+    # Per-attempt socket timeout for forwarded requests; sized for one
+    # in-flight batch plus a cold XLA compile behind it.
+    request_timeout_s: float = 660.0
+    # Same body cap as the backends: refuse before buffering.
+    max_body_mb: float = 160.0
+    # Span ring capacity behind the router's /debug/trace.
+    trace_buffer: int = 4096
+    # Bound on the session -> backend pin table (LRU beyond it, same
+    # contract as ClusterConfig.session_pin_limit: an evicted session's
+    # next frame re-pins and runs cold).
+    session_pin_limit: int = 4096
+
+    def __post_init__(self):
+        if isinstance(self.backends, list):
+            object.__setattr__(
+                self, "backends", tuple(tuple(b) for b in self.backends))
+        assert self.probe_interval_s > 0, self.probe_interval_s
+        assert self.probe_timeout_s > 0, self.probe_timeout_s
+        assert self.fail_after >= 1, self.fail_after
+        assert self.retries >= 0, self.retries
+        assert self.retry_backoff_ms >= 0, self.retry_backoff_ms
+        assert self.request_timeout_s > 0, self.request_timeout_s
+        assert self.max_body_mb > 0, self.max_body_mb
+        assert self.trace_buffer >= 1, self.trace_buffer
+        assert self.session_pin_limit >= 1, self.session_pin_limit
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Serving-layer parameters (serve/): dynamic micro-batching, the
     shape-bucketed compile cache, admission control and graceful
@@ -326,6 +416,12 @@ class ServeConfig:
     # high-priority short jobs instead of the batch-size-1 bypass.  None
     # keeps the monolithic dispatch path.
     sched: Optional[SchedConfig] = None
+
+    # Replicated serving (serve/cluster/, docs/serving.md "Cluster"):
+    # when set, the server runs N engine replicas (one per device)
+    # behind a least-outstanding-work dispatcher with session-sticky
+    # routing.  None keeps the single-engine path.
+    cluster: Optional[ClusterConfig] = None
 
     # Observability (obs/, docs/observability.md): capacity of the span
     # ring buffer behind /debug/trace.  Spans are a few hundred bytes; the
@@ -450,6 +546,98 @@ def sched_config_from_args(args: argparse.Namespace) -> SchedConfig:
     )
 
 
+def add_cluster_args(parser: argparse.ArgumentParser) -> None:
+    d = ClusterConfig()
+    g = parser.add_argument_group("cluster")
+    g.add_argument("--replicas", type=int, default=None,
+                   help="engine replicas, one per device (0/unset = "
+                        "single-engine serving; -1 = one per visible "
+                        "device); each replica owns its compile cache "
+                        "and is warmed in-process before it is routable")
+    g.add_argument("--session_pin_limit", type=int,
+                   default=d.session_pin_limit,
+                   help="bound on the session->replica pin table (LRU "
+                        "beyond it; a re-routed session re-runs cold)")
+    g.add_argument("--replica_fail_threshold", type=int,
+                   default=d.fail_threshold,
+                   help="consecutive engine failures after which a "
+                        "replica stops receiving new work")
+
+
+def cluster_config_from_args(args: argparse.Namespace
+                             ) -> Optional[ClusterConfig]:
+    if not args.replicas:
+        return None
+    return ClusterConfig(
+        replicas=None if args.replicas < 0 else args.replicas,
+        session_pin_limit=args.session_pin_limit,
+        fail_threshold=args.replica_fail_threshold,
+    )
+
+
+def _parse_backend(text: str) -> Tuple[str, int]:
+    host, _, port = text.rpartition(":")
+    try:
+        return (host or "127.0.0.1"), int(port)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"backend {text!r} is not HOST:PORT (e.g. 127.0.0.1:8080)")
+
+
+def add_router_args(parser: argparse.ArgumentParser) -> None:
+    d = RouterConfig()
+    g = parser.add_argument_group("router")
+    g.add_argument("--host", default=d.host)
+    g.add_argument("--port", type=int, default=d.port,
+                   help="0 binds an ephemeral port")
+    g.add_argument("--backends", nargs="+", type=_parse_backend,
+                   required=True, metavar="HOST:PORT",
+                   help="backend stereo servers to route over")
+    g.add_argument("--probe_interval_s", type=float,
+                   default=d.probe_interval_s,
+                   help="seconds between /healthz probes per backend")
+    g.add_argument("--probe_timeout_s", type=float,
+                   default=d.probe_timeout_s)
+    g.add_argument("--fail_after", type=int, default=d.fail_after,
+                   help="consecutive probe failures before a backend is "
+                        "unroutable")
+    g.add_argument("--router_retries", type=int, default=d.retries,
+                   help="failover attempts beyond the first for "
+                        "idempotent cold requests on backend failure")
+    g.add_argument("--retry_backoff_ms", type=float,
+                   default=d.retry_backoff_ms,
+                   help="base backoff between failover attempts "
+                        "(doubles per attempt, +-50%% jitter)")
+    g.add_argument("--router_timeout_s", type=float,
+                   default=d.request_timeout_s,
+                   help="per-attempt socket timeout for forwarded "
+                        "requests")
+    g.add_argument("--max_body_mb", type=float, default=d.max_body_mb)
+    g.add_argument("--trace_buffer", type=int, default=d.trace_buffer)
+    g.add_argument("--session_pin_limit", type=int,
+                   default=d.session_pin_limit,
+                   help="bound on the session -> backend pin table (LRU "
+                        "beyond it; an evicted session's next frame "
+                        "re-pins and runs cold)")
+
+
+def router_config_from_args(args: argparse.Namespace) -> RouterConfig:
+    return RouterConfig(
+        host=args.host,
+        port=args.port,
+        backends=tuple(tuple(b) for b in args.backends),
+        probe_interval_s=args.probe_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        fail_after=args.fail_after,
+        retries=args.router_retries,
+        retry_backoff_ms=args.retry_backoff_ms,
+        request_timeout_s=args.router_timeout_s,
+        max_body_mb=args.max_body_mb,
+        trace_buffer=args.trace_buffer,
+        session_pin_limit=args.session_pin_limit,
+    )
+
+
 def add_stream_args(parser: argparse.ArgumentParser) -> None:
     d = StreamConfig()
     g = parser.add_argument_group("stream")
@@ -496,12 +684,14 @@ def stream_config_from_args(args: argparse.Namespace) -> StreamConfig:
 def serve_config_from_args(args: argparse.Namespace,
                            stream: Optional[StreamConfig] = None,
                            stream_warmup: bool = False,
-                           sched: Optional[SchedConfig] = None
+                           sched: Optional[SchedConfig] = None,
+                           cluster: Optional[ClusterConfig] = None
                            ) -> ServeConfig:
     return ServeConfig(
         stream=stream,
         stream_warmup=stream_warmup,
         sched=sched,
+        cluster=cluster,
         host=args.host,
         port=args.port,
         divis_by=args.divis_by,

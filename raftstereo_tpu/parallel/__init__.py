@@ -10,11 +10,12 @@ expressed the TPU way: a ``jax.sharding.Mesh`` plus sharding annotations on
 from .distributed import (global_batch_from_local, initialize,
                           is_multiprocess, process_local_batch)
 from .mesh import (DATA_AXIS, SPACE_AXIS, batch_sharded, make_mesh,
-                   replicated, shard_batch, spatial_sharded)
+                   replica_devices, replicated, shard_batch,
+                   spatial_sharded)
 
 __all__ = [
     "DATA_AXIS", "SPACE_AXIS", "make_mesh", "replicated", "batch_sharded",
-    "spatial_sharded", "shard_batch",
+    "spatial_sharded", "shard_batch", "replica_devices",
     "initialize", "is_multiprocess", "process_local_batch",
     "global_batch_from_local",
 ]

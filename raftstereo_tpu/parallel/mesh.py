@@ -54,6 +54,31 @@ def make_mesh(data: Optional[int] = None, space: int = 1,
     return Mesh(arr, (DATA_AXIS, SPACE_AXIS))
 
 
+def replica_devices(n: Optional[int] = None,
+                    devices: Optional[Sequence] = None) -> list:
+    """Devices for N independent serving-engine replicas — the data axis
+    of an (n, 1) mesh, so replica placement follows the same device
+    order/layout training's data-parallel sharding uses (serve/cluster/
+    instantiates one ``BatchEngine`` per returned device).
+
+    ``n=None`` replicates over every visible device.  On the CPU host
+    platform, ``--xla_force_host_platform_device_count=N`` fans the host
+    out into N virtual devices, so multi-replica serving runs (and is
+    tested) without a pod — same answer as tests/conftest.py.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n is None:
+        n = len(devices)
+    if n < 1:
+        raise ValueError(f"replicas must be >= 1, got {n}")
+    if n > len(devices):
+        raise ValueError(
+            f"{n} replicas need {n} devices, have {len(devices)} "
+            f"(on CPU, raise --xla_force_host_platform_device_count)")
+    mesh = make_mesh(data=n, space=1, devices=devices[:n])
+    return [mesh.devices[i, 0] for i in range(n)]
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (weights, optimizer state, scalars)."""
     return NamedSharding(mesh, P())

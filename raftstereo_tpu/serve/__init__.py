@@ -38,31 +38,56 @@ with per-stream state and the adaptive iteration ladder living in the
 ``raftstereo_tpu.stream`` package (docs/streaming.md).
 """
 
-from .batcher import (  # noqa: F401
-    DynamicBatcher,
-    Future,
-    Overloaded,
-    RequestTimedOut,
-    ServeResult,
-    ShuttingDown,
-)
-from .client import (  # noqa: F401
-    ServeClient,
-    ServeError,
-    run_load,
-    synthetic_pair_pool,
-)
-from .engine import BatchEngine  # noqa: F401
-from .metrics import (  # noqa: F401
-    Counter,
-    Gauge,
-    MetricsRegistry,
-    ServeMetrics,
-)
-from .sched import IterationScheduler, SchedResult  # noqa: F401
-from .server import (  # noqa: F401
-    StereoServer,
-    build_server,
-    decode_array,
-    encode_array,
-)
+import importlib
+
+# Lazy (PEP 562) exports: importing this package must stay cheap so the
+# model-free surfaces (cli.router, serve/cluster/router.py, client-side
+# tooling) never drag in the engine/model stack — ``BatchEngine`` pulls
+# jax + flax + the model, which a proxy or load-gen process has no use
+# for.  ``from raftstereo_tpu.serve import X`` works unchanged; the
+# submodule is imported on first attribute access.
+_EXPORTS = {
+    "DynamicBatcher": ".batcher",
+    "Future": ".batcher",
+    "Overloaded": ".batcher",
+    "RequestTimedOut": ".batcher",
+    "ServeResult": ".batcher",
+    "ShuttingDown": ".batcher",
+    "ServeClient": ".client",
+    "ServeError": ".client",
+    "run_load": ".client",
+    "synthetic_pair_pool": ".client",
+    "ClusterDispatcher": ".cluster",
+    "ReplicaSet": ".cluster",
+    "StereoRouter": ".cluster",
+    "build_router": ".cluster",
+    "BatchEngine": ".engine",
+    "ClusterMetrics": ".metrics",
+    "Counter": ".metrics",
+    "Gauge": ".metrics",
+    "MetricsRegistry": ".metrics",
+    "ServeMetrics": ".metrics",
+    "IterationScheduler": ".sched",
+    "SchedResult": ".sched",
+    "StereoServer": ".server",
+    "build_server": ".server",
+    "decode_array": ".server",
+    "encode_array": ".server",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        rel = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    value = getattr(importlib.import_module(rel, __name__), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

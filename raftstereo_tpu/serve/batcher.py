@@ -60,6 +60,9 @@ class ServeResult:
     degraded: bool
     batch_size: int
     latency_s: float
+    # Which cluster replica answered (serve/cluster/dispatcher.py);
+    # None on the single-engine path.
+    replica: Optional[str] = None
 
 
 class Future:
@@ -69,13 +72,43 @@ class Future:
         self._done = threading.Event()
         self._value: Optional[ServeResult] = None
         self._exc: Optional[BaseException] = None
+        self._cb_lock = threading.Lock()
+        self._callbacks = []  # guarded_by: _cb_lock
 
     def _resolve(self, value=None, exc=None) -> None:
+        """Settle the future and run callbacks ON THIS THREAD.
+
+        Never call while holding a lock a callback may need: the cluster
+        dispatcher's settle callback reads every replica's queue depth
+        (serve/cluster/dispatcher.py), so resolving under one replica's
+        ``_cv`` while another worker does the same is an ABBA deadlock —
+        collect futures under the lock, resolve after releasing it
+        (asserted in tests/test_cluster.py)."""
         self._value, self._exc = value, exc
         self._done.set()
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            fn(self)
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(self)`` when the future resolves (immediately if it
+        already has).  Callbacks run on the resolving thread; a waiter
+        blocked in ``result()`` may wake concurrently, so callers that
+        must annotate the value before anyone reads it chain a second
+        future from the callback (serve/cluster/dispatcher.py does)."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        """The failure, if resolved with one (None while pending)."""
+        return self._exc if self._done.is_set() else None
 
     def result(self, timeout: Optional[float] = None) -> ServeResult:
         if not self._done.wait(timeout):
@@ -137,16 +170,20 @@ class DynamicBatcher:
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the worker.  ``drain=True`` answers everything still queued
         first; ``drain=False`` fails queued requests with ``ShuttingDown``."""
+        to_fail = []
         with self._cv:
             self._closed = True
             if not drain:
                 for q in self._queues.values():
-                    for r in q:
-                        r.future._resolve(exc=ShuttingDown("batcher stopped"))
+                    to_fail.extend(r.future for r in q)
                 self._queues.clear()
                 self._depth = 0
                 self.metrics.queue_depth.set(0)
             self._cv.notify_all()
+        # Outside _cv: resolving runs done-callbacks that may read this
+        # (or another replica's) queue depth — see Future._resolve.
+        for fut in to_fail:
+            fut._resolve(exc=ShuttingDown("batcher stopped"))
         if self._thread is not None:
             self._thread.join(timeout)
 

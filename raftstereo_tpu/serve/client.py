@@ -26,6 +26,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..utils.backoff import backoff_delay
 from ..utils.profiling import LatencyHistogram
 from .server import decode_array, encode_array
 
@@ -57,19 +58,76 @@ class ServeError(RuntimeError):
         self.request_id = request_id
 
 
+class _RetrySafe(Exception):
+    """Marks a connection failure that is provably safe to resend: the
+    request never reached the server (send phase) or is idempotent
+    (GET).  ``__cause__`` carries the underlying error.  The retry loop
+    in ``ServeClient._request`` resends ONLY these — a response-phase
+    POST failure may have executed server-side and propagates raw."""
+
+
 class ServeClient:
     """Blocking client over one keep-alive connection (not thread-safe —
-    load-gen workers each own one)."""
+    load-gen workers each own one).
 
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
+    ``retries`` adds bounded retry-with-backoff (exponential from
+    ``retry_backoff_ms``, +-50% jitter to decorrelate client storms) on
+    (a) send-side connection failures — a refused/reset connect never
+    reached the server, so resending is always safe (a restarting or
+    failing-over backend answers on a later attempt instead of the old
+    immediate hard failure) — and (b) 5xx statuses listed in
+    ``retry_statuses`` (default 502/503: shed and router-unavailable are
+    transient by contract — both come with Retry-After).  Response
+    timeouts are NEVER retried: the server may still be computing and a
+    resend would double the work and the wait.  Default ``retries=0``
+    preserves the historical fail-fast behaviour.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 retries: int = 0, retry_backoff_ms: float = 100.0,
+                 retry_statuses: Tuple[int, ...] = (502, 503)):
         self._conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        assert retries >= 0, retries
+        self.retries = retries
+        self.retry_backoff_ms = retry_backoff_ms
+        self.retry_statuses = tuple(retry_statuses)
 
     def close(self) -> None:
         self._conn.close()
 
+    def _backoff(self, attempt: int) -> None:
+        time.sleep(backoff_delay(self.retry_backoff_ms, attempt))
+
     def _request(self, method: str, path: str,
                  body: Optional[bytes] = None
                  ) -> Tuple[int, bytes, Dict[str, str]]:
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                self._backoff(attempt - 1)
+            try:
+                status, raw, headers = self._request_once(method, path,
+                                                          body)
+            except socket.timeout:
+                raise  # never resend: the server may still be computing
+            except _RetrySafe as e:
+                # Send-phase failure (or idempotent GET): provably safe
+                # to resend — the only exceptions this loop may eat.  A
+                # response-phase POST failure propagates raw below: the
+                # server may have processed it, so resending would run
+                # inference twice (and for a session frame, advance the
+                # warm-start state — see serve/cluster/router.py, which
+                # makes the same send/response distinction).
+                last_exc = e.__cause__
+                continue
+            if status in self.retry_statuses and attempt < self.retries:
+                continue
+            return status, raw, headers
+        raise last_exc
+
+    def _request_once(self, method: str, path: str,
+                      body: Optional[bytes] = None
+                      ) -> Tuple[int, bytes, Dict[str, str]]:
         headers = {"Content-Type": "application/json"} if body else {}
         try:
             self._conn.request(method, path, body=body, headers=headers)
@@ -78,7 +136,18 @@ class ServeClient:
             # closed while idle): the request never reached the server, so
             # one reconnect + resend is safe even for POST.
             self._conn.close()
-            self._conn.request(method, path, body=body, headers=headers)
+            try:
+                self._conn.request(method, path, body=body,
+                                   headers=headers)
+            except socket.timeout:
+                self._conn.close()
+                raise  # timeouts are never resent, even send-phase
+            except (http.client.HTTPException, ConnectionError,
+                    OSError) as e:
+                # Still send-phase (typically connection refused): the
+                # request never left, _request may back off and resend.
+                self._conn.close()
+                raise _RetrySafe() from e
         try:
             resp = self._conn.getresponse()
             return resp.status, resp.read(), dict(resp.headers)
@@ -89,13 +158,23 @@ class ServeClient:
             self._conn.close()
             raise
         except (http.client.HTTPException, ConnectionError, OSError):
-            if method != "GET":
-                self._conn.close()
-                raise  # non-idempotent: the server may have processed it
             self._conn.close()
-            self._conn.request(method, path, body=body, headers=headers)
-            resp = self._conn.getresponse()
-            return resp.status, resp.read(), dict(resp.headers)
+            if method != "GET":
+                raise  # non-idempotent: the server may have processed it
+            # GET is idempotent: one inline resend regardless of the
+            # retry budget (the historical stale-keep-alive recovery).
+            try:
+                self._conn.request(method, path, body=body,
+                                   headers=headers)
+                resp = self._conn.getresponse()
+                return resp.status, resp.read(), dict(resp.headers)
+            except socket.timeout:
+                self._conn.close()
+                raise  # timeouts are never resent (contract above)
+            except (http.client.HTTPException, ConnectionError,
+                    OSError) as e:
+                self._conn.close()
+                raise _RetrySafe() from e
 
     def predict(self, left: np.ndarray, right: np.ndarray,
                 iters: Optional[int] = None,
@@ -137,6 +216,10 @@ class ServeClient:
         # The server already puts request_id in meta; the header is
         # authoritative (and present on error replies too).
         meta.setdefault("request_id", headers.get("X-Request-Id"))
+        if "X-Backend" in headers:
+            # Talking through the cluster router: which backend answered
+            # (docs/serving.md "Cluster").
+            meta.setdefault("backend", headers["X-Backend"])
         return decode_array(data["disparity"]), meta
 
     def _get_json(self, path: str) -> Dict:
@@ -195,13 +278,18 @@ def run_load(host: str, port: int,
              mode: str = "closed", rate: Optional[float] = None,
              iters: Optional[int] = None,
              sequence_len: Optional[int] = None,
-             timeout: float = 120.0) -> Dict:
+             timeout: float = 120.0, retries: int = 0) -> Dict:
     """Drive ``requests`` pairs at the server; returns a stats dict.
 
     ``make_pair(i)`` supplies the i-th request's images (mix shapes to
     exercise several compile buckets).  ``mode='open'`` requires ``rate``
     (requests/sec): send times are fixed at ``i / rate`` from start,
     regardless of completions.
+
+    ``retries`` enables the client's bounded retry-with-backoff (see
+    ``ServeClient``) — load-gen against a router or a restarting server
+    rides out refused connections and transient 502/503 instead of
+    counting them as hard errors.
 
     ``sequence_len`` switches to SEQUENCE REPLAY (streaming traffic):
     request ``i`` is frame ``i % sequence_len`` of session
@@ -242,7 +330,7 @@ def run_load(host: str, port: int,
             return i
 
     def worker():
-        client = ServeClient(host, port, timeout=timeout)
+        client = ServeClient(host, port, timeout=timeout, retries=retries)
         try:
             while True:
                 start = claim()

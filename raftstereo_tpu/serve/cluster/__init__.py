@@ -1,0 +1,53 @@
+"""Replicated multi-chip serving (docs/serving.md "Cluster").
+
+Two composition levels over the single-engine serving stack:
+
+* **in one process** — :class:`ReplicaSet` instantiates N independent
+  ``BatchEngine`` stacks (one per device from
+  ``parallel.mesh.replica_devices``; virtual CPU devices in tier-1) and
+  :class:`ClusterDispatcher` is the single admission surface over them:
+  least-outstanding-work placement for cold requests, session-sticky
+  routing for stream/scheduled work.  Enabled by
+  ``ServeConfig.cluster`` (``cli.serve --replicas N``);
+* **across processes/hosts** — :class:`StereoRouter`
+  (``python -m raftstereo_tpu.cli.router``) fronts N backend
+  ``StereoServer``s with /healthz-driven readiness gating, bounded
+  retry-with-backoff failover of idempotent cold requests, session
+  pinning, and explicit per-backend drain.
+
+Both levels export the same ``cluster_*`` autoscaling metric families
+(serve/metrics.ClusterMetrics) and record their hops in the shared
+trace pipeline (obs/).
+"""
+
+import importlib
+
+# Lazy (PEP 562) exports, same rationale as serve/__init__: the router
+# members are model-free (stdlib + metrics/obs only) while replica/
+# dispatcher pull the full engine stack — a ``cli.router`` process must
+# be able to reach ``build_router`` without importing jax/flax/models.
+_EXPORTS = {
+    "Backend": ".router",
+    "StereoRouter": ".router",
+    "build_router": ".router",
+    "ClusterDispatcher": ".dispatcher",
+    "Replica": ".replica",
+    "ReplicaSet": ".replica",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        rel = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    value = getattr(importlib.import_module(rel, __name__), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,295 @@
+"""Cluster dispatcher: one admission surface over N engine replicas.
+
+The dispatcher is the in-process seam between the HTTP layer and a
+:class:`~raftstereo_tpu.serve.cluster.replica.ReplicaSet`: it quacks
+like the component it replaces (``DynamicBatcher.submit`` /
+``IterationScheduler.submit`` for plain requests, ``StreamRunner.step``
+for session frames), so ``StereoServer`` routes through it unchanged.
+
+Placement policy:
+
+* **cold requests** go to the READY replica with the least outstanding
+  work (queued + in flight).  A replica that sheds (``Overloaded``)
+  spills to the next-least-loaded one — the cluster is only overloaded
+  when every ready replica is;
+* **session frames are sticky**: RAFT's warm-start state (the previous
+  frame's low-res disparity) lives in the pinned replica's session
+  store, so moving a session means losing its state.  A frame re-pins
+  only when its replica is gone (failed/draining) — the new replica
+  serves it as a cold frame, never an error (the PR 3 contract), and
+  ``cluster_session_repins_total`` counts it;
+* **scheduled jobs stay put**: a request that joined a replica's running
+  batch completes there; the dispatcher never migrates device-resident
+  carried state.
+
+Results are annotated with ``replica=<name>`` (via a chained future, so
+the name is set before any ``result()`` waiter can observe the value) —
+the session-stickiness and placement tests read it off the wire.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ...config import ServeConfig
+from ..batcher import Future, Overloaded, RequestTimedOut, ShuttingDown
+from ..metrics import ClusterMetrics, ServeMetrics
+from .pins import PinTable
+from .replica import Replica, ReplicaSet
+
+__all__ = ["ClusterDispatcher"]
+
+
+def _outcome_of(exc: Optional[BaseException]) -> str:
+    if exc is None:
+        return "ok"
+    if isinstance(exc, Overloaded):
+        return "shed"
+    if isinstance(exc, RequestTimedOut):
+        return "timeout"
+    if isinstance(exc, ShuttingDown):
+        return "unavailable"
+    return "error"
+
+
+class _StoreView:
+    """``len()``-able view over every replica's session store (what the
+    /healthz stream block reports for the whole cluster)."""
+
+    def __init__(self, replicas):
+        self._replicas = replicas
+
+    def __len__(self) -> int:
+        return sum(len(r.stream.store) for r in self._replicas
+                   if r.stream is not None)
+
+
+class ClusterDispatcher:
+    """Thread-safe placement layer over a ReplicaSet."""
+
+    def __init__(self, replicaset: ReplicaSet, config: ServeConfig,
+                 metrics: Optional[ServeMetrics] = None, tracer=None):
+        self.rset = replicaset
+        self.cfg = config
+        self.metrics = metrics or replicaset.metrics
+        # Autoscaling families live on the SAME registry as the serve
+        # bundle: one /metrics scrape covers both.
+        self.cluster_metrics = ClusterMetrics(self.metrics.registry)
+        self.tracer = tracer
+        self._lock = threading.Lock()
+        # session_id -> replica rid (LRU-bounded; an evicted pin behaves
+        # exactly like a lost session: next frame re-pins and runs cold).
+        self._pins = PinTable(self.rset.cluster_cfg.session_pin_limit)
+        self._closed = False  # guarded_by: _lock
+
+    # ----------------------------------------------------------- placement
+
+    def _candidates(self):
+        """Ready replicas, least outstanding work first."""
+        return sorted(self.rset.ready_replicas(),
+                      key=lambda r: (r.outstanding(), r.rid))
+
+    def _record(self, replica_name: str, outcome: str) -> None:
+        self.cluster_metrics.dispatch.labels(
+            replica=replica_name, outcome=outcome).inc()
+
+    def _track(self, replica: Replica, inner: Future,
+               trace_id: Optional[str]) -> Future:
+        """Chain an outer future that (1) annotates the result with the
+        answering replica, (2) settles the replica's inflight/error
+        accounting, (3) labels the dispatch outcome — all before the
+        outer future resolves, so readers never see a half-annotated
+        result."""
+        replica.begin_dispatch()
+        outer = Future()
+
+        def settle(f: Future) -> None:
+            exc = f._exc
+            outcome = _outcome_of(exc)
+            # Engine failures count toward fail_threshold; backpressure
+            # (shed/timeout/shutdown) does not — an overloaded replica
+            # is healthy.
+            replica.end_dispatch(ok=outcome != "error")
+            self._record(replica.name, outcome)
+            value = f._value
+            if value is not None:
+                value.replica = replica.name
+            self._refresh_gauges()
+            outer._resolve(value=value, exc=exc)
+
+        inner.add_done_callback(settle)
+        return outer
+
+    def _refresh_gauges(self) -> None:
+        cm = self.cluster_metrics
+        cm.set_states(self.rset.states())
+        ready = []
+        for r in self.rset.replicas:
+            out = r.outstanding()
+            cm.queue_depth.labels(replica=r.name).set(out)
+            if r.routable():
+                ready.append(out)
+        cap = max(1, self.cfg.max_batch_size)
+        cm.utilization.set(
+            round(sum(min(1.0, o / cap) for o in ready) / len(ready), 4)
+            if ready else 0.0)
+        # Re-export the scalar serve/sched gauges as cluster-wide
+        # aggregates of the per-replica private instruments — N replica
+        # workers writing one shared sample would be last-writer-wins
+        # noise (see replica._ReplicaMetricsView).
+        reps = self.rset.replicas
+        sm = self.metrics
+        sm.queue_depth.set(sum(r.metrics.queue_depth.value for r in reps))
+        if self.cfg.sched is not None:
+            sm.sched_slots_active.set(
+                sum(r.metrics.sched_slots_active.value for r in reps))
+            sm.sched_occupancy.set(round(
+                sum(r.metrics.sched_occupancy.value for r in reps)
+                / len(reps), 4))
+            by_prio: Dict[str, float] = {}
+            for r in reps:
+                for labels, child in r.metrics.sched_queue_depth.series():
+                    by_prio[labels[0]] = by_prio.get(labels[0], 0.0) \
+                        + child.value
+            for prio, depth in by_prio.items():
+                sm.sched_queue_depth.labels(priority=prio).set(depth)
+
+    # ------------------------------------------------------------ admission
+
+    @property
+    def queue_depth(self) -> int:
+        """Cluster-wide outstanding work (the /healthz queue signal)."""
+        return sum(r.outstanding() for r in self.rset.replicas)
+
+    @property
+    def store(self) -> _StoreView:
+        return _StoreView(self.rset.replicas)
+
+    def stats(self) -> Dict[str, object]:
+        info = self.rset.stats()
+        info["session_pins"] = len(self._pins)
+        info["queue_depth"] = self.queue_depth
+        if self.cfg.sched is not None:
+            # The scheduler-mode healthz block: aggregate the per-replica
+            # scheduler snapshots under the usual keys.
+            scheds = [r.scheduler.stats() for r in self.rset.replicas]
+            info["iters_per_step"] = self.cfg.sched.iters_per_step
+            info["active_slots"] = sum(s["active_slots"] for s in scheds)
+            by_prio: Dict[str, int] = {}
+            for s in scheds:
+                for p, n in s["queue_depth_by_priority"].items():
+                    by_prio[p] = by_prio.get(p, 0) + n
+            info["queue_depth_by_priority"] = by_prio
+        return info
+
+    def submit(self, image1: np.ndarray, image2: np.ndarray,
+               iters: Optional[int] = None, *,
+               priority: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
+        """Place one cold request on the least-loaded ready replica;
+        spills to the next one when a replica sheds.  Signature covers
+        both backend modes — ``priority``/``deadline_ms`` are only legal
+        under ``--sched`` (the HTTP layer already enforces that)."""
+        with self._lock:
+            if self._closed:
+                raise ShuttingDown("cluster dispatcher stopped")
+        t0 = time.perf_counter()
+        last_exc: Optional[Exception] = None
+        candidates = self._candidates()
+        if not candidates:
+            self._refresh_gauges()
+            raise ShuttingDown("no ready replica")
+        for replica in candidates:
+            try:
+                if replica.scheduler is not None:
+                    inner = replica.scheduler.submit(
+                        image1, image2, iters=iters, priority=priority,
+                        deadline_ms=deadline_ms, trace_id=trace_id)
+                else:
+                    inner = replica.batcher.submit(
+                        image1, image2, iters, trace_id=trace_id)
+            except Overloaded as e:
+                self._record(replica.name, "shed")
+                last_exc = e
+                continue
+            except ShuttingDown as e:
+                last_exc = e
+                continue
+            if self.tracer is not None and trace_id is not None:
+                self.tracer.record(
+                    "cluster_dispatch", t0, time.perf_counter(), trace_id,
+                    attrs={"replica": replica.name,
+                           "outstanding": replica.outstanding()})
+            return self._track(replica, inner, trace_id)
+        self._refresh_gauges()
+        raise last_exc if last_exc is not None else Overloaded(
+            "every ready replica is overloaded")
+
+    # -------------------------------------------------------------- streams
+
+    def _pin(self, session_id: str) -> Replica:
+        """Sticky replica for a session, (re)pinning as needed (one
+        atomic decision inside the shared PinTable)."""
+        with self._lock:
+            if self._closed:
+                raise ShuttingDown("cluster dispatcher stopped")
+        rid, repinned = self._pins.pin(
+            session_id,
+            still_ok=lambda r: self.rset.replicas[r].routable(),
+            choose=lambda: (lambda c: c[0].rid if c else None)(
+                self._candidates()))
+        if rid is None:
+            raise ShuttingDown(
+                f"no ready replica for session {session_id!r}")
+        if repinned:
+            self.cluster_metrics.session_repins.inc()
+        return self.rset.replicas[rid]
+
+    def step(self, session_id: str, seq_no: Optional[int],
+             left: np.ndarray, right: np.ndarray,
+             trace_id: Optional[str] = None):
+        """One session frame through its pinned replica (StreamRunner
+        contract).  Raises the batcher exception types on backpressure,
+        which the HTTP layer already maps to 503/504."""
+        replica = self._pin(session_id)
+        t0 = time.perf_counter()
+        if self.tracer is not None and trace_id is not None:
+            self.tracer.record("cluster_dispatch", t0, t0, trace_id,
+                               attrs={"replica": replica.name,
+                                      "session_id": session_id,
+                                      "sticky": True})
+        replica.begin_dispatch()
+        try:
+            res = replica.stream.step(session_id, seq_no, left, right,
+                                      trace_id=trace_id)
+        except (Overloaded, RequestTimedOut, ShuttingDown) as e:
+            replica.end_dispatch(ok=True)  # backpressure, not a failure
+            self._record(replica.name, _outcome_of(e))
+            raise
+        except Exception:
+            replica.end_dispatch(ok=False)
+            self._record(replica.name, "error")
+            raise
+        replica.end_dispatch(ok=True)
+        self._record(replica.name, "ok")
+        res.replica = replica.name
+        self._refresh_gauges()
+        return res
+
+    # ------------------------------------------------------------ lifecycle
+
+    def drain(self) -> None:
+        """Stop admitting on every replica; admitted work finishes."""
+        for r in self.rset.replicas:
+            r.drain()
+        self._refresh_gauges()
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        with self._lock:
+            self._closed = True
+        self.rset.stop(drain=drain)

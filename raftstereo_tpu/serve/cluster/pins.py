@@ -1,0 +1,61 @@
+"""Session -> target pin table, shared by the in-process dispatcher and
+the HTTP router (stdlib-only: the router never imports the engine/model
+stack).
+
+One implementation for one policy: sessions are sticky because RAFT's
+warm-start state lives next to one engine's compile cache, so both
+placement layers need the same LRU-bounded get-or-assign — an evicted or
+re-pinned session's next frame runs cold, never errors.  The whole
+decision (read pin, validate it, choose a replacement, write, evict)
+happens under ONE lock acquisition: two concurrent first frames of a
+session must agree on the pin, not race to different targets.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Optional, Tuple
+
+__all__ = ["PinTable"]
+
+
+class PinTable:
+    """LRU-bounded ``session_id -> target id`` map with atomic
+    get-or-assign."""
+
+    def __init__(self, limit: int):
+        assert limit >= 1, limit
+        self.limit = limit
+        self._lock = threading.Lock()
+        self._pins = collections.OrderedDict()  # guarded_by: _lock
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pins)
+
+    def pin(self, session_id: str,
+            still_ok: Callable[[int], bool],
+            choose: Callable[[], Optional[int]]
+            ) -> Tuple[Optional[int], bool]:
+        """Sticky target for ``session_id``: the existing pin if
+        ``still_ok(target)``, else ``choose()`` (called under the table
+        lock — keep it cheap and never have it take this table's lock).
+
+        Returns ``(target, repinned)``; ``(None, False)`` when the pin
+        is stale/absent and ``choose()`` found no target (the pin is
+        left untouched).  ``repinned`` is True only when a LIVE pin was
+        replaced — the caller counts it (the frame will run cold)."""
+        with self._lock:
+            old = self._pins.get(session_id)
+            if old is not None and still_ok(old):
+                self._pins.move_to_end(session_id)
+                return old, False
+            new = choose()
+            if new is None:
+                return None, False
+            self._pins[session_id] = new
+            self._pins.move_to_end(session_id)
+            while len(self._pins) > self.limit:
+                self._pins.popitem(last=False)
+            return new, old is not None

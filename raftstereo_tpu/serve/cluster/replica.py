@@ -1,0 +1,312 @@
+"""Engine replicas: N independent serving stacks, one per device.
+
+A :class:`Replica` is the full single-engine dispatch stack of PR 1/7 —
+``BatchEngine`` plus exactly one of ``DynamicBatcher`` (monolithic) or
+``IterationScheduler`` (``--sched``), plus a ``StreamRunner`` when
+streaming is enabled — pinned to one device.  A :class:`ReplicaSet`
+instantiates one per device from ``parallel.mesh.replica_devices``, so
+replica layout follows the same device order training's data-parallel
+axis uses; on the CPU host platform the devices are the virtual ones
+``--xla_force_host_platform_device_count`` fans out, which is how the
+tier-1 tests run a real multi-replica cluster without a pod.
+
+Key properties:
+
+* every replica owns its OWN jit wrappers and compile cache — replicas
+  warm independently (in parallel) and never serialize on one another's
+  dispatch lock;
+* warmup is in-process ladder warmup only: each replica compiles its
+  configured buckets before it is marked ``ready`` (the persistent JAX
+  compile cache is broken on this container — see CHANGES.md PR 2 — so
+  replicas never share serialized executables);
+* per-replica results are bitwise-identical to the single-engine path:
+  the executables are the same programs at the same shapes, just placed
+  on different devices (asserted in tests/test_cluster.py).
+
+Replica states: ``starting`` (warming, unroutable) -> ``ready`` ->
+``draining`` (finishing admitted work) -> ``drained``; ``failed`` after
+``fail_threshold`` consecutive engine errors (stops receiving new work;
+the dispatcher reports it in ``cluster_replicas{state="failed"}``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from ...config import ClusterConfig, ServeConfig
+from ..batcher import DynamicBatcher
+from ..engine import BatchEngine
+from ..metrics import Gauge, LabelFamily, ServeMetrics
+from ..sched import IterationScheduler
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Replica", "ReplicaSet"]
+
+_STATES = ("starting", "ready", "draining", "drained", "failed")
+
+
+class _ReplicaMetricsView:
+    """Per-replica facade over the shared ``ServeMetrics``.
+
+    Counters and histograms pass through — they are additive, so N
+    replica workers incrementing one shared family is exactly the
+    cluster-wide total.  The scalar ``.set()`` gauges are NOT additive:
+    each replica's batcher/scheduler writes its own absolute value, so
+    sharing one sample is last-writer-wins noise (replica r1 setting
+    ``serve_queue_depth 0`` right after r0 set 10).  Those gauges are
+    replaced with private unregistered instruments here, and the
+    dispatcher re-exports cluster-wide aggregates onto the shared
+    (rendered) ones in ``_refresh_gauges``."""
+
+    def __init__(self, shared: ServeMetrics):
+        self._shared = shared
+        self.queue_depth = Gauge()
+        self.sched_slots_active = Gauge()
+        self.sched_occupancy = Gauge()
+        self.sched_queue_depth = LabelFamily(Gauge, ("priority",))
+
+    def __getattr__(self, name):
+        return getattr(self._shared, name)
+
+
+class Replica:
+    """One device's serving stack plus its routing state."""
+
+    def __init__(self, rid: int, device, model, variables,
+                 config: ServeConfig, metrics: ServeMetrics,
+                 tracer=None, fail_threshold: int = 3):
+        self.rid = rid
+        self.name = f"r{rid}"
+        self.device = device
+        self.cfg = config
+        self._fail_threshold = fail_threshold
+        # Scalar gauges are private per replica (see _ReplicaMetricsView);
+        # the dispatcher aggregates them back onto the shared registry.
+        self.metrics = _ReplicaMetricsView(metrics)
+        self.engine = BatchEngine(model, variables, config, self.metrics,
+                                  device=device)
+        self.scheduler: Optional[IterationScheduler] = None
+        self.batcher: Optional[DynamicBatcher] = None
+        if config.sched is not None:
+            self.scheduler = IterationScheduler(
+                self.engine, config, self.metrics, tracer=tracer).start()
+        else:
+            self.batcher = DynamicBatcher(
+                self.engine, config, self.metrics, tracer=tracer).start()
+        self.stream = None
+        if config.stream is not None:
+            from ...stream.runner import StreamRunner  # local: avoids an
+            # import cycle (stream.runner's engine builder imports serve)
+            self.stream = StreamRunner(self.engine, config.stream,
+                                       self.metrics, tracer=tracer,
+                                       scheduler=self.scheduler)
+        self._lock = threading.Lock()
+        self._state = "starting"  # guarded_by: _lock
+        self._inflight = 0  # guarded_by: _lock
+        self._consecutive_errors = 0  # guarded_by: _lock
+
+    # -------------------------------------------------------------- state
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._effective_state()
+
+    def _effective_state(self) -> str:  # guarded_by: _lock
+        """``draining`` resolves to ``drained`` once the last admitted
+        request has been answered (queue empty + nothing in flight)."""
+        if self._state == "draining" and self._inflight == 0 \
+                and self._backend_depth() == 0:
+            return "drained"
+        return self._state
+
+    def _backend_depth(self) -> int:
+        if self.scheduler is not None:
+            return self.scheduler.queue_depth + self.scheduler.active_slots()
+        return self.batcher.queue_depth
+
+    def outstanding(self) -> int:
+        """Work placed on this replica and not yet answered — the
+        least-outstanding-work placement signal."""
+        with self._lock:
+            inflight = self._inflight
+        return inflight + self._backend_depth()
+
+    def routable(self) -> bool:
+        with self._lock:
+            return self._state == "ready"
+
+    def mark_ready(self) -> None:
+        with self._lock:
+            if self._state == "starting":
+                self._state = "ready"
+
+    def mark_failed(self, why: str) -> None:
+        with self._lock:
+            if self._state != "failed":
+                logger.error("replica %s marked failed: %s", self.name, why)
+                self._state = "failed"
+
+    def drain(self) -> None:
+        """Stop admitting; already-admitted work keeps running to
+        completion (the batcher/scheduler worker is not stopped)."""
+        with self._lock:
+            if self._state in ("starting", "ready"):
+                self._state = "draining"
+
+    # ----------------------------------------------------------- dispatch
+
+    def begin_dispatch(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def end_dispatch(self, ok: bool) -> None:
+        """Settle one dispatch.  ``ok`` means the engine worked —
+        answered, shed, or timed out; only engine FAILURES count toward
+        ``fail_threshold`` (an overloaded replica is healthy)."""
+        with self._lock:
+            self._inflight -= 1
+            if ok:
+                self._consecutive_errors = 0
+            else:
+                self._consecutive_errors += 1
+                if self._consecutive_errors >= self._fail_threshold \
+                        and self._state != "failed":
+                    logger.error(
+                        "replica %s: %d consecutive engine errors, "
+                        "marking failed", self.name,
+                        self._consecutive_errors)
+                    self._state = "failed"
+
+    # ---------------------------------------------------------- lifecycle
+
+    def warmup(self) -> None:
+        """In-process ladder warmup, mirroring ``build_server``: compile
+        every configured bucket (and sched phases / stream ladder levels)
+        on THIS replica's device, then become routable."""
+        try:
+            if self.cfg.sched is not None:
+                if self.cfg.warmup:
+                    self.engine.warmup_sched(
+                        iters_per_step=self.cfg.sched.iters_per_step)
+            else:
+                if self.cfg.warmup:
+                    self.engine.warmup()
+                if self.cfg.stream is not None and self.cfg.stream_warmup:
+                    self.engine.warmup_stream(ladder=self.cfg.stream.ladder)
+        except Exception as e:
+            self.mark_failed(f"warmup failed: {e}")
+            raise
+        self.mark_ready()
+
+    def stop(self, drain: bool = True) -> None:
+        if self.batcher is not None:
+            self.batcher.stop(drain=drain)
+        if self.scheduler is not None:
+            self.scheduler.stop(drain=drain)
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            state = self._effective_state()
+            inflight = self._inflight
+        info: Dict[str, object] = {
+            "state": state,
+            "device": str(self.device),
+            "inflight": inflight,
+            "queue_depth": self._backend_depth(),
+            "compiled": self.engine.cache_stats["compiled"],
+        }
+        if self.stream is not None:
+            info["sessions"] = len(self.stream.store)
+        return info
+
+
+class ReplicaSet:
+    """N replicas over the mesh's replica devices, warmed concurrently.
+
+    The set itself is mostly bookkeeping: replicas are independent by
+    construction, and all routing policy lives in the dispatcher."""
+
+    def __init__(self, model, variables, config: ServeConfig,
+                 metrics: Optional[ServeMetrics] = None, tracer=None,
+                 devices=None):
+        from ...parallel.mesh import replica_devices
+
+        self.cfg = config
+        self.cluster_cfg: ClusterConfig = config.cluster or ClusterConfig()
+        self.metrics = metrics or ServeMetrics()
+        if devices is None:
+            devices = replica_devices(self.cluster_cfg.replicas)
+        self.replicas: List[Replica] = [
+            Replica(i, dev, model, variables, config, self.metrics,
+                    tracer=tracer,
+                    fail_threshold=self.cluster_cfg.fail_threshold)
+            for i, dev in enumerate(devices)]
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def engine(self) -> BatchEngine:
+        """Shape/warmth policy view — what the HTTP layer's admission
+        checks use.  Bucketing is pure config (identical across
+        replicas) but warmth is per-replica compile state, so prefer a
+        READY replica's engine: if replica 0's warmup failed while
+        others warmed (the set tolerates that), its cold cache must not
+        make admission reject traffic the ready replicas can serve."""
+        ready = self.ready_replicas()
+        return (ready[0] if ready else self.replicas[0]).engine
+
+    def ready_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas if r.routable()]
+
+    def states(self) -> Dict[str, int]:
+        counts = {s: 0 for s in _STATES}
+        for r in self.replicas:
+            counts[r.state] += 1
+        return counts
+
+    def warmup(self) -> None:
+        """Warm every replica; parallel by default (each engine owns its
+        own lock and compile cache, so the warmups are independent).  A
+        replica whose warmup fails is marked ``failed`` and skipped —
+        the set is usable as long as one replica became ready."""
+        if not self.cluster_cfg.warmup_parallel:
+            for r in self.replicas:
+                try:
+                    r.warmup()
+                except Exception:
+                    logger.exception("replica %s warmup failed", r.name)
+            self._require_ready()
+            return
+        threads = [threading.Thread(target=self._warm_one, args=(r,),
+                                    name=f"warmup-{r.name}", daemon=True)
+                   for r in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self._require_ready()
+
+    def _warm_one(self, replica: Replica) -> None:
+        try:
+            replica.warmup()
+        except Exception:  # already marked failed; keep the others going
+            logger.exception("replica %s warmup failed", replica.name)
+
+    def _require_ready(self) -> None:
+        if not self.ready_replicas():
+            raise RuntimeError(
+                "no replica finished warmup; cluster cannot serve "
+                f"(states: {self.states()})")
+
+    def stop(self, drain: bool = True) -> None:
+        for r in self.replicas:
+            r.stop(drain=drain)
+
+    def stats(self) -> Dict[str, object]:
+        return {"replicas": {r.name: r.stats() for r in self.replicas},
+                "states": self.states()}

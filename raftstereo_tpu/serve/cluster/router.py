@@ -1,0 +1,548 @@
+"""Front-end HTTP router over N backend stereo servers.
+
+The router is model-free and holds no device state: it proxies
+``/predict`` bodies byte-for-byte to one of N ``StereoServer`` backends
+(possibly on other hosts), choosing the backend the way the in-process
+dispatcher chooses a replica:
+
+* **readiness gating** — a background prober polls every backend's
+  ``/healthz`` (``live``/``ready``/``draining``); only ``ready``
+  backends are routable, so a restarting backend is never routed to
+  while it pays its warmup compiles;
+* **least outstanding work** — cold requests go to the ready backend
+  with the fewest (router-side in-flight + last-probed queue) requests;
+* **session stickiness** — frames of one session pin to one backend
+  (warm-start state is backend-local); a lost backend re-pins the
+  session and the new backend serves a cold frame;
+* **bounded failover** — cold inference is idempotent (a pure function
+  of the images), so a backend failure mid-request retries on another
+  backend with exponential backoff + jitter, up to ``retries`` extra
+  attempts.  Session frames are NOT idempotent (a duplicate would
+  advance the session), so they only retry connect-phase failures
+  (request provably never reached a backend) and otherwise fail with a
+  clean 503 — never a hang: every socket the router opens has a
+  timeout.
+
+``POST /debug/drain`` with ``{"backend": "b0"}`` takes a backend out of
+rotation and forwards the drain: the backend stops admitting, finishes
+running batches, and reports ``drained`` on its /healthz, which the
+router's prober (and ``GET /healthz`` here) surfaces.  The
+``cluster_*`` metric families on ``GET /metrics`` are the autoscaling
+signals (docs/serving.md "Cluster").
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import socket
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ...config import RouterConfig
+from ...obs import Tracer, build_info, dump_threads, trace_response
+from ...utils.backoff import backoff_delay
+from ..httpbase import JsonRequestHandler
+from ..metrics import ClusterMetrics, MetricsRegistry
+from .pins import PinTable
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Backend", "StereoRouter", "build_router"]
+
+
+class Backend:
+    """One backend server plus the router's view of its health."""
+
+    def __init__(self, bid: int, host: str, port: int):
+        self.bid = bid
+        self.name = f"b{bid}"
+        self.host = host
+        self.port = port
+        self._lock = threading.Lock()
+        self.live = False  # guarded_by: _lock
+        self.ready = False  # guarded_by: _lock
+        self.draining = False  # guarded_by: _lock
+        self.drained = False  # guarded_by: _lock
+        self._queue_depth = 0  # guarded_by: _lock
+        self._probe_failures = 0  # guarded_by: _lock
+        self.inflight = 0  # guarded_by: _lock
+
+    def routable(self) -> bool:
+        with self._lock:
+            return self.live and self.ready and not self.draining
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return self.inflight + self._queue_depth
+
+    def begin(self) -> None:
+        with self._lock:
+            self.inflight += 1
+
+    def end(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+
+    def mark_unreachable(self) -> None:
+        """Called on an in-flight connection failure: stop routing here
+        immediately instead of waiting out the probe interval."""
+        with self._lock:
+            self.live = False
+            self.ready = False
+
+    def mark_draining(self) -> None:
+        with self._lock:
+            self.draining = True
+
+    def on_probe(self, health: Optional[Dict], fail_after: int) -> None:
+        """Fold one probe result (None = probe failed) into the state."""
+        with self._lock:
+            if health is None:
+                self._probe_failures += 1
+                if self._probe_failures >= fail_after:
+                    self.live = False
+                    self.ready = False
+                return
+            self._probe_failures = 0
+            self.live = bool(health.get("live", True))
+            self.ready = bool(health.get("ready", True))
+            # Trust the backend's own draining report when it makes one:
+            # a drained backend RESTARTED at the same address reports
+            # draining=false and must rejoin rotation (scale-in undo).
+            # Only a backend that predates the flag keeps the router's
+            # local mark_draining decision sticky.
+            if "draining" in health:
+                self.draining = bool(health["draining"])
+            self.drained = bool(health.get("drained", False))
+            self._queue_depth = int(health.get("queue_depth", 0) or 0)
+
+    def state(self) -> str:
+        with self._lock:
+            if not self.live:
+                return "unreachable"
+            if self.draining:
+                return "drained" if self.drained else "draining"
+            return "ready" if self.ready else "starting"
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "host": self.host, "port": self.port,
+                "live": self.live, "ready": self.ready,
+                "draining": self.draining, "drained": self.drained,
+                "queue_depth": self._queue_depth,
+                "inflight": self.inflight,
+                "probe_failures": self._probe_failures,
+            }
+
+
+def _http_json(host: str, port: int, method: str, path: str,
+               timeout: float, body: Optional[bytes] = None,
+               headers: Optional[Dict[str, str]] = None
+               ) -> Tuple[int, Dict]:
+    """One short JSON request to a backend (probes, drain forwarding)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, (json.loads(raw) if raw else {})
+    finally:
+        conn.close()
+
+
+class _Prober(threading.Thread):
+    """Polls every backend's /healthz on a fixed cadence and refreshes
+    the cluster gauges — the router's only source of backend readiness
+    besides in-flight connection failures."""
+
+    def __init__(self, router: "StereoRouter"):
+        super().__init__(name="router-prober", daemon=True)
+        self.router = router
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def probe_once(self) -> None:
+        cfg = self.router.config
+        for b in self.router.backends:
+            try:
+                status, health = _http_json(
+                    b.host, b.port, "GET", "/healthz",
+                    timeout=cfg.probe_timeout_s)
+                b.on_probe(health if status == 200 else None,
+                           cfg.fail_after)
+                if status != 200:
+                    self.router.cluster_metrics.probe_failures.labels(
+                        replica=b.name).inc()
+            except (OSError, ValueError):
+                # ValueError covers JSONDecodeError: a backend answering
+                # non-JSON on /healthz (wrong port, an intermediary's
+                # HTML error page) is a FAILED probe for that backend —
+                # never an exception that aborts the round (or, at
+                # startup, the router) and leaves the other backends'
+                # health stale.
+                b.on_probe(None, cfg.fail_after)
+                self.router.cluster_metrics.probe_failures.labels(
+                    replica=b.name).inc()
+        self.router.refresh_gauges()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("health probe round failed")
+            self._stop.wait(self.router.config.probe_interval_s)
+
+
+class _RouterHandler(JsonRequestHandler):
+    server_version = "raftstereo-router/1.0"
+    _log = logger
+    # _send/_json/_read_body come from JsonRequestHandler — shared with
+    # the backend server's handler so the two dialects cannot drift.
+
+    # ------------------------------------------------------------- GET side
+
+    def do_GET(self):
+        rt: "StereoRouter" = self.server
+        url = urlparse(self.path)
+        if url.path == "/healthz":
+            self._json(200, rt.health())
+        elif url.path == "/metrics":
+            rt.refresh_gauges()
+            self._send(200, rt.cluster_metrics.render().encode(),
+                       "text/plain; version=0.0.4")
+        elif url.path == "/debug/trace":
+            try:
+                body, extra = trace_response(rt.tracer, url.query)
+            except ValueError as e:
+                self._json(400, {"error": f"bad query: {e}"})
+                return
+            self._send(200, body, "application/json", extra)
+        elif url.path == "/debug/threads":
+            self._send(200, dump_threads().encode(), "text/plain")
+        elif url.path == "/debug/vars":
+            self._json(200, {
+                "backends": {b.name: b.snapshot() for b in rt.backends},
+                "session_pins": rt.pin_count(),
+                "build": build_info(),
+            })
+        else:
+            self._json(404, {"error": f"no such path {self.path!r}"})
+
+    # ------------------------------------------------------------ POST side
+
+    def _drain(self, rt: "StereoRouter", raw: bytes) -> None:
+        """POST /debug/drain: take one backend out of rotation and
+        forward the drain; the backend finishes running batches and its
+        /healthz flips to drained (poll it through GET /healthz here)."""
+        qs = parse_qs(urlparse(self.path).query)
+        name = (qs.get("backend", [None])[0])
+        if name is None and raw:
+            try:
+                name = json.loads(raw).get("backend")
+            except Exception:
+                name = None
+        backend = next((b for b in rt.backends if b.name == name), None)
+        if backend is None:
+            self._json(400, {"error": f"unknown backend {name!r}; choose "
+                                      f"from "
+                                      f"{[b.name for b in rt.backends]}"})
+            return
+        backend.mark_draining()
+        rt.refresh_gauges()
+        try:
+            status, reply = _http_json(
+                backend.host, backend.port, "POST", "/debug/drain",
+                timeout=rt.config.probe_timeout_s)
+        except (OSError, ValueError) as e:  # incl. non-JSON reply
+            self._json(502, {"error": f"drain forward failed: {e}",
+                             "backend": backend.name})
+            return
+        self._json(status, {"backend": backend.name, "drain": reply})
+
+    def do_POST(self):
+        rt: "StereoRouter" = self.server
+        path = urlparse(self.path).path
+        raw = self._read_body(rt.config.max_body_mb)
+        if raw is None:
+            return
+        if path == "/debug/drain":
+            self._drain(rt, raw)
+            return
+        if path != "/predict":
+            self._json(404, {"error": f"no such path {self.path!r}"})
+            return
+        # Same 64-char cap the backend applies (server.py): a longer
+        # client-chosen id would be truncated there and split the trace
+        # between router and backend spans (it is also client-controlled
+        # data stored in the span ring — bound it).
+        rid = (self.headers.get("X-Request-Id") or "")[:64] \
+            or rt.tracer.new_trace_id()
+        try:
+            payload = json.loads(raw)
+            session_id = payload.get("session_id")
+        except Exception as e:
+            self._json(400, {"error": f"bad request: {e}"},
+                       {"X-Request-Id": rid})
+            return
+        status, body, headers = rt.route_predict(raw, session_id, rid)
+        self._send(status, body, "application/json", headers)
+
+
+class StereoRouter(ThreadingHTTPServer):
+    """HTTP front-end owning the backend table, prober, pins, metrics.
+
+    ``config.port == 0`` binds an ephemeral port (read it from
+    ``router.port``).  The router exports ONLY the ``cluster_*``
+    families — per-request serving metrics live on the backends.
+    """
+
+    daemon_threads = True
+
+    def __init__(self, config: RouterConfig,
+                 tracer: Optional[Tracer] = None):
+        assert config.backends, "a router needs at least one backend"
+        self.config = config
+        self.backends: List[Backend] = [
+            Backend(i, host, port)
+            for i, (host, port) in enumerate(config.backends)]
+        self.registry = MetricsRegistry()
+        self.cluster_metrics = ClusterMetrics(self.registry)
+        self.tracer = tracer or Tracer(capacity=config.trace_buffer)
+        # session_id -> backend bid (same LRU pin policy — and the same
+        # PinTable implementation — as the in-process dispatcher: an
+        # evicted pin behaves exactly like a lost session, the next
+        # frame re-pins and runs cold).
+        self._pins = PinTable(config.session_pin_limit)
+        self._prober = _Prober(self)
+        super().__init__((config.host, config.port), _RouterHandler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self) -> "StereoRouter":
+        """Probe once synchronously (so a freshly built router already
+        knows which backends are ready), then start the prober."""
+        self._prober.probe_once()
+        self._prober.start()
+        return self
+
+    def close(self) -> None:
+        self._prober.stop()
+        self.shutdown()
+        self.server_close()
+
+    # ------------------------------------------------------------- routing
+
+    def pin_count(self) -> int:
+        return len(self._pins)
+
+    def health(self) -> Dict[str, object]:
+        """Router /healthz: the router is live by construction; ready
+        means at least one backend is routable."""
+        return {
+            "status": "ok",
+            "live": True,
+            "ready": any(b.routable() for b in self.backends),
+            "backends": {b.name: dict(b.snapshot(), state=b.state())
+                         for b in self.backends},
+            "session_pins": self.pin_count(),
+        }
+
+    def _ready_backends(self, exclude=()) -> List[Backend]:
+        ready = [b for b in self.backends
+                 if b.routable() and b.bid not in exclude]
+        return sorted(ready, key=lambda b: (b.outstanding(), b.bid))
+
+    def _pin_backend(self, session_id: str,
+                     exclude=()) -> Optional[Backend]:
+        """Sticky backend for a session, re-pinning when its backend is
+        gone (the new backend serves the frame cold)."""
+        bid, repinned = self._pins.pin(
+            session_id,
+            still_ok=lambda b: self.backends[b].routable()
+            and b not in exclude,
+            choose=lambda: (lambda c: c[0].bid if c else None)(
+                self._ready_backends(exclude)))
+        if bid is None:
+            return None
+        if repinned:
+            self.cluster_metrics.session_repins.inc()
+        return self.backends[bid]
+
+    def _record(self, backend: Backend, outcome: str) -> None:
+        self.cluster_metrics.dispatch.labels(
+            replica=backend.name, outcome=outcome).inc()
+
+    def refresh_gauges(self) -> None:
+        cm = self.cluster_metrics
+        states: Dict[str, int] = {}
+        for b in self.backends:
+            states[b.state()] = states.get(b.state(), 0) + 1
+            cm.queue_depth.labels(replica=b.name).set(b.outstanding())
+        cm.set_states(states)
+        ready = [b for b in self.backends if b.routable()]
+        # Utilization proxy without knowing backend batch capacity: the
+        # fraction of ready backends with work outstanding.
+        cm.utilization.set(
+            round(sum(1 for b in ready if b.outstanding() > 0)
+                  / len(ready), 4) if ready else 0.0)
+
+    def _forward(self, backend: Backend, raw: bytes, rid: str
+                 ) -> Tuple[str, int, bytes, Dict[str, str]]:
+        """One proxy attempt.  Returns (phase, status, body, headers):
+        phase ``"ok"`` carries a backend reply; ``"connect"`` failed
+        before the request reached the backend (always safe to retry);
+        ``"response"`` failed after (only idempotent work may retry);
+        ``"timeout"`` means the backend may still be computing."""
+        conn = http.client.HTTPConnection(
+            backend.host, backend.port,
+            timeout=self.config.request_timeout_s)
+        try:
+            try:
+                conn.request("POST", "/predict", body=raw,
+                             headers={"Content-Type": "application/json",
+                                      "X-Request-Id": rid})
+            except OSError:
+                backend.mark_unreachable()
+                return "connect", 0, b"", {}
+            try:
+                resp = conn.getresponse()
+                body = resp.read()
+            except socket.timeout:
+                return "timeout", 0, b"", {}
+            except (http.client.HTTPException, OSError):
+                backend.mark_unreachable()
+                return "response", 0, b"", {}
+            headers = {"X-Request-Id": resp.headers.get("X-Request-Id",
+                                                        rid),
+                       "X-Backend": backend.name}
+            return "ok", resp.status, body, headers
+        finally:
+            conn.close()
+
+    def route_predict(self, raw: bytes, session_id: Optional[str],
+                      rid: str) -> Tuple[int, bytes, Dict[str, str]]:
+        """Pick a backend and proxy; bounded failover for cold requests.
+        Never blocks without a timeout and never retries work that may
+        have executed unless it is idempotent (cold inference)."""
+        cfg = self.config
+        t0 = time.perf_counter()
+        is_session = session_id is not None
+        attempts = cfg.retries + 1
+        tried: List[int] = []
+        detail = "no ready backend"
+        spilled_shed = False
+        for attempt in range(attempts):
+            if is_session:
+                backend = self._pin_backend(str(session_id),
+                                            exclude=tuple(tried))
+            else:
+                cands = self._ready_backends(exclude=tuple(tried))
+                backend = cands[0] if cands else None
+            if backend is None:
+                break
+            tried.append(backend.bid)
+            if attempt and not spilled_shed:
+                # Same exponential-backoff-with-jitter schedule as the
+                # client's retries (utils/backoff.py — one formula for
+                # both ends of the failover story).  A shed (healthy 503
+                # reply) spills immediately instead — there is no failure
+                # storm to decorrelate, and the in-process dispatcher
+                # spills Overloaded replicas without a pause too.
+                time.sleep(backoff_delay(cfg.retry_backoff_ms,
+                                         attempt - 1))
+            spilled_shed = False
+            backend.begin()
+            t_fwd = time.perf_counter()
+            try:
+                phase, status, body, headers = self._forward(backend, raw,
+                                                             rid)
+            finally:
+                backend.end()
+            self.tracer.record(
+                "router_hop", t_fwd, time.perf_counter(), rid,
+                attrs={"backend": backend.name, "attempt": attempt,
+                       "phase": phase, "status": status,
+                       "session": is_session})
+            if phase == "ok":
+                if status == 500 and not is_session:
+                    # Backend crashed mid-inference: cold inference is
+                    # idempotent, fail over like a connection error.
+                    self._record(backend, "failover")
+                    detail = f"backend {backend.name} answered 500"
+                    continue
+                if status == 503 and not is_session:
+                    # Backend shed (queue full / draining just started):
+                    # nothing executed, so spill the cold request to the
+                    # next-least-loaded backend — matching the in-process
+                    # dispatcher, the cluster is only overloaded when
+                    # every ready backend is.  (Session frames stay put:
+                    # their pinned backend shedding is backpressure the
+                    # client must pace to, not a reason to move state.)
+                    self._record(backend, "shed")
+                    detail = f"backend {backend.name} shed (503)"
+                    spilled_shed = True
+                    continue
+                outcome = {200: "ok", 503: "shed",
+                           504: "timeout"}.get(status, "error")
+                self._record(backend, outcome)
+                # Router-added latency: everything before the successful
+                # forward began (route pick, failed attempts, backoffs)
+                # — the backend's own compute is excluded.
+                self.cluster_metrics.router_latency.observe(t_fwd - t0)
+                self.tracer.record("route", t0, time.perf_counter(), rid,
+                                   attrs={"backend": backend.name,
+                                          "attempts": attempt + 1,
+                                          "status": status})
+                return status, body, headers
+            if phase == "timeout":
+                # The backend may still be computing: a blind retry would
+                # run inference twice AND double the client's wait.
+                self._record(backend, "timeout")
+                return 504, json.dumps(
+                    {"error": "timeout",
+                     "detail": f"backend {backend.name} exceeded "
+                               f"{cfg.request_timeout_s}s"}).encode(), \
+                    {"X-Request-Id": rid}
+            if phase == "response" and is_session:
+                # The frame may have executed; a duplicate would advance
+                # the session state.  Fail clean, client decides.
+                self._record(backend, "error")
+                return 503, json.dumps(
+                    {"error": "unavailable",
+                     "detail": f"backend {backend.name} failed "
+                               f"mid-frame; session state unknown"}
+                ).encode(), {"X-Request-Id": rid, "Retry-After": "1"}
+            # connect-phase failure (any request), or response-phase
+            # failure of an idempotent cold request: fail over.
+            self._record(backend, "connect_error" if phase == "connect"
+                         else "failover")
+            detail = f"backend {backend.name} {phase} failure"
+        self.refresh_gauges()
+        self.tracer.record("route", t0, time.perf_counter(), rid,
+                           attrs={"attempts": len(tried), "status": 503,
+                                  "detail": detail})
+        return 503, json.dumps(
+            {"error": "unavailable", "detail": detail,
+             "attempts": len(tried)}).encode(), \
+            {"X-Request-Id": rid, "Retry-After": "1"}
+
+
+def build_router(config: RouterConfig,
+                 tracer: Optional[Tracer] = None) -> StereoRouter:
+    """Construct + start a router (first probe already done, prober
+    running).  The caller drives ``serve_forever()`` and ``close()``."""
+    router = StereoRouter(config, tracer=tracer).start()
+    logger.info("routing on %s:%d over %s", config.host, router.port,
+                [f"{h}:{p}" for h, p in config.backends])
+    return router

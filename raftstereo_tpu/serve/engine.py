@@ -23,6 +23,7 @@ device dispatch only.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -45,8 +46,18 @@ class BatchEngine:
     """Batched test-mode forward behind a shape-bucketed compile cache."""
 
     def __init__(self, model, variables, config: ServeConfig,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None, device=None):
         self.model = model
+        # ``device`` pins every executable (and the weights) to one chip:
+        # the replicated cluster (serve/cluster/) builds one engine per
+        # device from parallel.mesh.replica_devices, each with its OWN
+        # jit wrappers — so each replica owns an independent compile
+        # cache and the replicas never serialize on one another's
+        # dispatch lock.  None keeps JAX's default placement (the
+        # single-engine path, unchanged).
+        self.device = device
+        if device is not None:
+            variables = jax.device_put(variables, device)
         self.variables = variables
         self.cfg = config
         self.metrics = metrics
@@ -67,6 +78,17 @@ class BatchEngine:
         # their own): thread-local because an attribute would be overwritten
         # by whichever dispatch finished last.
         self._seg = threading.local()
+
+    def _device_ctx(self):
+        """Thread-local placement override for one dispatch: jit traces,
+        input STAGING and transfers inside it target this engine's
+        device (staging outside it would land on the global default
+        device and pay a copy per dispatch).  A context manager (not a
+        global config update) because concurrent replicas dispatch from
+        different threads at once."""
+        if self.device is None:
+            return contextlib.nullcontext()
+        return jax.default_device(self.device)
 
     # ----------------------------------------------------------- shape policy
 
@@ -255,17 +277,22 @@ class BatchEngine:
             "mixed buckets in one batch: "
             f"{sorted({p.bucket_hw for p in padders})}")
         lefts, rights = [], []
-        for (im1, im2), padder in zip(pairs, padders):
-            i1, i2 = padder.pad(jnp.asarray(im1, jnp.float32)[None],
-                                jnp.asarray(im2, jnp.float32)[None])
-            lefts.append(i1)
-            rights.append(i2)
-        pad_rows = self.cfg.max_batch_size - len(pairs)
-        i1 = jnp.concatenate(lefts, axis=0)
-        i2 = jnp.concatenate(rights, axis=0)
-        if pad_rows:
-            i1 = jnp.pad(i1, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
-            i2 = jnp.pad(i2, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+        # Staging under _device_ctx too, not just the jit call: a pinned
+        # replica's inputs must land on ITS device — staged on the global
+        # default they would pay a device-to-device copy per dispatch and
+        # serialize every replica's staging on one chip's stream.
+        with self._device_ctx():
+            for (im1, im2), padder in zip(pairs, padders):
+                i1, i2 = padder.pad(jnp.asarray(im1, jnp.float32)[None],
+                                    jnp.asarray(im2, jnp.float32)[None])
+                lefts.append(i1)
+                rights.append(i2)
+            pad_rows = self.cfg.max_batch_size - len(pairs)
+            i1 = jnp.concatenate(lefts, axis=0)
+            i2 = jnp.concatenate(rights, axis=0)
+            if pad_rows:
+                i1 = jnp.pad(i1, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+                i2 = jnp.pad(i2, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
         self._seg.pad = (t_pad0, time.perf_counter())
         return padders, hw, i1, i2, pad_rows
 
@@ -286,7 +313,8 @@ class BatchEngine:
                 (self.metrics.compile_misses if miss
                  else self.metrics.compile_hits).labels(**labels).inc()
             start = time.perf_counter()
-            out_dev = call()
+            with self._device_ctx():
+                out_dev = call()
             # Two measured phases: device compute (dispatch until the
             # result exists on device) and the device->host copy.  Both
             # still happen under the engine lock — fetch-before-release is
@@ -342,18 +370,19 @@ class BatchEngine:
         padders, hw, i1, i2, pad_rows = self._pad_pairs(pairs)
         lh, lw = self.low_hw(hw)
         inits = []
-        for init in flow_inits:
-            if init is None:
-                init = np.zeros((lh, lw), np.float32)
-            init = np.asarray(init, np.float32)
-            assert init.shape == (lh, lw), (
-                f"flow_init {init.shape} != low-res bucket shape "
-                f"{(lh, lw)} (bucket {hw}, factor "
-                f"{self.model.config.factor})")
-            inits.append(jnp.asarray(init)[None, :, :, None])
-        fi = jnp.concatenate(inits, axis=0)
-        if pad_rows:
-            fi = jnp.pad(fi, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+        with self._device_ctx():  # stage on this replica's device
+            for init in flow_inits:
+                if init is None:
+                    init = np.zeros((lh, lw), np.float32)
+                init = np.asarray(init, np.float32)
+                assert init.shape == (lh, lw), (
+                    f"flow_init {init.shape} != low-res bucket shape "
+                    f"{(lh, lw)} (bucket {hw}, factor "
+                    f"{self.model.config.factor})")
+                inits.append(jnp.asarray(init)[None, :, :, None])
+            fi = jnp.concatenate(inits, axis=0)
+            if pad_rows:
+                fi = jnp.pad(fi, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
         key = (hw[0], hw[1], iters, "stream")
         (low, up), miss = self._dispatch(
             key, lambda: self._stream_fn(iters)(self.variables, i1, i2, fi))
@@ -403,7 +432,8 @@ class BatchEngine:
                 (self.metrics.compile_misses if miss
                  else self.metrics.compile_hits).labels(**labels).inc()
             start = time.perf_counter()
-            out = call()
+            with self._device_ctx():
+                out = call()
             jax.block_until_ready(out)
             t_done = time.perf_counter()
             self.last_batch_runtime = t_done - start
@@ -456,8 +486,9 @@ class BatchEngine:
         fi = np.zeros((bsz, lh, lw, 1), np.float32)
         for (im1, im2), padder, init, slot in zip(pairs, padders,
                                                   flow_inits, slots):
-            p1, p2 = padder.pad(jnp.asarray(im1, jnp.float32)[None],
-                                jnp.asarray(im2, jnp.float32)[None])
+            with self._device_ctx():  # tiny pad ops on our own device
+                p1, p2 = padder.pad(jnp.asarray(im1, jnp.float32)[None],
+                                    jnp.asarray(im2, jnp.float32)[None])
             i1[slot] = np.asarray(p1[0], np.float32)
             i2[slot] = np.asarray(p2[0], np.float32)
             if init is not None:
@@ -486,7 +517,8 @@ class BatchEngine:
                          mask: np.ndarray):
         """Merge ``incoming`` into ``running`` where ``mask`` (B,) is
         True; returns ``(state, included_compile)``."""
-        m = jnp.asarray(mask, bool)
+        with self._device_ctx():  # the mask joins device-resident state
+            m = jnp.asarray(mask, bool)
         assert m.shape == (self.cfg.max_batch_size,), m.shape
         key = (hw[0], hw[1], 0, "sched_join")
         return self._dispatch_state(

@@ -1,0 +1,77 @@
+"""Shared stdlib-only HTTP handler plumbing for the serving front-ends.
+
+Both the single-server front-end (``serve/server.py``) and the cluster
+router (``serve/cluster/router.py``) speak the same small dialect:
+JSON replies with explicit Content-Length (keep-alive), and a bounded
+Content-Length check before any body is buffered.  One base class keeps
+the two handlers byte-identical on that dialect — a fix to the body-cap
+or header logic lands in both.
+
+This module must stay importable without the engine/model stack: the
+router is model-free (see serve/__init__.py's lazy exports).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, Optional
+
+__all__ = ["JsonRequestHandler"]
+
+
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """JSON-over-HTTP/1.1 handler base: reply helpers + body cap.
+
+    Subclasses set ``_log`` to their module logger (request chatter goes
+    to ``logging``, never stderr) and their own ``server_version``."""
+
+    protocol_version = "HTTP/1.1"  # keep-alive: load-gen reuses connections
+    _log = logging.getLogger(__name__)
+
+    def log_message(self, fmt, *args):
+        self._log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              extra_headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, obj,
+              extra_headers: Optional[Dict[str, str]] = None) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json",
+                   extra_headers)
+
+    def _content_length(self, limit_mb: float) -> Optional[int]:
+        """Parse + bound Content-Length WITHOUT reading the body.
+
+        Returns the length, or None when it is missing/unparseable/over
+        ``limit_mb`` — the connection is then marked for close (refusing
+        before buffering means the unread body can never be drained, so
+        keep-alive would misparse it as the next request line).  The
+        caller sends its own 413."""
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            length = -1
+        if length < 0 or length > limit_mb * 2 ** 20:
+            self.close_connection = True
+            return None
+        return length
+
+    def _read_body(self, limit_mb: float) -> Optional[bytes]:
+        """Bounded body read; replies 413 itself and returns None on a
+        bad/oversize Content-Length."""
+        length = self._content_length(limit_mb)
+        if length is None:
+            self._json(413, {"error": "body too large or bad "
+                                      "Content-Length",
+                             "limit_mb": limit_mb})
+            return None
+        return self.rfile.read(length) if length else b""

@@ -32,8 +32,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils.profiling import LatencyHistogram
 
-__all__ = ["Counter", "Gauge", "LabelFamily", "MetricsRegistry",
-           "ServeMetrics"]
+__all__ = ["ClusterMetrics", "Counter", "Gauge", "LabelFamily",
+           "MetricsRegistry", "ServeMetrics"]
 
 
 class Counter:
@@ -299,6 +299,65 @@ class ServeMetrics:
             "sched_step_latency_seconds",
             "engine wall-clock per scheduler step (every occupied slot "
             "advances iters_per_step iterations), compile-free steps only")
+
+    def render(self) -> str:
+        return self.registry.render()
+
+
+class ClusterMetrics:
+    """The replicated-serving / autoscaling signal bundle
+    (serve/cluster/, docs/serving.md "Cluster").
+
+    Shared by the in-process dispatcher (mounted on the server's
+    ``ServeMetrics`` registry, so one ``/metrics`` scrape covers both)
+    and the front-end router (its own registry — the router process has
+    no serve bundle).  The ``cluster_replicas{state=}`` gauge family and
+    the per-replica queue-depth/utilization gauges are the autoscaling
+    inputs: scale out when ready replicas run hot, scale in when
+    utilization stays low; ``cluster_dispatch_total{outcome=}`` exposes
+    failover and shed rates.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self.replicas = r.gauge(
+            "cluster_replicas",
+            "engine replicas / backends by state (starting/ready/"
+            "draining/drained/failed/unreachable)",
+            labels=("state",))
+        self.queue_depth = r.gauge(
+            "cluster_queue_depth",
+            "requests queued or in flight, per replica",
+            labels=("replica",))
+        self.dispatch = r.counter(
+            "cluster_dispatch_total",
+            "dispatch decisions per replica and outcome (ok/error/shed/"
+            "timeout/unavailable/failover/connect_error)",
+            labels=("replica", "outcome"))
+        self.utilization = r.gauge(
+            "cluster_utilization",
+            "mean occupied fraction (0-1) of the ready replicas' batch "
+            "capacity — the primary scale-out signal")
+        self.session_repins = r.counter(
+            "cluster_session_repins_total",
+            "session frames re-pinned to a new replica because the "
+            "pinned one was lost or draining (the frame re-runs cold)")
+        self.probe_failures = r.counter(
+            "cluster_probe_failures_total",
+            "health-probe failures per backend (router only)",
+            labels=("replica",))
+        self.router_latency = r.histogram(
+            "cluster_router_hop_latency_seconds",
+            "router-added latency per forwarded request (route pick + "
+            "proxying, excluding the backend's own compute)")
+
+    def set_states(self, states: Dict[str, int]) -> None:
+        """Overwrite the per-state replica gauge (absent states -> 0, so
+        a replica leaving a state does not leave a stale sample)."""
+        for state in ("starting", "ready", "draining", "drained",
+                      "failed", "unreachable"):
+            self.replicas.labels(state=state).set(states.get(state, 0))
 
     def render(self) -> str:
         return self.registry.render()

@@ -68,6 +68,9 @@ class SchedResult:
     batch_slots: int           # occupied slots when this request left
     latency_s: float
     included_compile: bool
+    # Which cluster replica answered (serve/cluster/dispatcher.py);
+    # None on the single-engine path.
+    replica: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -150,6 +153,7 @@ class IterationScheduler:
         """Stop the worker.  ``drain=True`` finishes everything queued and
         running first; ``drain=False`` fails queued requests immediately
         with ``ShuttingDown`` and the worker fails running slots."""
+        to_fail = []
         with self._cv:
             self._closed = True
             self._drain = drain
@@ -158,9 +162,13 @@ class IterationScheduler:
                     if self.metrics is not None:
                         self.metrics.sched_queue_depth.labels(
                             priority=it.priority).add(-1)
-                    it.future._resolve(exc=ShuttingDown("scheduler stopped"))
+                    to_fail.append(it.future)
                 self._queue.clear()
             self._cv.notify_all()
+        # Outside _cv: done-callbacks may read queue depths (see
+        # batcher.Future._resolve).
+        for fut in to_fail:
+            fut._resolve(exc=ShuttingDown("scheduler stopped"))
         if self._thread is not None:
             self._thread.join(timeout)
 
@@ -252,17 +260,21 @@ class IterationScheduler:
 
     def _loop(self) -> None:
         while True:
+            abort = False
             with self._cv:
                 while (not self._closed and not self._queue
                        and not self._running):
                     self._cv.wait()
                 if self._closed:
                     if not self._drain:
-                        self._fail_running(ShuttingDown("scheduler "
-                                                        "stopped"))
+                        abort = True
+                    elif not self._queue and not self._running:
                         return
-                    if not self._queue and not self._running:
-                        return
+            if abort:
+                # _running is worker-private; failing its futures must
+                # happen outside _cv (see batcher.Future._resolve).
+                self._fail_running(ShuttingDown("scheduler stopped"))
+                return
             try:
                 self.run_once()
             except Exception:  # pragma: no cover - defensive
@@ -306,6 +318,7 @@ class IterationScheduler:
         sc = self.sched_cfg
         timeout_s = self.cfg.request_timeout_ms / 1000.0
         joins: Dict[Tuple[int, int], List[_QueueItem]] = {}
+        timed_out: List[_QueueItem] = []
         with self._cv:
             keep: List[_QueueItem] = []
             for it in self._queue:
@@ -314,13 +327,7 @@ class IterationScheduler:
                         self.metrics.timeouts.inc()
                         self.metrics.sched_queue_depth.labels(
                             priority=it.priority).add(-1)
-                    if self.tracer is not None and it.trace_id is not None:
-                        self.tracer.record(
-                            "queue_wait", it.t_enqueue, now, it.trace_id,
-                            attrs={"outcome": "timeout"})
-                    it.future._resolve(exc=RequestTimedOut(
-                        f"queued {now - it.t_enqueue:.3f}s > "
-                        f"{timeout_s:.3f}s limit"))
+                    timed_out.append(it)
                 else:
                     keep.append(it)
             keep.sort(key=lambda it: queue_sort_key(
@@ -343,6 +350,16 @@ class IterationScheduler:
                     self.metrics.sched_queue_depth.labels(
                         priority=it.priority).add(-1)
             self._queue = keep
+        # Outside _cv: done-callbacks may read queue depths (see
+        # batcher.Future._resolve).
+        for it in timed_out:
+            if self.tracer is not None and it.trace_id is not None:
+                self.tracer.record(
+                    "queue_wait", it.t_enqueue, now, it.trace_id,
+                    attrs={"outcome": "timeout"})
+            it.future._resolve(exc=RequestTimedOut(
+                f"queued {now - it.t_enqueue:.3f}s > "
+                f"{timeout_s:.3f}s limit"))
         return joins
 
     def _join(self, bucket: Tuple[int, int],

@@ -43,7 +43,7 @@ import json
 import logging
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Dict, Optional, Union
 from urllib.parse import urlparse
 
@@ -54,6 +54,7 @@ from ..obs import Tracer, build_info, dump_threads, trace_response
 from ..utils.profiling import OnDemandProfiler, ProfilerBusy
 from .batcher import DynamicBatcher, Overloaded, RequestTimedOut, ShuttingDown
 from .engine import BatchEngine
+from .httpbase import JsonRequestHandler
 from .metrics import ServeMetrics
 from .sched import IterationScheduler
 
@@ -95,29 +96,13 @@ def _outcome(code: int, obj: Dict) -> str:
     return "error"
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonRequestHandler):
     server_version = "raftstereo-serve/1.0"
-    protocol_version = "HTTP/1.1"  # keep-alive: load-gen reuses connections
+    _log = logger  # request chatter to this module's logger, not stderr
 
-    # -------------------------------------------------------------- plumbing
-    def log_message(self, fmt, *args):  # route chatter to logging, not stderr
-        logger.debug("%s %s", self.address_string(), fmt % args)
-
-    def _send(self, code: int, body: bytes, ctype: str,
-              extra_headers: Optional[Dict[str, str]] = None) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in (extra_headers or {}).items():
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _json(self, code: int, obj,
-              extra_headers: Optional[Dict[str, str]] = None) -> None:
-        self._send(code, json.dumps(obj).encode(),
-                   "application/json", extra_headers)
-
+    # ------------------------------------------------------------- plumbing
+    # (_send/_json/_content_length come from JsonRequestHandler, shared
+    # byte-for-byte with the cluster router's handler.)
     def _finish(self, code: int, obj: Dict, endpoint: str, rid: str,
                 t0: float,
                 extra_headers: Optional[Dict[str, str]] = None) -> None:
@@ -146,11 +131,21 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/healthz":
             health = {
                 "status": "ok",
+                # live vs ready (k8s-style): live = the process answers;
+                # ready = warmup finished and not draining, i.e. traffic
+                # routed here will not pay a cold compile.  The cluster
+                # router gates on ready, never on live.
+                "live": True,
+                "ready": srv.is_ready,
+                "draining": srv.draining,
+                "drained": srv.drained,
                 "queue_depth": srv.queue_depth,
                 "compiled_buckets": sorted(srv.engine.compiled_keys),
                 "max_batch_size": srv.config.max_batch_size,
                 "iters": srv.config.iters,
             }
+            if srv.cluster is not None:
+                health["cluster"] = srv.cluster.stats()
             if srv.scheduler is not None:
                 health["sched"] = srv.scheduler.stats()
             if srv.stream is not None:
@@ -184,6 +179,10 @@ class _Handler(BaseHTTPRequestHandler):
                 },
                 "sched": (srv.scheduler.stats()
                           if srv.scheduler is not None else None),
+                "cluster": (srv.cluster.stats()
+                            if srv.cluster is not None else None),
+                "ready": srv.is_ready,
+                "draining": srv.draining,
                 "trace": {"capacity": srv.tracer.capacity,
                           "recorded": srv.tracer.recorded,
                           "dropped": srv.tracer.dropped},
@@ -223,20 +222,34 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         srv: "StereoServer" = self.server
-        if urlparse(self.path).path == "/debug/profile":
+        path = urlparse(self.path).path
+        if path == "/debug/profile":
             self._debug_profile(srv)
             return
-        rid = srv.tracer.new_trace_id()
+        if path == "/debug/drain":
+            # Explicit drain (the router's scale-in/maintenance hook):
+            # stop admitting /predict traffic, let everything already
+            # queued or running finish, report drained on /healthz.
+            # Drain any request body first (the router dialect sends
+            # {"backend": ...}; unread bytes would desync keep-alive).
+            if self._read_body(srv.config.max_body_mb) is None:
+                return
+            srv.start_drain()
+            self._json(200, {"draining": True, "drained": srv.drained,
+                             "queue_depth": srv.queue_depth,
+                             "inflight": srv.inflight})
+            return
+        # A router in front forwards its request id so the hop's spans
+        # and the backend's spans share one trace (docs/observability.md).
+        rid = (self.headers.get("X-Request-Id") or "")[:64] \
+            or srv.tracer.new_trace_id()
         t_req0 = time.perf_counter()
         endpoint = "predict"
-        try:
-            length = int(self.headers.get("Content-Length", 0) or 0)
-        except ValueError:
-            length = -1
-        if length < 0 or length > srv.config.max_body_mb * 2 ** 20:
-            # Refuse before buffering: close instead of draining an
-            # arbitrarily large (or unparseable) body.
-            self.close_connection = True
+        # Refuse before buffering (shared body cap; connection marked
+        # close): the reply rides through _finish so the 413 is counted
+        # and traced like every other /predict outcome.
+        length = self._content_length(srv.config.max_body_mb)
+        if length is None:
             self._finish(413, {"error": "body too large or bad "
                                         "Content-Length",
                                "limit_mb": srv.config.max_body_mb},
@@ -254,6 +267,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self._finish(404, {"error": f"no such path {self.path!r}"},
                              "other", rid, t_req0)
                 return
+            # Readiness gate + in-flight count, atomically: a warming
+            # server must not accept traffic (the request would stall
+            # behind the warmup compiles), a draining one must not
+            # admit new work — and an ADMITTED request is counted in
+            # flight from the same lock acquisition, so drain's "finish
+            # everything admitted" contract covers requests still
+            # decoding or validating (``drained`` must never read true
+            # while a request sits between the gate and dispatch).
+            if not srv.try_begin_predict():
+                detail = ("draining" if srv.draining
+                          else "not ready (warming up)")
+                self._finish(503, {"error": "unavailable",
+                                   "detail": detail},
+                             endpoint, rid, t_req0, {"Retry-After": "1"})
+                return
             try:
                 payload = json.loads(raw)
                 left = decode_array(payload["left"])
@@ -264,10 +292,23 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline_ms = payload.get("deadline_ms")
                 priority = payload.get("priority")
             except Exception as e:
+                srv.end_predict()
                 self._finish(400, {"error": f"bad request: {e}"},
                              endpoint, rid, t_req0)
                 return
             del raw, payload
+        try:
+            self._predict_admitted(srv, endpoint, rid, t_req0, left, right,
+                                   iters, session_id, seq_no, deadline_ms,
+                                   priority)
+        finally:
+            srv.end_predict()
+
+    def _predict_admitted(self, srv: "StereoServer", endpoint, rid, t_req0,
+                          left, right, iters, session_id, seq_no,
+                          deadline_ms, priority) -> None:
+        """Validation + dispatch of one admitted (gate-passed, decoded,
+        in-flight-counted) /predict request."""
         try:
             if left.ndim != 3 or left.shape[-1] != 3 \
                     or left.shape != right.shape:
@@ -424,13 +465,16 @@ class _Handler(BaseHTTPRequestHandler):
             finally:
                 with srv.stream_inflight_lock:
                     srv.stream_inflight -= 1
+            meta = {"session_id": res.session_id, "seq_no": res.seq_no,
+                    "frame_idx": res.frame_idx, "iters": res.iters,
+                    "warm": res.warm,
+                    "update_ema": round(res.update_ema, 4),
+                    "latency_ms": round(res.latency_s * 1e3, 3)}
+            if res.replica is not None:
+                meta["replica"] = res.replica
             self._finish(200, {
                 "disparity": encode_array(res.disparity),
-                "meta": {"session_id": res.session_id, "seq_no": res.seq_no,
-                         "frame_idx": res.frame_idx, "iters": res.iters,
-                         "warm": res.warm,
-                         "update_ema": round(res.update_ema, 4),
-                         "latency_ms": round(res.latency_s * 1e3, 3)},
+                "meta": meta,
             }, endpoint, rid, t_req0)
             return
         # Size the HTTP-side wait for what can actually be ahead of this
@@ -453,7 +497,8 @@ class _Handler(BaseHTTPRequestHandler):
                     left, right, iters=iters, priority=priority,
                     deadline_ms=deadline_ms, trace_id=rid)
             else:
-                fut = srv.batcher.submit(left, right, iters, trace_id=rid)
+                fut = srv.batcher.submit(left, right, iters,
+                                         trace_id=rid)
         except ValueError as e:  # bad priority/deadline/target (sched)
             self._finish(400, {"error": f"bad request: {e}"},
                          endpoint, rid, t_req0)
@@ -468,8 +513,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             # The batcher/scheduler enforces request_timeout_ms while
-            # queued; the slack covers whatever can run ahead (batch or
-            # cold compile).
+            # queued; the slack covers whatever can run ahead (batch
+            # or cold compile).
             res = fut.result(
                 timeout=srv.config.request_timeout_ms / 1000.0 + slack)
         except RequestTimedOut as e:
@@ -477,7 +522,8 @@ class _Handler(BaseHTTPRequestHandler):
                          endpoint, rid, t_req0)
             return
         except (TimeoutError, ShuttingDown) as e:
-            self._finish(503, {"error": "unavailable", "detail": str(e)},
+            self._finish(503, {"error": "unavailable",
+                               "detail": str(e)},
                          endpoint, rid, t_req0)
             return
         except Exception as e:
@@ -485,7 +531,8 @@ class _Handler(BaseHTTPRequestHandler):
                          endpoint, rid, t_req0)
             return
         if srv.scheduler is not None:
-            meta = {"iters": res.iters, "target_iters": res.target_iters,
+            meta = {"iters": res.iters,
+                    "target_iters": res.target_iters,
                     "degraded": res.degraded, "priority": res.priority,
                     "batch_slots": res.batch_slots,
                     "latency_ms": round(res.latency_s * 1e3, 3)}
@@ -493,6 +540,8 @@ class _Handler(BaseHTTPRequestHandler):
             meta = {"iters": res.iters, "degraded": res.degraded,
                     "batch_size": res.batch_size,
                     "latency_ms": round(res.latency_s * 1e3, 3)}
+        if res.replica is not None:
+            meta["replica"] = res.replica
         self._finish(200, {
             "disparity": encode_array(res.disparity),
             "meta": meta,
@@ -511,18 +560,40 @@ class StereoServer(ThreadingHTTPServer):
     def __init__(self, config: ServeConfig, engine: BatchEngine,
                  batcher: Optional[DynamicBatcher], metrics: ServeMetrics,
                  stream=None, tracer: Optional[Tracer] = None,
-                 scheduler: Optional[IterationScheduler] = None):
+                 scheduler: Optional[IterationScheduler] = None,
+                 cluster=None, start_ready: bool = True):
         assert (batcher is None) != (scheduler is None), (
             "exactly one of batcher (monolithic dispatch) or scheduler "
             "(iteration-level continuous batching) must be set")
         self.config = config
-        self.engine = engine
+        self._engine = engine
         self.batcher = batcher
         self.scheduler = scheduler
         self.metrics = metrics
         self.stream = stream  # stream.runner.StreamRunner or None
+        # serve/cluster/.ClusterDispatcher or None.  In cluster mode the
+        # dispatcher ALSO fills the batcher/scheduler slot above (it
+        # implements their submit contracts), so the request paths are
+        # identical; this reference is for cluster-specific surfaces
+        # (healthz block, drain fan-out).
+        self.cluster = cluster
         self.tracer = tracer or Tracer(capacity=config.trace_buffer)
         self.profiler = OnDemandProfiler(log_dir="runs/serve/profile")
+        # Readiness (live vs ready on /healthz): set once warmup
+        # finishes.  build_server passes start_ready=False and owns the
+        # gate — it warms either before returning (blocking) or in a
+        # background thread (warmup_async), during which the server is
+        # live but refuses /predict with 503.  Direct construction
+        # defaults to ready: whoever assembles the stack by hand has
+        # already warmed (or chosen not to warm) the engine.
+        self._ready = threading.Event()
+        if start_ready:
+            self._ready.set()
+        self._flags_lock = threading.Lock()
+        self._draining = False  # guarded_by: _flags_lock
+        # /predict requests admitted and not yet answered (drain wants
+        # "everything running finished", which queue depth alone misses).
+        self._predict_inflight = 0  # guarded_by: _flags_lock
         # Admission control for the session path (which bypasses the
         # batcher queue): frames concurrently decoded-and-waiting on the
         # session/engine locks, shed with 503 beyond queue_limit.
@@ -545,6 +616,85 @@ class StereoServer(ThreadingHTTPServer):
         return (self.scheduler.queue_depth if self.scheduler is not None
                 else self.batcher.queue_depth)
 
+    @property
+    def engine(self) -> BatchEngine:
+        """Shape/warmth policy view for admission checks.  In cluster
+        mode this resolves through the ReplicaSet ON EVERY ACCESS, not
+        at construction: readiness is per-replica state, and replica 0
+        may have failed warmup while others warmed — a snapshot taken
+        before warmup would pin admission to its cold compile cache."""
+        if self.cluster is not None:
+            return self.cluster.rset.engine
+        return self._engine
+
+    # ------------------------------------------------- readiness + draining
+
+    def mark_ready(self) -> None:
+        """Warmup finished: the server may advertise ready and admit
+        /predict traffic."""
+        self._ready.set()
+
+    @property
+    def draining(self) -> bool:
+        with self._flags_lock:
+            return self._draining
+
+    @property
+    def is_ready(self) -> bool:
+        """Routable: warmed AND not draining (what /healthz ``ready``
+        reports and the cluster router gates on)."""
+        return self._ready.is_set() and not self.draining
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        return self._ready.wait(timeout)
+
+    def try_begin_predict(self) -> bool:
+        """Atomic readiness gate + in-flight count: both under one lock
+        so ``drained`` can never observe a request that passed the gate
+        but is not yet counted (the drain-then-decommission flow polls
+        ``drained`` and kills the process on true)."""
+        with self._flags_lock:
+            if not self._ready.is_set() or self._draining:
+                return False
+            self._predict_inflight += 1
+            return True
+
+    def end_predict(self) -> None:
+        with self._flags_lock:
+            self._predict_inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        """Admitted /predict requests not yet answered.  Session frames
+        are included: ``try_begin_predict`` wraps the WHOLE handler
+        (cold and stream paths), so adding ``stream_inflight`` — the
+        session path's separate admission-control counter — would
+        double-count them."""
+        with self._flags_lock:
+            return self._predict_inflight
+
+    def start_drain(self) -> None:
+        """POST /debug/drain: stop admitting, finish everything already
+        admitted (queued requests keep dispatching; running batches
+        complete), then report ``drained`` on /healthz."""
+        with self._flags_lock:
+            self._draining = True
+        if self.cluster is not None:
+            self.cluster.drain()
+
+    @property
+    def drained(self) -> bool:
+        """Drain complete: nothing queued, nothing running."""
+        if not self.draining:
+            return False
+        if self.queue_depth or self.inflight:
+            return False
+        if self.scheduler is not None:
+            active = getattr(self.scheduler, "active_slots", None)
+            if callable(active) and active():
+                return False
+        return True
+
     def close(self) -> None:
         """Stop accepting, drain the queue, release the socket."""
         self.shutdown()
@@ -557,48 +707,107 @@ class StereoServer(ThreadingHTTPServer):
 
 def build_server(model, variables, config: ServeConfig,
                  metrics: Optional[ServeMetrics] = None,
-                 tracer: Optional[Tracer] = None) -> StereoServer:
-    """Wire engine + batcher + tracer + HTTP server; warm configured
+                 tracer: Optional[Tracer] = None,
+                 warmup_async: bool = False) -> StereoServer:
+    """Wire engine(s) + dispatch + tracer + HTTP server; warm configured
     buckets.
 
-    The caller drives ``server.serve_forever()`` (blocking) or a thread, and
-    ``server.close()`` on the way out.
+    With ``config.cluster`` set, N engine replicas (one per device) are
+    built behind a ClusterDispatcher instead of a single engine.
+
+    ``warmup_async=False`` (default) warms before returning — the
+    historical blocking behaviour, ready on return.  ``warmup_async=True``
+    returns immediately with the server LIVE but NOT READY (/healthz
+    ``ready: false``, /predict 503) and warms in a background thread —
+    what a restarting production server wants: health-checkable at once,
+    routable only when traffic will not pay a cold compile.
+
+    The caller drives ``server.serve_forever()`` (blocking) or a thread,
+    and ``server.close()`` on the way out.
     """
     metrics = metrics or ServeMetrics()
     tracer = tracer or Tracer(capacity=config.trace_buffer)
-    engine = BatchEngine(model, variables, config, metrics)
-    scheduler = None
-    if config.sched is not None:
-        # Iteration-level continuous batching: the scheduler IS the
-        # dispatch path — the micro-batcher is not started, admission
-        # control lives in scheduler.submit, and session frames ride the
-        # same scheduler as high-priority short jobs.  Warmth is the four
-        # phase executables per bucket, not per iteration level.
-        if config.warmup:
-            engine.warmup_sched(iters_per_step=config.sched.iters_per_step)
-        scheduler = IterationScheduler(engine, config, metrics,
-                                       tracer=tracer).start()
-    elif config.warmup:
-        engine.warmup()
+    cluster = None
     stream = None
-    if config.stream is not None:
-        from ..stream.runner import StreamRunner  # local: avoids an
-        # import cycle (stream.runner's engine builder imports this pkg)
-        stream = StreamRunner(engine, config.stream, metrics, tracer=tracer,
-                              scheduler=scheduler)
-        if config.stream_warmup and scheduler is None:
-            engine.warmup_stream(ladder=config.stream.ladder)
-    batcher = None
-    if scheduler is None:
-        batcher = DynamicBatcher(engine, config, metrics,
-                                 tracer=tracer).start()
+    if config.cluster is not None:
+        from .cluster import ClusterDispatcher, ReplicaSet
+
+        rset = ReplicaSet(model, variables, config, metrics, tracer=tracer)
+        cluster = ClusterDispatcher(rset, config, metrics, tracer=tracer)
+        engine = rset.engine
+        # The dispatcher fills whichever dispatch slot the mode uses —
+        # the HTTP layer's request paths are unchanged; per-replica
+        # batchers/schedulers live inside the replicas.
+        scheduler = cluster if config.sched is not None else None
+        batcher = cluster if config.sched is None else None
+        if config.stream is not None:
+            stream = cluster  # sticky session routing via the dispatcher
+
+        def warm():
+            rset.warmup()
+    else:
+        engine = BatchEngine(model, variables, config, metrics)
+        scheduler = None
+        if config.sched is not None:
+            # Iteration-level continuous batching: the scheduler IS the
+            # dispatch path — the micro-batcher is not started, admission
+            # control lives in scheduler.submit, and session frames ride
+            # the same scheduler as high-priority short jobs.  Warmth is
+            # the four phase executables per bucket, not per iteration
+            # level.
+            scheduler = IterationScheduler(engine, config, metrics,
+                                           tracer=tracer).start()
+        if config.stream is not None:
+            from ..stream.runner import StreamRunner  # local: avoids an
+            # import cycle (stream.runner's engine builder imports this
+            # pkg)
+            stream = StreamRunner(engine, config.stream, metrics,
+                                  tracer=tracer, scheduler=scheduler)
+        batcher = None
+        if scheduler is None:
+            batcher = DynamicBatcher(engine, config, metrics,
+                                     tracer=tracer).start()
+
+        def warm():
+            if config.sched is not None:
+                if config.warmup:
+                    engine.warmup_sched(
+                        iters_per_step=config.sched.iters_per_step)
+            else:
+                if config.warmup:
+                    engine.warmup()
+                if config.stream is not None and config.stream_warmup:
+                    engine.warmup_stream(ladder=config.stream.ladder)
+
     server = StereoServer(config, engine, batcher, metrics, stream=stream,
-                          tracer=tracer, scheduler=scheduler)
+                          tracer=tracer, scheduler=scheduler,
+                          cluster=cluster, start_ready=False)
+
+    def warm_then_ready():
+        try:
+            warm()
+        except Exception:
+            # Live but never ready: probes keep failing readiness, the
+            # router keeps traffic away, and the operator sees why here.
+            logger.exception("warmup failed; server stays NOT READY")
+            return
+        server.mark_ready()
+
+    if warmup_async:
+        threading.Thread(target=warm_then_ready, daemon=True,
+                         name="serve-warmup").start()
+    else:
+        # Blocking path: a warmup failure must raise (a silent
+        # never-ready server would hang the caller's first request).
+        warm()
+        server.mark_ready()
     logger.info("serving on %s:%d (buckets=%s, max_batch=%d, iters=%d/%d, "
-                "stream=%s, sched=%s)",
+                "stream=%s, sched=%s, replicas=%s, ready=%s)",
                 config.host, server.port,
                 sorted(engine.compiled_keys) or "lazy",
                 config.max_batch_size, config.iters, config.degraded_iters,
                 list(config.stream.ladder) if config.stream else "off",
-                "on" if scheduler is not None else "off")
+                "on" if scheduler is not None else "off",
+                len(cluster.rset) if cluster is not None else "1 (single)",
+                server.is_ready)
     return server

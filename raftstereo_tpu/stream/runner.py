@@ -48,6 +48,9 @@ class StreamResult:
     update_ema: float
     latency_s: float
     included_compile: bool
+    # Which cluster replica answered (serve/cluster/dispatcher.py);
+    # None on the single-engine path.
+    replica: Optional[str] = None
 
 
 class StreamRunner:

@@ -1,0 +1,21 @@
+"""Shared retry backoff policy (stdlib-only, importable from the
+model-free router as well as the serving client).
+
+One formula for both ends of the failover story — the cluster router's
+backend failover (serve/cluster/router.py) and the client's
+retry-with-backoff (serve/client.py) — so tuning the schedule (base,
+growth, jitter range) cannot silently diverge between them.
+"""
+
+from __future__ import annotations
+
+import random
+
+__all__ = ["backoff_delay"]
+
+
+def backoff_delay(base_ms: float, attempt: int) -> float:
+    """Seconds to wait before retry ``attempt`` (0 = first retry):
+    exponential from ``base_ms``, with +-50% jitter to decorrelate
+    retry storms across concurrent callers."""
+    return (base_ms / 1000.0) * (2 ** attempt) * (0.5 + random.random())

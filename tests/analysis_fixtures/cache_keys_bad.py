@@ -35,3 +35,18 @@ class Engine:
             if key in self._compiled:   # iters_per_step missing: RSA401
                 continue
             self._dispatch(key, lambda: None)
+
+    def infer_replicated(self, pairs, iters, mode):
+        # Cluster replica path (serve/cluster/): the replica id may be in
+        # the key, but iters/mode must still reach it.
+        for replica in range(2):
+            key = (replica, 64, 96, iters)
+            self._dispatch(key, lambda: (pairs, mode))  # mode: RSA401
+
+    def warmup_replica_ladder(self, buckets, iters_list, precision):
+        for h, w in buckets:
+            for iters in iters_list:
+                key = (h, w, iters)
+                if key in self._compiled:   # precision missing: RSA401
+                    continue
+                self._dispatch(key, lambda: None)

@@ -41,3 +41,18 @@ class Engine:
             if key in self._compiled:
                 continue
             self._dispatch(key, lambda: None)
+
+    def infer_replicated(self, pairs, iters, mode):
+        # Cluster replica path (serve/cluster/): per-replica executables
+        # keyed by everything that selects a distinct program.
+        for replica in range(2):
+            key = (replica, 64, 96, iters, mode)
+            self._dispatch(key, lambda: pairs)
+
+    def warmup_replica_ladder(self, buckets, iters_list, precision):
+        for h, w in buckets:
+            for iters in iters_list:
+                key = (h, w, iters, precision)
+                if key in self._compiled:
+                    continue
+                self._dispatch(key, lambda: None)

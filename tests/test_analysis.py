@@ -91,7 +91,8 @@ class TestCacheKeys:
         findings = _run("cache_keys_bad.py")
         assert _codes_lines(findings) == [
             ("RSA401", 16), ("RSA402", 19), ("RSA401", 23),
-            ("RSA401", 30), ("RSA401", 35)]
+            ("RSA401", 30), ("RSA401", 35), ("RSA401", 44),
+            ("RSA401", 50)]
         assert "precision" in findings[0].message
         assert "mode" in findings[2].message
         # The scheduler's phase-executable keys (serve/engine.py): a step
@@ -99,11 +100,18 @@ class TestCacheKeys:
         # key omits it.
         assert "iters_per_step" in findings[3].message
         assert "iters_per_step" in findings[4].message
+        # The cluster-replica shapes (serve/cluster/): a per-replica key
+        # that drops mode, and a replica ladder warmup that drops
+        # precision.
+        assert "mode" in findings[5].message
+        assert "precision" in findings[6].message
 
     def test_good_fixture_is_clean(self):
         # Includes the phase-executable shapes: prologue (no key-relevant
         # params, shape-derived key), step keyed by iters_per_step, and a
-        # warmup loop whose membership test carries it.
+        # warmup loop whose membership test carries it — plus the
+        # cluster-replica shapes (replica id in the key is fine; every
+        # key-relevant param still reaches it).
         assert _run("cache_keys_good.py") == []
 
 

@@ -1,6 +1,12 @@
 """Parallel layer: mesh construction + multi-host helpers (single-process
 semantics on the virtual 8-device CPU mesh)."""
 
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
 import jax
 import numpy as np
 import pytest
@@ -8,8 +14,11 @@ import pytest
 from raftstereo_tpu.parallel import (DATA_AXIS, SPACE_AXIS, batch_sharded,
                                      global_batch_from_local, initialize,
                                      is_multiprocess, make_mesh,
-                                     process_local_batch, replicated,
-                                     shard_batch, spatial_sharded)
+                                     process_local_batch, replica_devices,
+                                     replicated, shard_batch,
+                                     spatial_sharded)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Known sharded-Pallas parity failures on this container (tracking: PR3
 # fault-tolerance note in CHANGES.md): its jax build removed the
@@ -49,6 +58,101 @@ class TestMesh:
         assert batch_sharded(mesh).spec == jax.sharding.PartitionSpec(DATA_AXIS)
         assert spatial_sharded(mesh).spec == jax.sharding.PartitionSpec(
             None, SPACE_AXIS)
+
+
+class TestMeshSubprocessDeviceCounts:
+    """Satellite (ISSUE 8): the non-trivial mesh shapes must hold at a
+    device count OTHER than the suite's fixed 8 — run a fresh
+    interpreter with ``--xla_force_host_platform_device_count=4`` (the
+    documented CPU fan-out knob, same one the replicated-serving tests
+    lean on) and assert mesh layout, sharding placement and
+    replica-device selection all behave at 4 devices."""
+
+    SCRIPT = textwrap.dedent("""
+        import json
+        import numpy as np
+        from raftstereo_tpu.utils.platform import apply_env_platform
+        assert apply_env_platform("cpu") == "cpu"
+        import jax
+        from raftstereo_tpu.parallel import (DATA_AXIS, SPACE_AXIS,
+            batch_sharded, make_mesh, replica_devices, shard_batch)
+
+        out = {"device_count": jax.device_count()}
+        mesh = make_mesh()
+        out["default_shape"] = [mesh.shape[DATA_AXIS],
+                                mesh.shape[SPACE_AXIS]]
+        m22 = make_mesh(data=2, space=2)
+        out["m22"] = [m22.shape[DATA_AXIS], m22.shape[SPACE_AXIS]]
+        m14 = make_mesh(data=1, space=4)
+        out["m14"] = [m14.shape[DATA_AXIS], m14.shape[SPACE_AXIS]]
+        try:
+            make_mesh(data=5)
+            out["oversub"] = "accepted"
+        except ValueError:
+            out["oversub"] = "rejected"
+        # Sharded placement is real: 8-row batch over data=4 puts a
+        # distinct 2-row shard on each of the 4 devices.
+        m = make_mesh(data=4)
+        (x,) = shard_batch(m, (np.arange(8 * 3, dtype=np.float32)
+                               .reshape(8, 3),))
+        shards = sorted((s.device.id, s.data.shape[0])
+                        for s in x.addressable_shards)
+        out["shards"] = shards
+        out["sharding_ok"] = x.sharding == batch_sharded(m)
+        # Replica devices: distinct, mesh-ordered, subset-able, bounded.
+        devs = replica_devices()
+        out["replicas_all"] = [d.id for d in devs]
+        out["replicas_2"] = [d.id for d in replica_devices(2)]
+        try:
+            replica_devices(5)
+            out["replica_oversub"] = "accepted"
+        except ValueError:
+            out["replica_oversub"] = "rejected"
+        print("RESULT " + json.dumps(out))
+    """)
+
+    def test_mesh_paths_at_four_devices(self):
+        env = os.environ.copy()
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-c", self.SCRIPT], capture_output=True,
+            text=True, env=env, cwd=REPO, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("RESULT ")][-1]
+        out = json.loads(line[len("RESULT "):])
+        assert out["device_count"] == 4
+        assert out["default_shape"] == [4, 1]
+        assert out["m22"] == [2, 2]
+        assert out["m14"] == [1, 4]
+        assert out["oversub"] == "rejected"
+        # One distinct 2-row shard per device.
+        assert out["shards"] == [[0, 2], [1, 2], [2, 2], [3, 2]]
+        assert out["sharding_ok"] is True
+        assert out["replicas_all"] == [0, 1, 2, 3]
+        assert out["replicas_2"] == [0, 1]
+        assert out["replica_oversub"] == "rejected"
+
+
+class TestReplicaDevices:
+    """replica_devices on the suite's own 8-device mesh (no subprocess):
+    the serve/cluster ReplicaSet placement contract."""
+
+    def test_distinct_mesh_ordered_devices(self):
+        devs = replica_devices(3)
+        assert len({d.id for d in devs}) == 3
+        assert [d.id for d in devs] == [d.id for d in replica_devices(3)]
+
+    def test_all_devices_default(self):
+        assert len(replica_devices()) == jax.device_count()
+
+    def test_bounds(self):
+        with pytest.raises(ValueError, match="replicas"):
+            replica_devices(0)
+        with pytest.raises(ValueError, match="devices"):
+            replica_devices(jax.device_count() + 1)
 
 
 class TestDistributed:
