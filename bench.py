@@ -772,6 +772,60 @@ def bench_sched(height: int, width: int, long_iters: int, max_batch: int,
     }
 
 
+def bench_gru(height: int, width: int, batch: int, iters: int, corr: str,
+              compute_dtype: str, reps: int, quick: bool):
+    """GRU-backend A/B smoke (mirrors --serve/--sched's shape policy):
+    the SAME weights through the test-mode forward with gru_backend
+    pinned to "xla" and to "fused" (ops/pallas_gru.py), reporting
+    per-pair time for both, the speedup, and the max |disparity| gap —
+    so the megakernel's flagship contribution and its numeric envelope
+    are measurable in one process.  --quick runs the tiny model with the
+    interpret-mode kernel on CPU (a parity smoke, not a perf number)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from raftstereo_tpu.config import RAFTStereoConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    rng = np.random.default_rng(0)
+    i1 = jnp.asarray(rng.integers(0, 255, (batch, height, width, 3)),
+                     jnp.float32)
+    i2 = jnp.asarray(rng.integers(0, 255, (batch, height, width, 3)),
+                     jnp.float32)
+    variables = None
+    out = {}
+    ups = {}
+    for backend in ("xla", "fused"):
+        cfg = RAFTStereoConfig(corr_implementation=corr,
+                               compute_dtype=compute_dtype,
+                               gru_backend=backend, **model_kw)
+        model = RAFTStereo(cfg)
+        if variables is None:   # shared weights: a real A/B
+            variables = model.init(jax.random.key(0), (height, width))
+        fn = jax.jit(lambda v, a, b, m=model: m.forward(
+            v, a, b, iters=iters, test_mode=True))
+        up = fn(variables, i1, i2)[1]
+        jax.block_until_ready(up)
+        ups[backend] = np.asarray(up, np.float32)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn(variables, i1, i2))
+        dt = (time.perf_counter() - t0) / max(reps, 1)
+        out[f"{backend}_ms_per_batch"] = round(dt * 1e3, 3)
+        out[f"{backend}_pairs_per_sec"] = round(batch / dt, 3)
+    out["speedup"] = round(out["xla_ms_per_batch"]
+                           / max(out["fused_ms_per_batch"], 1e-9), 3)
+    out["max_abs_diff"] = float(np.abs(ups["fused"] - ups["xla"]).max())
+    return out
+
+
 def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
                            reps: int) -> float:
     """Run the reference PyTorch model (random weights) on CPU at the same
@@ -870,6 +924,13 @@ def main() -> None:
                         "vs the monolithic micro-batcher path, reporting "
                         "short-job p50/p99 both ways (the head-of-line "
                         "blocking gap)")
+    p.add_argument("--gru", action="store_true",
+                   help="A/B the GRU step backends: the same weights "
+                        "through the test-mode forward with gru_backend "
+                        "pinned to 'xla' and to 'fused' (the Pallas "
+                        "megakernel, ops/pallas_gru.py), reporting both "
+                        "timings, the speedup and the max |disparity| "
+                        "gap; --quick = interpret-mode parity smoke")
     p.add_argument("--cluster", action="store_true",
                    help="benchmark replicated serving: N engine replicas "
                         "(one per device; --replicas, default 2) behind "
@@ -906,7 +967,7 @@ def main() -> None:
     # refuse to run while the static-analysis baseline has entries
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
     if args.quick or args.serve or args.stream or args.sched \
-            or args.cluster:
+            or args.cluster or args.gru:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -1068,6 +1129,34 @@ def main() -> None:
                       f"iter workload, iteration-level continuous batching",
             "value": summary["sched"]["short_p99_ms"],
             "unit": "ms",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
+
+    if args.gru:
+        h, w = args.height, args.width
+        batch = args.batch
+        reps = args.reps
+        if args.quick:
+            # Tiny model + shape: the fused kernel runs in interpret
+            # mode on CPU, so this is a parity smoke, not a perf
+            # number.  An explicitly given flag wins, same contract as
+            # --height everywhere else.
+            if not explicit_hw:
+                h, w = 64, 96
+            if not explicit_iters:
+                args.iters = 4
+            if not explicit_reps:
+                reps = 2
+        summary = bench_gru(h, w, batch, args.iters, args.corr,
+                            args.compute_dtype, reps, quick=args.quick)
+        record = {
+            "metric": f"gru fused-vs-xla pairs/sec @{w}x{h}, "
+                      f"{args.iters} GRU iters, batch {batch}",
+            "value": summary["fused_pairs_per_sec"],
+            "unit": "pairs/sec",
             "vs_baseline": 0.0,
         }
         record.update(summary)

@@ -17,9 +17,10 @@ which names flow into them (transitively through simple assignments and
 method reaches the key:
 
 * key-relevant = the parameter name contains ``iters``, ``mode``,
-  ``precision`` or ``dtype`` — the inputs that select a distinct
-  executable (shape inputs are carried by the bucket, which every key
-  already starts from).
+  ``precision``, ``dtype`` or ``backend`` — the inputs that select a
+  distinct executable (shape inputs are carried by the bucket, which
+  every key already starts from; ``backend`` covers kernel-backend
+  selectors like the fused-GRU ``gru_backend``, serve/engine.py).
 
 Codes:
 
@@ -39,7 +40,7 @@ from .core import Finding, SourceFile, qualname_of
 __all__ = ["check"]
 
 _METHOD_RE = re.compile(r"^(infer|warmup)_")
-_KEY_TOKENS = ("iters", "mode", "precision", "dtype")
+_KEY_TOKENS = ("iters", "mode", "precision", "dtype", "backend")
 _CACHE_ATTR_RE = re.compile(r"compiled|cache", re.IGNORECASE)
 _DISPATCH_RE = re.compile(r"dispatch", re.IGNORECASE)
 

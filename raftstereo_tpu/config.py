@@ -66,6 +66,16 @@ class RAFTStereoConfig:
     # so evaluations comparing runs across device counts can pin the path.
     fused_encoder: Optional[bool] = None
 
+    # Test-mode GRU step backend (ops/pallas_gru.py).  "auto" resolves to
+    # the fused Pallas megakernel (motion encoder + gru0 gates + flow head
+    # in one VMEM-resident kernel per iteration) on a single-device TPU
+    # backend and to the XLA reference step everywhere else; "fused"/"xla"
+    # pin one numeric path (the fused step matches the XLA step to fp32
+    # accumulation-order tolerance, not bitwise).  Train-mode tracing and
+    # device meshes always take the XLA step.  Serving executables are
+    # cache-keyed by the RESOLVED backend (serve/engine.py).
+    gru_backend: str = "auto"
+
     # Rematerialize each GRU iteration in the backward pass (jax.checkpoint
     # on the scan body): activation memory drops from O(iters) to O(1) at the
     # cost of one extra forward per iteration.  Required to fit the reference
@@ -80,6 +90,7 @@ class RAFTStereoConfig:
             "auto", "reg", "alt", "pallas", "pallas_alt"), self.corr_implementation
         assert self.corr_precision in (
             "highest", "high", "default"), self.corr_precision
+        assert self.gru_backend in ("auto", "fused", "xla"), self.gru_backend
         assert 1 <= self.n_gru_layers <= 3, self.n_gru_layers
         assert len(self.hidden_dims) >= self.n_gru_layers
 
@@ -739,6 +750,11 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                    default="highest",
                    help="MXU multiply precision for fp32 correlation matmuls "
                         "(highest=exact 6-pass, high=3-pass, default=1-pass)")
+    g.add_argument("--gru_backend", choices=["auto", "fused", "xla"],
+                   default="auto",
+                   help="test-mode GRU step backend: 'auto' = fused Pallas "
+                        "megakernel on single-device TPU, XLA elsewhere "
+                        "(ops/pallas_gru.py)")
     g.add_argument("--remat", action="store_true",
                    help="rematerialize each GRU iteration in backward: "
                         "O(1) activation memory instead of O(iters); "
@@ -759,5 +775,6 @@ def model_config_from_args(args: argparse.Namespace) -> RAFTStereoConfig:
         compute_dtype="bfloat16" if args.mixed_precision else "float32",
         corr_dtype=args.corr_dtype,
         corr_precision=args.corr_precision,
+        gru_backend=args.gru_backend,
         remat=args.remat,
     )

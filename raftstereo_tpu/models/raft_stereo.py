@@ -33,7 +33,7 @@ from ..ops.image import coords_grid_x
 from ..ops.upsample import convex_upsample
 from .encoders import BasicEncoder, MultiBasicEncoder
 from .layers import ResidualBlock, conv
-from .update import BasicMultiUpdateBlock
+from .update import BasicMultiUpdateBlock, _interp_to
 
 
 class ContextZQR(nn.Module):
@@ -199,7 +199,16 @@ class RAFTStereo:
         zqr_list = self.zqr.apply(self._split_vars(variables, "zqr"), inp_list)
         return net_list, zqr_list, fmap1, fmap2
 
-    def _corr_setup(self, update_vars: Dict, test_mode: bool):
+    def _use_fused_gru(self, test_mode: bool) -> bool:
+        """Whether this trace takes the fused GRU megakernel step
+        (ops/pallas_gru.py) — resolved once per forward and threaded
+        through ``_corr_setup`` and ``_step_body`` so the lookup policy
+        and the step body always agree."""
+        from ..ops.pallas_gru import use_fused_gru
+        return use_fused_gru(self.config.gru_backend, test_mode)
+
+    def _corr_setup(self, update_vars: Dict, test_mode: bool,
+                    fused: bool = False):
         """Static correlation-lookup policy shared by the monolithic and
         phase-split forwards: the volume dtype, whether the motion
         encoder's convc1 is fused into the lookup kernel (and its
@@ -218,7 +227,10 @@ class RAFTStereo:
         # forward), while fp32's module conv runs at flax default precision
         # — a different rounding than any Mosaic-loweable policy — and fp32
         # is the certified-parity path, which must keep one numeric form.
-        use_epi = (test_mode and self.dtype == jnp.bfloat16
+        # The fused GRU step subsumes the epilogue (convc1 runs inside the
+        # megakernel, which reads the correlation features exactly once),
+        # so it asks the lookup for RAW features instead.
+        use_epi = (test_mode and not fused and self.dtype == jnp.bfloat16
                    and corr_epilogue_active(cfg.corr_implementation))
         epi = (update_vars["params"]["encoder"]["convc1"] if use_epi
                else None)
@@ -229,15 +241,65 @@ class RAFTStereo:
         return corr_dtype, use_epi, epi, -(-cfg.cor_planes // 64) * 64
 
     def _step_body(self, update_vars: Dict, zqr_list, corr_fn, grid,
-                   test_mode: bool, use_epi: bool):
+                   test_mode: bool, use_epi: bool, fused: bool = False,
+                   out_channels: int = 0):
         """The per-iteration refinement body, identical between the
         monolithic ``forward`` scan and the scheduler's single-iteration
         step executable (``forward_step``) — sharing the code is what
-        makes the two paths bitwise-comparable."""
+        makes the two paths bitwise-comparable.
+
+        ``fused`` swaps the finest level (motion encoder + gru0 + flow
+        head) for the Pallas megakernel step (ops/pallas_gru.py); the
+        coarser GRU levels keep the module path — they run at 1/4 and
+        1/16 of the finest level's pixel count and update FIRST, exactly
+        as in the module's coarsest->finest call order, so the kernel
+        consumes the same upsampled coarser state the module would."""
         cfg = self.config
         dtype = self.dtype
         sf = cfg.slow_fast_gru
         n = cfg.n_gru_layers
+
+        if fused:
+            assert test_mode, "fused GRU step is test-mode only"
+            from ..ops.corr import resolve_implementation
+            from ..ops.pallas_gru import fused_update, pack_update_params
+            # The width the lookup actually emits: the pallas_alt backend
+            # zero-pads to the lane-friendly ``out_channels`` (from the
+            # caller's _corr_setup — the SAME call that built corr_fn);
+            # every other backend returns the natural cor_planes.
+            corr_width = (out_channels
+                          if resolve_implementation(cfg.corr_implementation)
+                          == "pallas_alt" else cfg.cor_planes)
+            ext_dim = cfg.hidden_dims[1] if n > 1 else 0
+            wpack = pack_update_params(update_vars["params"], corr_width,
+                                       ext_dim, dtype)
+            cz0, cr0, cq0 = zqr_list[0]
+
+            def fused_step(carry, _):
+                nets, d = carry
+                d = jax.lax.stop_gradient(d)
+                corr = corr_fn(grid + d)
+                nets = list(nets)
+                if n == 3 and sf:
+                    nets = self.update.apply(update_vars, nets, zqr_list,
+                                             iter2=True, iter1=False,
+                                             iter0=False, update=False)
+                if n >= 2 and sf:
+                    nets = self.update.apply(update_vars, nets, zqr_list,
+                                             iter2=(n == 3), iter1=True,
+                                             iter0=False, update=False)
+                if n >= 2:
+                    nets = self.update.apply(update_vars, nets, zqr_list,
+                                             iter2=(n == 3), iter1=True,
+                                             iter0=False, update=False)
+                ext = (_interp_to(nets[1], nets[0]) if n > 1 else None)
+                hnew, delta = fused_update(nets[0], ext, corr, d,
+                                           cz0, cr0, cq0, wpack)
+                nets[0] = hnew
+                d = d + delta[..., :1].astype(jnp.float32)
+                return (tuple(nets), d), None
+
+            return fused_step
 
         def step(carry, _):
             nets, d = carry
@@ -280,8 +342,9 @@ class RAFTStereo:
         net_list, zqr_list, fmap1, fmap2 = self._encode(variables, image1,
                                                         image2)
         update_vars = self._split_vars(variables, "update")
+        fused = self._use_fused_gru(test_mode)
         corr_dtype, use_epi, epi, out_channels = self._corr_setup(
-            update_vars, test_mode)
+            update_vars, test_mode, fused)
         corr_fn = make_corr_fn(cfg.corr_implementation, fmap1, fmap2,
                                cfg.corr_levels, cfg.corr_radius,
                                dtype=corr_dtype,
@@ -297,7 +360,8 @@ class RAFTStereo:
             disp = disp + flow_init.astype(jnp.float32)
 
         step = self._step_body(update_vars, zqr_list, corr_fn, grid,
-                               test_mode, use_epi)
+                               test_mode, use_epi, fused=fused,
+                               out_channels=out_channels)
         body = jax.checkpoint(step) if cfg.remat else step
         # ``unroll`` feeds lax.scan's unroll factor.  Perf-neutral by default
         # (1); bench.py's FLOP accounting compiles fully-unrolled variants
@@ -368,8 +432,10 @@ class RAFTStereo:
         scheduler's single-iteration step executable; test-mode only)."""
         cfg = self.config
         update_vars = self._split_vars(variables, "update")
+        fused = self._use_fused_gru(test_mode=True)
         _, use_epi, epi, out_channels = self._corr_setup(update_vars,
-                                                         test_mode=True)
+                                                         test_mode=True,
+                                                         fused=fused)
         corr_fn = corr_fn_from_state(cfg.corr_implementation, state["corr"],
                                      cfg.corr_levels, cfg.corr_radius,
                                      precision=cfg.corr_precision,
@@ -380,7 +446,8 @@ class RAFTStereo:
         b, h0, w0 = disp.shape[:3]
         grid = coords_grid_x(b, h0, w0)
         step = self._step_body(update_vars, state["zqr"], corr_fn, grid,
-                               test_mode=True, use_epi=use_epi)
+                               test_mode=True, use_epi=use_epi, fused=fused,
+                               out_channels=out_channels)
         (nets, disp), _ = jax.lax.scan(step, (tuple(state["nets"]), disp),
                                        None, length=iters)
         return dict(state, nets=tuple(nets), disp=disp)

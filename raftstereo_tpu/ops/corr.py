@@ -414,6 +414,34 @@ def corr_epilogue_active(implementation: str) -> bool:
             and resolve_implementation(implementation) == "pallas_alt")
 
 
+def _roundup(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _padded_level_widths(w: int, num_levels: int) -> Tuple[int, ...]:
+    """Per-level lane-padded W2 widths of a floor-halving pyramid whose
+    level-0 width is ``w`` — the static shape info the pre-flattened
+    Pallas corr states carry implicitly (level-0 W2 == the lookup
+    coordinates' W1 for stereo, so it never needs to be stored)."""
+    from .pallas_corr import LANE
+    widths = [w]
+    for _ in range(num_levels - 1):
+        widths.append(widths[-1] // 2)
+    return tuple(_roundup(x, LANE) for x in widths)
+
+
+def _pack_state_rows(x: jax.Array, hp: int, w_axis: int,
+                     w_to: int) -> jax.Array:
+    """Zero-pad a batch-leading (B, H, ...) array to (B, Hp, ...) rows and
+    ``w_axis`` to ``w_to`` — reshape/zero-pad only, so packed lookups are
+    bitwise-equal to the unpacked ones (padded rows/columns correlate to
+    exactly zero and are sliced off; asserted in tests/test_model.py)."""
+    widths = [(0, 0)] * x.ndim
+    widths[1] = (0, hp - x.shape[1])
+    widths[w_axis] = (0, w_to - x.shape[w_axis])
+    return jnp.pad(x, widths)
+
+
 def build_corr_state(implementation: str, fmap1: jax.Array,
                      fmap2: jax.Array, num_levels: int,
                      dtype=jnp.float32,
@@ -425,15 +453,22 @@ def build_corr_state(implementation: str, fmap1: jax.Array,
 
     Every leaf keeps the batch as its leading axis so per-slot selects
     (``jnp.where`` over a (B,) mask) compose requests into a running batch
-    without touching other slots' values.  The Pallas backends' flatten/
-    lane-pad relayout therefore happens per lookup instead of once here —
-    exact (reshape/zero-pad only), at some per-step HBM cost on TPU; the
-    GRU-megakernel roadmap item subsumes that cost.
+    without touching other slots' values.  For the Pallas backends the
+    kernels' flatten/lane-pad relayout is done HERE, once at the prologue:
+    levels are lane-padded and concatenated along W2, rows padded to the
+    kernel row block, all with the batch axis kept leading — so each
+    lookup through ``corr_fn_from_state`` performs only free reshapes
+    (merging the leading (B, Hp) axes) instead of re-copying the pyramid
+    per step.  The packing is reshape/zero-pad only and therefore exact
+    (asserted in tests/test_model.py); level widths are derived statically
+    from the lookup coordinates' W1 (``_padded_level_widths``).
 
     The arrays are built by the SAME ops as ``make_corr_fn`` at the same
     dtypes, so a lookup through ``corr_fn_from_state`` is bitwise-equal to
     the monolithic closure's (asserted in tests/test_sched.py).
     """
+    from .pallas_corr import _BLOCK_ROWS, _block_w1
+
     implementation = resolve_implementation(implementation)
     if implementation == "reg":
         volume = build_corr_volume(fmap1.astype(jnp.float32),
@@ -448,14 +483,31 @@ def build_corr_state(implementation: str, fmap1: jax.Array,
         volume = build_corr_volume(fmap1.astype(jnp.float32),
                                    fmap2.astype(jnp.float32), dtype=dtype,
                                    precision=precision)
-        return tuple(build_corr_pyramid(volume, num_levels))
+        pyr = build_corr_pyramid(volume, num_levels)
+        b, h, w1 = pyr[0].shape[:3]
+        hp = _roundup(h, _BLOCK_ROWS)
+        w1p = _roundup(w1, _block_w1(w1))
+        w2s = _padded_level_widths(w1, num_levels)
+        vcat = jnp.concatenate(
+            [jnp.pad(v, ((0, 0), (0, hp - h), (0, w1p - w1),
+                         (0, w2s[i] - v.shape[3])))
+             for i, v in enumerate(pyr)], axis=3)
+        return (vcat,)
     if implementation == "pallas_alt":
-        # astype before the per-lookup flatten: elementwise, so the order
-        # swap vs make_pallas_alt_corr_fn's construct() is exact.
+        # astype before the pack: elementwise, so the order swap vs
+        # make_pallas_alt_corr_fn's construct() is exact.
         f1 = fmap1.astype(jnp.float32).astype(dtype)
         f2p = [x.astype(dtype) for x in
                build_fmap2_pyramid(fmap2.astype(jnp.float32), num_levels)]
-        return (f1,) + tuple(f2p)
+        b, h, w1 = f1.shape[:3]
+        hp = _roundup(h, _BLOCK_ROWS)
+        w1p = _roundup(w1, _block_w1(w1))
+        w2s = _padded_level_widths(w1, num_levels)
+        f1p = _pack_state_rows(f1, hp, 2, w1p)
+        f2cat = jnp.concatenate(
+            [_pack_state_rows(f2, hp, 2, w2s[i])
+             for i, f2 in enumerate(f2p)], axis=2)
+        return (f1p, f2cat)
     raise ValueError(f"unknown corr implementation: {implementation}")
 
 
@@ -480,26 +532,30 @@ def corr_fn_from_state(implementation: str, state: Sequence[jax.Array],
         fn = lambda coords: _alt_lookup(f1, f2p, radius, precision,  # noqa: E731
                                         coords)
     elif implementation == "pallas":
-        from .pallas_corr import (pad_vol_lane, pallas_lookup_pyramid_flat,
-                                  preflatten_volume)
-        volumes = tuple(state)
+        from .pallas_corr import pallas_lookup_pyramid_flat
+        (vcat4,) = state     # (B, Hp, W1p, sum(w2s)) — pre-packed
         offsets = _tap_offsets(radius)
 
         def fn(coords):
-            pyr = [pad_vol_lane(preflatten_volume(v)) for v in volumes]
-            w2s = tuple(v.shape[2] for v in pyr)
-            vcat = jnp.concatenate(pyr, axis=2)
             x = coords[..., 0].astype(jnp.float32)
+            b, h, w1 = x.shape
+            hp = vcat4.shape[1]
+            w2s = _padded_level_widths(w1, num_levels)
+            assert sum(w2s) == vcat4.shape[3], (w2s, vcat4.shape)
             taps = jnp.concatenate(
                 [x[..., None] / (2.0 ** i) + offsets
                  for i in range(len(w2s))], axis=-1)
-            return pallas_lookup_pyramid_flat(vcat, taps, w2s)
+            if hp != h:   # row pad mirrors the packed state's
+                taps = jnp.pad(taps, ((0, 0), (0, hp - h), (0, 0), (0, 0)))
+            # Merging the leading (B, Hp) axes is a free row-major
+            # reinterpretation — the only per-lookup "relayout" left.
+            vflat = vcat4.reshape((-1,) + vcat4.shape[2:])
+            out = pallas_lookup_pyramid_flat(vflat, taps, w2s)
+            return out[:, :h] if hp != h else out
     elif implementation == "pallas_alt":
-        from .pallas_alt import (pad_w2_lane,
-                                 pallas_alt_pyramid_radial_epi_flat,
-                                 pallas_alt_pyramid_radial_flat,
-                                 preflatten_fmap1, preflatten_fmap2)
-        f1, f2_levels = state[0], tuple(state[1:])
+        from .pallas_alt import (pallas_alt_pyramid_radial_epi_flat,
+                                 pallas_alt_pyramid_radial_flat)
+        f1p4, f2cat4 = state  # (B, Hp, W1p, C), (B, Hp, sum(w2s), C)
         scales = tuple(1.0 / 2.0 ** i for i in range(num_levels))
         epi = None
         if epilogue is not None:
@@ -507,20 +563,27 @@ def corr_fn_from_state(implementation: str, state: Sequence[jax.Array],
                    epilogue["bias"].reshape(1, 1, -1).astype(out_dtype))
 
         def fn(coords):
-            f1flat = preflatten_fmap1(f1)
-            f2p = [pad_w2_lane(preflatten_fmap2(x)) for x in f2_levels]
-            w2s = tuple(f2.shape[1] for f2 in f2p)
-            f2cat = jnp.concatenate(f2p, axis=1)
-            xl = coords[..., 0].astype(jnp.float32)[..., None]
+            x = coords[..., 0].astype(jnp.float32)
+            b, h, w1 = x.shape
+            hp = f1p4.shape[1]
+            w2s = _padded_level_widths(w1, num_levels)
+            assert sum(w2s) == f2cat4.shape[2], (w2s, f2cat4.shape)
+            xl = x[..., None]
+            if hp != h:
+                xl = jnp.pad(xl, ((0, 0), (0, hp - h), (0, 0), (0, 0)))
+            f1flat = f1p4.reshape((-1,) + f1p4.shape[2:])
+            f2cat = f2cat4.reshape((-1,) + f2cat4.shape[2:])
             if epi is not None:
-                return pallas_alt_pyramid_radial_epi_flat(
+                out = pallas_alt_pyramid_radial_epi_flat(
                     f1flat, f2cat, xl, w2s, radius, epi[0], epi[1],
                     precision=precision, out_dtype=out_dtype,
                     level_scales=scales)
-            return pallas_alt_pyramid_radial_flat(
-                f1flat, f2cat, xl, w2s, radius, precision=precision,
-                out_dtype=out_dtype, out_channels=out_channels,
-                level_scales=scales)
+            else:
+                out = pallas_alt_pyramid_radial_flat(
+                    f1flat, f2cat, xl, w2s, radius, precision=precision,
+                    out_dtype=out_dtype, out_channels=out_channels,
+                    level_scales=scales)
+            return out[:, :h] if hp != h else out
         return fn
     else:
         raise ValueError(f"unknown corr implementation: {implementation}")

@@ -1,8 +1,8 @@
 """Shape-bucketed, padded-batch compiled inference engine.
 
 The serving analogue of ``eval/runner.Evaluator``: one compiled executable
-per (shape bucket, GRU iterations), reused across requests.  Three shape
-decisions keep the XLA compile count small and predictable:
+per (shape bucket, GRU iterations, GRU backend), reused across requests.
+Three shape decisions keep the XLA compile count small and predictable:
 
 * every image is padded with the SAME ``BucketPadder`` policy the Evaluator
   uses (divis_by alignment, then round-up to ``bucket_multiple``), so
@@ -35,6 +35,7 @@ import numpy as np
 
 from ..config import ServeConfig
 from ..ops.image import BucketPadder
+from ..ops.pallas_gru import resolve_gru_backend
 from .metrics import ServeMetrics
 
 logger = logging.getLogger(__name__)
@@ -61,6 +62,17 @@ class BatchEngine:
         self.variables = variables
         self.cfg = config
         self.metrics = metrics
+        # Resolved test-mode GRU step backend ("fused" Pallas megakernel
+        # or the "xla" reference step, ops/pallas_gru.py) — a MODE
+        # component of every executable cache key: the two backends
+        # compile different programs with different numerics, so a key
+        # that omitted it could serve one backend's executable to the
+        # other's request.  Resolved once per engine (platform + config
+        # are fixed for the engine's lifetime); immutable thereafter.
+        # (model=None: replica-lifecycle test stubs never dispatch — the
+        # reference backend keeps their keys well-formed.)
+        self.gru_backend = ("xla" if model is None
+                            else resolve_gru_backend(model.config))
         self._fns: Dict[object, object] = {}  # guarded_by: _lock
         # (keyed iters | ("stream", iters))
         self._lock = threading.RLock()
@@ -68,8 +80,9 @@ class BatchEngine:
         # must not block behind _lock, which is held across a whole device
         # dispatch (seconds) or compile (minutes).
         self._stats_lock = threading.Lock()
-        # Compiled keys: (h, w, iters) for the plain forward and
-        # (h, w, iters, "stream") for the warm-start (flow_init) forward.
+        # Compiled keys: (h, w, iters, gru_backend) for the plain
+        # forward and (h, w, iters, "stream", gru_backend) for the
+        # warm-start (flow_init) forward.
         self._compiled: Set[Tuple] = set()  # guarded_by: _stats_lock
         self.last_batch_runtime: float = float("nan")  # guarded_by: _lock
         self.last_included_compile: bool = True  # guarded_by: _lock
@@ -119,12 +132,13 @@ class BatchEngine:
     def is_warm(self, hw: Tuple[int, int], iters: int) -> bool:
         """Whether (bucket, iters) already has a compiled executable."""
         with self._stats_lock:
-            return (hw[0], hw[1], iters) in self._compiled
+            return (hw[0], hw[1], iters, self.gru_backend) in self._compiled
 
     def is_stream_warm(self, hw: Tuple[int, int], iters: int) -> bool:
         """Whether (bucket, iters) has a compiled WARM-START executable."""
         with self._stats_lock:
-            return (hw[0], hw[1], iters, "stream") in self._compiled
+            return (hw[0], hw[1], iters, "stream",
+                    self.gru_backend) in self._compiled
 
     def low_hw(self, hw: Tuple[int, int]) -> Tuple[int, int]:
         """The 1/factor grid a padded bucket's disparity field lives on —
@@ -203,7 +217,7 @@ class BatchEngine:
         Covers both iteration levels (normal + degraded) so flipping into
         graceful degradation under load never stalls the queue behind a
         compile — exactly the moment a compile is least affordable.
-        Returns the (h, w, iters) keys warmed.
+        Returns the (h, w, iters, gru_backend) keys warmed.
         """
         buckets = list(buckets or self.cfg.buckets)
         # sorted, not set-ordered: the default {iters, degraded_iters} set
@@ -215,7 +229,7 @@ class BatchEngine:
         for h, w in buckets:
             bh, bw = self.bucket_of((h, w, 3))
             for iters in iters_list:
-                key = (bh, bw, iters)
+                key = (bh, bw, iters, self.gru_backend)
                 # is_warm, not a bare `in self._compiled`: membership is
                 # guarded by _stats_lock (RSA301).
                 if self.is_warm((bh, bw), iters):
@@ -241,7 +255,7 @@ class BatchEngine:
             # sorted for reproducible compile order/logs, same policy as
             # ``warmup`` (the ladder is descending by construction).
             for iters in sorted(ladder):
-                key = (bh, bw, iters, "stream")
+                key = (bh, bw, iters, "stream", self.gru_backend)
                 if self.is_stream_warm((bh, bw), iters):
                     continue
                 zero = np.zeros((h, w, 3), np.float32)
@@ -303,7 +317,7 @@ class BatchEngine:
         ``(host_outputs, included_compile)`` — the flag is per-call, not
         read back from shared engine state, so concurrent callers cannot
         race each other's compile accounting."""
-        mode = "stream" if len(key) == 4 else "batch"
+        mode = "stream" if len(key) == 5 else "batch"
         labels = dict(bucket=f"{key[0]}x{key[1]}", iters=str(key[2]),
                       mode=mode)
         with self._lock:
@@ -344,7 +358,7 @@ class BatchEngine:
                     iters: int) -> List[np.ndarray]:
         """Run a coalesced batch; returns one (H, W) disparity per pair."""
         padders, hw, i1, i2, _ = self._pad_pairs(pairs)
-        key = (hw[0], hw[1], iters)
+        key = (hw[0], hw[1], iters, self.gru_backend)
         (flow_up,), _ = self._dispatch(
             key, lambda: [self._fn(iters)(self.variables, i1, i2)[1]])
         return [padder.unpad(flow_up[i:i + 1])[0, ..., 0]
@@ -383,7 +397,7 @@ class BatchEngine:
             fi = jnp.concatenate(inits, axis=0)
             if pad_rows:
                 fi = jnp.pad(fi, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
-        key = (hw[0], hw[1], iters, "stream")
+        key = (hw[0], hw[1], iters, "stream", self.gru_backend)
         (low, up), miss = self._dispatch(
             key, lambda: self._stream_fn(iters)(self.variables, i1, i2, fi))
         # .copy(): the low-res slice becomes long-lived session state; a
@@ -398,17 +412,18 @@ class BatchEngine:
     # The phase executables behind serve/sched/ (docs/serving.md): the
     # split forward runs as prologue -> step x N -> epilogue, with the
     # carried state device-resident between boundaries.  All four phases
-    # live in the same compile cache under arity-4 keys
-    # (h, w, iters_per_step, phase) — iters_per_step is 0 for the phases
-    # it cannot affect — so /healthz, the RSA401 checker and the warmup
-    # accounting see them like every other executable.
+    # live in the same compile cache under arity-5 keys
+    # (h, w, iters_per_step, phase, gru_backend) — iters_per_step is 0
+    # for the phases it cannot affect — so /healthz, the RSA401 checker
+    # and the warmup accounting see them like every other executable.
 
     def _sched_keys(self, hw: Tuple[int, int],
                     iters_per_step: int) -> List[Tuple]:
-        return [(hw[0], hw[1], 0, "sched_prologue"),
-                (hw[0], hw[1], iters_per_step, "sched_step"),
-                (hw[0], hw[1], 0, "sched_epilogue"),
-                (hw[0], hw[1], 0, "sched_join")]
+        g = self.gru_backend
+        return [(hw[0], hw[1], 0, "sched_prologue", g),
+                (hw[0], hw[1], iters_per_step, "sched_step", g),
+                (hw[0], hw[1], 0, "sched_epilogue", g),
+                (hw[0], hw[1], 0, "sched_join", g)]
 
     def is_sched_warm(self, hw: Tuple[int, int],
                       iters_per_step: int) -> bool:
@@ -498,7 +513,7 @@ class BatchEngine:
                     f"{(lh, lw)} (bucket {hw})")
                 fi[slot, :, :, 0] = init
         self._seg.pad = (t_pad0, time.perf_counter())
-        key = (hw[0], hw[1], 0, "sched_prologue")
+        key = (hw[0], hw[1], 0, "sched_prologue", self.gru_backend)
         state, miss = self._dispatch_state(
             key, lambda: self._sched_prologue_fn()(self.variables, i1, i2,
                                                    fi))
@@ -508,7 +523,8 @@ class BatchEngine:
                          iters_per_step: int):
         """Advance the running batch by one boundary (``iters_per_step``
         GRU iterations); returns ``(state, included_compile)``."""
-        key = (hw[0], hw[1], iters_per_step, "sched_step")
+        key = (hw[0], hw[1], iters_per_step, "sched_step",
+               self.gru_backend)
         return self._dispatch_state(
             key, lambda: self._sched_step_fn(iters_per_step)(
                 self.variables, state))
@@ -520,7 +536,7 @@ class BatchEngine:
         with self._device_ctx():  # the mask joins device-resident state
             m = jnp.asarray(mask, bool)
         assert m.shape == (self.cfg.max_batch_size,), m.shape
-        key = (hw[0], hw[1], 0, "sched_join")
+        key = (hw[0], hw[1], 0, "sched_join", self.gru_backend)
         return self._dispatch_state(
             key, lambda: self._sched_join_fn()(running, incoming, m))
 
@@ -529,7 +545,7 @@ class BatchEngine:
         ``(disp_low (B, H/f, W/f, 1), disp_up (B, H, W, 1),
         included_compile)`` — the scheduler unpads per leaving slot
         (``padder_of``)."""
-        key = (hw[0], hw[1], 0, "sched_epilogue")
+        key = (hw[0], hw[1], 0, "sched_epilogue", self.gru_backend)
         (low, up), miss = self._dispatch_state(
             key, lambda: self._sched_epilogue_fn()(self.variables, state))
         return (np.asarray(low, np.float32), np.asarray(up, np.float32),

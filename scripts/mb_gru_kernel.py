@@ -17,8 +17,14 @@ Variants:
   rowslab    — grid over R-row slabs + 2 halo rows per slab
   resident   — whole image resident in VMEM (H+2 zero-padded rows), grid=1
 
+``--fused`` instead runs the SHIPPED production megakernel
+(ops/pallas_gru.fused_update — motion encoder + gru0 gates + flow head)
+against its XLA reference at the same shapes, so microbench-vs-flagship
+divergence is measurable with the real kernel, not just the conv probe.
+
 Usage: python scripts/mb_gru_kernel.py [--h 136] [--w 240] [--cin 384]
                                        [--cout 256] [--reps 50] [--rows 8]
+       python scripts/mb_gru_kernel.py --fused [--hd 128] [--corr_ch 64]
 """
 
 from __future__ import annotations
@@ -41,6 +47,14 @@ def main():
     p.add_argument("--rows", type=int, default=8)
     p.add_argument("--reps", type=int, default=50)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--fused", action="store_true",
+                   help="bench the shipped ops/pallas_gru megakernel vs "
+                        "its XLA reference instead of the conv probes")
+    p.add_argument("--hd", type=int, default=128,
+                   help="--fused: gru0 hidden width")
+    p.add_argument("--corr_ch", type=int, default=64,
+                   help="--fused: correlation feature width as emitted by "
+                        "the lookup (pallas_alt lane pad)")
     args = p.parse_args()
 
     from raftstereo_tpu.utils import apply_env_platform
@@ -58,6 +72,9 @@ def main():
     H, W, CIN, COUT, R = args.h, args.w, args.cin, args.cout, args.rows
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     rng = np.random.default_rng(0)
+
+    if args.fused:
+        return _run_fused(args, jax, jnp, np, rng, H, W, dtype)
     x = jnp.asarray(rng.normal(size=(H, W, CIN)), dtype)
     # Weights in (dy, dx, CIN, COUT) order, flattened to (9, CIN, COUT).
     wts = jnp.asarray(rng.normal(size=(3, 3, CIN, COUT)) * 0.05, dtype)
@@ -211,6 +228,101 @@ def main():
         if y is not None and y_ref is not None:
             d = float(jnp.abs(y - y_ref).max())
             print(f"  max|{name} - xla| = {d:.3e}")
+
+
+def _run_fused(args, jax, jnp, np, rng, H, W, dtype):
+    """Bench the production megakernel (ops/pallas_gru.fused_update) vs
+    its XLA reference at GRU-block shapes: one iteration's finest-level
+    update (motion encoder + gates + flow head), corr lookup excluded —
+    the same work the flagship loop pays per iteration per level-0 row."""
+    import time
+
+    from raftstereo_tpu.ops import pallas_gru as pg
+
+    hd, ck, ext = args.hd, args.corr_ch, args.hd
+    cor_planes = min(36, ck)
+
+    def arr(*shape, scale=0.05):
+        return jnp.asarray(rng.normal(size=shape) * scale, dtype)
+
+    params = {
+        "encoder": {
+            "convc1": {"kernel": arr(1, 1, cor_planes, 64),
+                       "bias": arr(64)},
+            "convc2": {"kernel": arr(3, 3, 64, 64), "bias": arr(64)},
+            "convf1": {"kernel": arr(7, 7, 2, 64), "bias": arr(64)},
+            "convf2": {"kernel": arr(3, 3, 64, 64), "bias": arr(64)},
+            "conv": {"kernel": arr(3, 3, 128, 126), "bias": arr(126)},
+        },
+        "gru0": {
+            "convzr": {"kernel": arr(3, 3, hd + 128 + ext, 2 * hd),
+                       "bias": arr(2 * hd)},
+            "convq": {"kernel": arr(3, 3, hd + 128 + ext, hd),
+                      "bias": arr(hd)},
+        },
+        "flow_head": {
+            "conv1": {"kernel": arr(3, 3, hd, 256), "bias": arr(256)},
+            "conv2": {"kernel": arr(3, 3, 256, 2), "bias": arr(2)},
+        },
+    }
+    wpack = pg.pack_update_params(params, ck, ext, dtype)
+    h = arr(1, H, W, hd, scale=1.0)
+    e = arr(1, H, W, ext, scale=1.0)
+    corr = arr(1, H, W, ck, scale=1.0)
+    disp = jnp.asarray(rng.normal(size=(1, H, W, 1)), jnp.float32)
+    cz, cr, cq = (arr(1, H, W, hd, scale=1.0) for _ in range(3))
+
+    xin = hd + 128 + ext
+    flops = 2.0 * H * W * (cor_planes * 64 + 9 * 64 * 64 + 49 * 64
+                           + 9 * 64 * 64 + 9 * 128 * 126
+                           + 9 * xin * 2 * hd + 9 * xin * hd
+                           + 9 * hd * 256 + 9 * 256 * 2)
+
+    def run(f):
+        def g(hh):
+            hn, dl = f(hh, e, corr, disp, cz, cr, cq, wpack)
+            return hn + dl[..., :1]   # keep both outputs live
+        return g
+
+    def timed(name, f):
+        g = jax.jit(run(f))
+        lo = max(args.reps // 5, 1)
+
+        def loop(n):
+            def body(i, carry):
+                acc, hh = carry
+                y = g(hh)
+                s = y.astype(jnp.float32).sum()
+                return acc + s, hh + (s * 1e-30).astype(hh.dtype)
+            return jax.jit(lambda hh: jax.lax.fori_loop(
+                0, n, body, (jnp.float32(0), hh))[0])
+
+        f_hi, f_lo = loop(args.reps), loop(lo)
+        try:
+            float(f_hi(h)); float(f_lo(h))
+        except Exception as exc:  # noqa: BLE001 — report, keep going
+            print(f"{name:10s}: FAILED {type(exc).__name__}: "
+                  f"{str(exc)[:200]}")
+            return None
+
+        def once(fn):
+            t0 = time.perf_counter(); float(fn(h))
+            return time.perf_counter() - t0
+
+        t_hi = sorted(once(f_hi) for _ in range(3))[1]
+        t_lo = sorted(once(f_lo) for _ in range(3))[1]
+        dt = max(t_hi - t_lo, 1e-9) / max(args.reps - lo, 1)
+        print(f"{name:10s}: {dt*1e6:8.1f} us  {flops/dt/1e12:7.1f} TF/s",
+              flush=True)
+        return f(h, e, corr, disp, cz, cr, cq, wpack)
+
+    y_ref = timed("xla_ref", pg._xla_reference_update)
+    y_fused = timed("fused", pg.fused_update)
+    if y_ref is not None and y_fused is not None:
+        d = max(float(jnp.abs(a.astype(jnp.float32)
+                              - b.astype(jnp.float32)).max())
+                for a, b in zip(y_fused, y_ref))
+        print(f"  max|fused - xla_ref| = {d:.3e}")
 
 
 if __name__ == "__main__":

@@ -50,3 +50,15 @@ class Engine:
                 if key in self._compiled:   # precision missing: RSA401
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_fused_step(self, pairs, iters, gru_backend):
+        h, w = 64, 96
+        key = (h, w, iters)             # gru_backend NOT in the key
+        return self._dispatch(key, lambda: (pairs, gru_backend))  # RSA401
+
+    def warmup_gru_backends(self, buckets, iters, gru_backend):
+        for h, w in buckets:
+            key = (h, w, iters, "stream")
+            if key in self._compiled:   # gru_backend missing: RSA401
+                continue
+            self._dispatch(key, lambda: None)

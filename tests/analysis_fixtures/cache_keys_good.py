@@ -56,3 +56,17 @@ class Engine:
                 if key in self._compiled:
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_fused_step(self, pairs, iters, gru_backend):
+        # Kernel-backend selector (the fused-GRU mode param,
+        # serve/engine.py): a distinct compiled program per backend.
+        h, w = 64, 96
+        key = (h, w, iters, gru_backend)
+        return self._dispatch(key, lambda: pairs)
+
+    def warmup_gru_backends(self, buckets, iters, gru_backend):
+        for h, w in buckets:
+            key = (h, w, iters, "stream", gru_backend)
+            if key in self._compiled:
+                continue
+            self._dispatch(key, lambda: None)

@@ -41,3 +41,13 @@ def test_quick_mfu_extras():
 def test_data_mode_contract():
     r = _run(["--data", "--num_workers", "0", "--batch", "4"])
     assert r["unit"] == "samples/sec" and r["value"] > 0
+
+
+@pytest.mark.slow
+def test_gru_mode_contract():
+    r = _run(["--gru", "--quick"])
+    assert r["unit"] == "pairs/sec" and r["value"] > 0
+    assert {"xla_ms_per_batch", "fused_ms_per_batch", "speedup",
+            "max_abs_diff"} <= set(r)
+    import math
+    assert math.isfinite(r["max_abs_diff"])

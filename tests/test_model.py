@@ -379,3 +379,52 @@ class TestHeadFastForms:
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(mask_m), np.asarray(mask_ref),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestCorrStatePacking:
+    """build_corr_state's pre-flattened Pallas layouts (PR 9): the hoisted
+    relayout is reshape/zero-pad ONLY — exact by construction — and a
+    lookup through the packed state is bitwise-equal to the monolithic
+    closure's."""
+
+    @pytest.mark.parametrize("impl", ["pallas", "pallas_alt"])
+    def test_pack_is_reshape_zero_pad_only(self, rng, impl):
+        from raftstereo_tpu.ops import corr as C
+
+        b, h, w, c = 2, 11, 20, 16   # h not a row-block multiple,
+        f1 = jnp.asarray(rng.normal(size=(b, h, w, c)), jnp.float32)
+        f2 = jnp.asarray(rng.normal(size=(b, h, w, c)), jnp.float32)
+        state = C.build_corr_state(impl, f1, f2, 2)
+        for leaf in state:
+            assert leaf.shape[0] == b  # batch-leading (scheduler selects)
+        if impl == "pallas_alt":
+            f1p, f2cat = state
+            # Exactness: the original arrays are recoverable by slicing —
+            # every other element is exactly zero padding.
+            np.testing.assert_array_equal(np.asarray(f1p[:, :h, :w]),
+                                          np.asarray(f1))
+            np.testing.assert_array_equal(np.asarray(f2cat[:, :h, :w]),
+                                          np.asarray(f2))
+            rest = np.asarray(f2cat).copy()
+            rest[:, :h, :w] = 0
+            assert (rest[:, :, :w] == 0).all() and (rest[:, h:] == 0).all()
+        else:
+            (vcat,) = state
+            vol = C.build_corr_volume(f1, f2)
+            np.testing.assert_array_equal(np.asarray(vcat[:, :h, :w, :w]),
+                                          np.asarray(vol))
+
+    @pytest.mark.parametrize("impl", ["pallas", "pallas_alt"])
+    def test_packed_lookup_bitwise_equals_monolithic(self, rng, impl):
+        from raftstereo_tpu.ops import corr as C
+
+        b, h, w, c = 1, 11, 20, 16
+        f1 = jnp.asarray(rng.normal(size=(b, h, w, c)), jnp.float32)
+        f2 = jnp.asarray(rng.normal(size=(b, h, w, c)), jnp.float32)
+        coords = jnp.asarray(rng.uniform(-3, w + 3, size=(b, h, w, 1)),
+                             jnp.float32)
+        mono = C.make_corr_fn(impl, f1, f2, 2, 2)
+        state = C.build_corr_state(impl, f1, f2, 2)
+        packed = C.corr_fn_from_state(impl, state, 2, 2)
+        np.testing.assert_array_equal(np.asarray(mono(coords)),
+                                      np.asarray(packed(coords)))

@@ -1,0 +1,230 @@
+"""Fused GRU update megakernel (ops/pallas_gru.py): parity with the XLA
+reference step it replaces, in interpret mode on the CPU suite.
+
+The kernel's conv math is exact (the data-stationary formulation computes
+the same products); differences vs the XLA step come only from fp32
+accumulation ORDER (one fused fp32 accumulation per conv vs per-slice
+rounded convs), so parity is asserted to a documented tolerance, not
+bitwise — the default (XLA) path must stay bitwise-unchanged instead.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raftstereo_tpu.config import RAFTStereoConfig
+from raftstereo_tpu.models.raft_stereo import RAFTStereo
+from raftstereo_tpu.models.update import BasicMultiUpdateBlock
+from raftstereo_tpu.ops import pallas_gru as pg
+
+# fp32 accumulation-order tolerance: contractions are <= 384 deep, and
+# the kernel accumulates each conv once in fp32 where the XLA path
+# rounds per kernel-slice — observed max |diff| ~5e-6 on random inputs.
+TOL = dict(rtol=2e-4, atol=2e-5)
+
+
+def _tiny_cfg(n_gru_layers=2, **kw):
+    return RAFTStereoConfig(n_gru_layers=n_gru_layers,
+                            hidden_dims=(32, 32, 32)[:max(n_gru_layers, 2)],
+                            corr_levels=2, corr_radius=2, **kw)
+
+
+def _update_inputs(rng, cfg, b, h, w, hd):
+    """Random finest-level kernel inputs + a REAL update-block parameter
+    tree (so the pack sees production shapes/names)."""
+    shapes = [(h, w)]
+    for _ in range(cfg.n_gru_layers - 1):
+        shapes.append((-(-shapes[-1][0] // 2), -(-shapes[-1][1] // 2)))
+    net = [jnp.asarray(rng.normal(size=(b, lh, lw, hd)), jnp.float32)
+           for lh, lw in shapes]
+    zqr = [tuple(jnp.asarray(rng.normal(size=(b, lh, lw, hd)), jnp.float32)
+                 for _ in range(3)) for lh, lw in shapes]
+    corr = jnp.asarray(rng.normal(size=(b, h, w, cfg.cor_planes)),
+                       jnp.float32)
+    disp = jnp.asarray(rng.normal(size=(b, h, w, 1)), jnp.float32)
+    flow = jnp.concatenate([disp, jnp.zeros_like(disp)], -1)
+    blk = BasicMultiUpdateBlock(cfg)
+    variables = blk.init(jax.random.key(0), net, zqr, corr, flow)
+    return blk, variables, net, zqr, corr, disp, flow
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("h,w", [
+        (8, 12),    # single slab
+        (40, 9),    # multi-slab (starts 0, 8) + odd width
+        (33, 12),   # clamped last slab overlaps the first (starts 0, 1)
+    ])
+    def test_matches_packed_reference(self, rng, h, w):
+        """Kernel vs the XLA mirror of the SAME packed weights — covers
+        the slab plan, halo windows and image-edge masking: every slab
+        boundary is also a conv-halo boundary for some intermediate."""
+        cfg = _tiny_cfg()
+        hd = 32
+        _, v, net, zqr, corr, disp, _ = _update_inputs(rng, cfg, 2, h, w, hd)
+        wpack = pg.pack_update_params(v["params"], cfg.cor_planes, hd,
+                                      jnp.float32)
+        ext = jnp.asarray(rng.normal(size=net[0].shape), jnp.float32)
+        cz, cr, cq = zqr[0]
+        hn, dl = pg.fused_update(net[0], ext, corr, disp, cz, cr, cq, wpack)
+        hn_r, dl_r = pg._xla_reference_update(net[0], ext, corr, disp,
+                                              cz, cr, cq, wpack)
+        np.testing.assert_allclose(np.asarray(hn), np.asarray(hn_r), **TOL)
+        np.testing.assert_allclose(np.asarray(dl), np.asarray(dl_r), **TOL)
+
+    def test_matches_module_update_block(self, rng):
+        """Kernel vs the production module path (BasicMultiUpdateBlock
+        with the gru0-level flags the test-mode step uses)."""
+        cfg = _tiny_cfg()
+        hd = 32
+        blk, v, net, zqr, corr, disp, flow = _update_inputs(
+            rng, cfg, 1, 16, 12, hd)
+        from raftstereo_tpu.models.update import _interp_to
+        ext = _interp_to(net[1], net[0])
+        wpack = pg.pack_update_params(v["params"], cfg.cor_planes, hd,
+                                      jnp.float32)
+        cz, cr, cq = zqr[0]
+        hn, dl = pg.fused_update(net[0], ext, corr, disp, cz, cr, cq, wpack)
+        nets, mask, delta = blk.apply(v, list(net), zqr, corr, flow,
+                                      iter1=False, iter2=False,
+                                      with_mask=False)
+        assert mask is None
+        np.testing.assert_allclose(np.asarray(hn), np.asarray(nets[0]),
+                                   **TOL)
+        np.testing.assert_allclose(np.asarray(dl), np.asarray(delta), **TOL)
+
+    def test_single_level_no_ext(self, rng):
+        """n_gru_layers=1: the ext operand (and its weight slices) drop
+        out of the kernel entirely."""
+        cfg = _tiny_cfg(n_gru_layers=1)
+        hd = 32
+        blk, v, net, zqr, corr, disp, flow = _update_inputs(
+            rng, cfg, 1, 8, 12, hd)
+        wpack = pg.pack_update_params(v["params"], cfg.cor_planes, 0,
+                                      jnp.float32)
+        assert "wzr_e" not in wpack and "wq_e" not in wpack
+        cz, cr, cq = zqr[0]
+        hn, dl = pg.fused_update(net[0], None, corr, disp, cz, cr, cq,
+                                 wpack)
+        nets, _, delta = blk.apply(v, list(net), zqr, corr, flow,
+                                   iter1=False, iter2=False,
+                                   with_mask=False)
+        np.testing.assert_allclose(np.asarray(hn), np.asarray(nets[0]),
+                                   **TOL)
+        np.testing.assert_allclose(np.asarray(dl), np.asarray(delta), **TOL)
+
+    def test_gradients_are_the_reference_vjp(self, rng):
+        """custom_vjp backward == grads of the XLA reference formulation
+        (bitwise: the bwd IS that function's VJP at the saved primals)."""
+        cfg = _tiny_cfg()
+        hd = 32
+        _, v, net, zqr, corr, disp, _ = _update_inputs(rng, cfg, 1, 8, 12,
+                                                       hd)
+        wpack = pg.pack_update_params(v["params"], cfg.cor_planes, hd,
+                                      jnp.float32)
+        ext = jnp.asarray(rng.normal(size=net[0].shape), jnp.float32)
+        cz, cr, cq = zqr[0]
+
+        def loss(f):
+            def g(h, e, c, d, wp):
+                hn, dl = f(h, e, c, d, cz, cr, cq, wp)
+                return hn.sum() + (dl * 1.7).sum()
+            return g
+
+        args = (net[0], ext, corr, disp, wpack)
+        gk = jax.grad(loss(pg.fused_update), argnums=(0, 1, 2, 3, 4))(*args)
+        gr = jax.grad(loss(pg._xla_reference_update),
+                      argnums=(0, 1, 2, 3, 4))(*args)
+        for a, b in zip(jax.tree.leaves(gk), jax.tree.leaves(gr)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+
+class TestModelIntegration:
+    @pytest.mark.parametrize("n_gru_layers", [1, 2])
+    def test_forward_fused_vs_xla(self, rng, n_gru_layers):
+        """Full test-mode forward: the fused backend matches the XLA
+        step to tolerance at every output, including after 4 iterations
+        of feedback through the correlation lookup."""
+        cfg = _tiny_cfg(n_gru_layers=n_gru_layers)
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(0), (32, 48))
+        i1 = jnp.asarray(rng.integers(0, 255, (1, 32, 48, 3)), jnp.float32)
+        i2 = jnp.asarray(rng.integers(0, 255, (1, 32, 48, 3)), jnp.float32)
+        with pg.override_fused_gru(False):
+            low_x, up_x = model.forward(variables, i1, i2, iters=4,
+                                        test_mode=True)
+        with pg.override_fused_gru(True):
+            low_f, up_f = model.forward(variables, i1, i2, iters=4,
+                                        test_mode=True)
+        np.testing.assert_allclose(np.asarray(low_f), np.asarray(low_x),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(up_f), np.asarray(up_x),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_default_path_bitwise_unchanged(self, rng):
+        """On CPU the auto backend resolves to "xla" and must be the
+        IDENTICAL program — the PR 1/3/7 parity guarantees ride on it."""
+        assert not pg.use_fused_gru("auto", True)
+        assert pg.resolve_gru_backend(_tiny_cfg()) == "xla"
+        cfg_auto = _tiny_cfg()
+        cfg_xla = _tiny_cfg(gru_backend="xla")
+        model_a, model_x = RAFTStereo(cfg_auto), RAFTStereo(cfg_xla)
+        variables = model_a.init(jax.random.key(0), (32, 48))
+        i1 = jnp.asarray(rng.integers(0, 255, (1, 32, 48, 3)), jnp.float32)
+        i2 = jnp.asarray(rng.integers(0, 255, (1, 32, 48, 3)), jnp.float32)
+        a = model_a.forward(variables, i1, i2, iters=2, test_mode=True)
+        x = model_x.forward(variables, i1, i2, iters=2, test_mode=True)
+        for u, v in zip(a, x):
+            np.testing.assert_array_equal(np.asarray(u), np.asarray(v))
+
+    def test_forward_step_fused_parity(self, rng):
+        """Phase-split path: prologue -> fused steps -> epilogue matches
+        the fused monolithic forward (the scheduler's executables pick
+        up the same backend)."""
+        cfg = _tiny_cfg()
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(0), (32, 48))
+        i1 = jnp.asarray(rng.integers(0, 255, (1, 32, 48, 3)), jnp.float32)
+        i2 = jnp.asarray(rng.integers(0, 255, (1, 32, 48, 3)), jnp.float32)
+        with pg.override_fused_gru(True):
+            low_m, up_m = model.forward(variables, i1, i2, iters=3,
+                                        test_mode=True)
+            state = model.forward_prologue(variables, i1, i2)
+            for _ in range(3):
+                state = model.forward_step(variables, state, iters=1)
+            low_s, up_s = model.forward_epilogue(variables, state)
+        np.testing.assert_array_equal(np.asarray(low_s), np.asarray(low_m))
+        np.testing.assert_array_equal(np.asarray(up_s), np.asarray(up_m))
+
+
+class TestGate:
+    def test_cpu_auto_off_forced_on(self):
+        assert not pg.use_fused_gru("auto", True)
+        assert pg.use_fused_gru("fused", True)
+        assert not pg.use_fused_gru("xla", True)
+
+    def test_train_mode_always_xla(self):
+        assert not pg.use_fused_gru("fused", False)
+        assert not pg.use_fused_gru("auto", False)
+
+    def test_mesh_gates_off_loudly(self, monkeypatch):
+        """An active multi-device corr mesh disables the kernel — with a
+        warning when it was explicitly requested (a bare pallas_call
+        cannot be SPMD-partitioned)."""
+        import raftstereo_tpu.parallel.context as ctx
+
+        class _FakeMesh:
+            size = 2
+        monkeypatch.setattr(ctx, "active_corr_mesh", lambda: _FakeMesh())
+        with pytest.warns(RuntimeWarning, match="corr mesh"):
+            assert not pg.use_fused_gru("fused", True)
+        assert not pg.use_fused_gru("auto", True)
+
+    def test_config_wins_over_override(self):
+        """Explicit config backend beats the thread-local test scope —
+        the use_fused_stem precedence."""
+        with pg.override_fused_gru(True):
+            assert not pg.use_fused_gru("xla", True)
+        with pg.override_fused_gru(False):
+            assert pg.use_fused_gru("fused", True)

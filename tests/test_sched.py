@@ -298,8 +298,10 @@ class TestSchedEngine:
                            min_duration_s=0.5) as cold:
             warmed = engine.warmup_sched()
         assert sorted(warmed) == [
-            (64, 96, 0, "sched_epilogue"), (64, 96, 0, "sched_join"),
-            (64, 96, 0, "sched_prologue"), (64, 96, 1, "sched_step")]
+            (64, 96, 0, "sched_epilogue", "xla"),
+            (64, 96, 0, "sched_join", "xla"),
+            (64, 96, 0, "sched_prologue", "xla"),
+            (64, 96, 1, "sched_step", "xla")]
         # The step executable (the GRU body) is a model-scale compile:
         # if the 0.5 s floor ever rises above the real compile times, the
         # warm budget-0 guard below would pass vacuously — keep that loud.

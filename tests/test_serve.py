@@ -207,7 +207,8 @@ class TestEngine:
         eng = BatchEngine(model, variables, cfg)
         # Warmup compiles the configured bucket at BOTH iteration levels.
         warmed = eng.warmup()
-        assert sorted(warmed) == [(64, 96, 1), (64, 96, 2)]
+        assert sorted(warmed) == [(64, 96, 1, "xla"),
+                                  (64, 96, 2, "xla")]
         a, b = _img(60, 90, 1), _img(64, 96, 2)  # same 64x96 bucket
         eng.infer_batch([(a, a)], iters=2)
         assert not eng.last_included_compile  # warmup paid the compile
@@ -365,8 +366,8 @@ class TestEndToEnd:
             # above the real compile time, the warm budget-0 guards below
             # would pass vacuously — this assert makes that loud.
             assert cold_report.compiles == 2, cold_report.durations
-            assert server.engine.compiled_keys == {(64, 96, 3),
-                                                   (96, 128, 3)}
+            assert server.engine.compiled_keys == {
+                (64, 96, 3, "xla"), (96, 128, 3, "xla")}
             assert metrics.compile_misses.value == 2
 
             # (2) bitwise equality with the single-image Evaluator under
@@ -463,7 +464,7 @@ class TestEndToEnd:
             health = client.healthz()
             assert health["status"] == "ok"
             assert sorted(tuple(k) for k in health["compiled_buckets"]) \
-                == [(64, 96, 3), (96, 128, 3)]
+                == [(64, 96, 3, "xla"), (96, 128, 3, "xla")]
             client.close()
         finally:
             server.close()

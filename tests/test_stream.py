@@ -195,7 +195,8 @@ class TestEngineStream:
         # Mixed plain/stream compile keys coexist (and stay sortable for
         # /healthz).
         keys = eng.compiled_keys
-        assert (64, 96, 12) in keys and (64, 96, 12, "stream") in keys
+        assert (64, 96, 12, "xla") in keys
+        assert (64, 96, 12, "stream", "xla") in keys
         sorted(keys)
 
     def test_flow_init_shape_validated(self, stream_engine):
@@ -390,7 +391,8 @@ class TestEndToEnd:
                 assert health["stream"]["ladder"] == [12, 6]
                 assert health["stream"]["session_limit"] == 2
                 assert sorted({k[2] for k in map(
-                    tuple, health["compiled_buckets"]) if len(k) == 4}) == [6, 12]
+                    tuple, health["compiled_buckets"])
+                    if len(k) == 5 and k[3] == "stream"}) == [6, 12]
                 # Stream warmup compiled the two ladder levels; the session
                 # traffic above added none — the engine-level view of the
                 # budget the retrace guard just enforced for real.
