@@ -826,6 +826,63 @@ def bench_gru(height: int, width: int, batch: int, iters: int, corr: str,
     return out
 
 
+def bench_quant(height: int, width: int, batch: int, iters: int, corr: str,
+                reps: int, quick: bool):
+    """Accuracy-tier A/B smoke (mirrors --gru): the SAME weights through
+    the test-mode forward at each precision mode — fp32 (the certified
+    reference), bf16 (the 'fast' tier) and int8-corr+bf16 (the 'turbo'
+    tier, ops/quant.py) — reporting per-pair time for each, the speedups
+    over fp32 and the max |disparity| gap vs the fp32 reference, so the
+    quantized fast path's contribution and numeric envelope are
+    measurable in one process.  --quick runs the tiny model on CPU (a
+    parity smoke, not a perf number)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from raftstereo_tpu.config import RAFTStereoConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.ops.quant import MODES, config_for_mode
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    rng = np.random.default_rng(0)
+    i1 = jnp.asarray(rng.integers(0, 255, (batch, height, width, 3)),
+                     jnp.float32)
+    i2 = jnp.asarray(rng.integers(0, 255, (batch, height, width, 3)),
+                     jnp.float32)
+    base = RAFTStereoConfig(corr_implementation=corr, **model_kw)
+    variables = None
+    out = {}
+    ups = {}
+    for mode in MODES:
+        model = RAFTStereo(config_for_mode(base, mode))
+        if variables is None:   # shared weights: a real A/B
+            variables = model.init(jax.random.key(0), (height, width))
+        fn = jax.jit(lambda v, a, b, m=model: m.forward(
+            v, a, b, iters=iters, test_mode=True))
+        up = fn(variables, i1, i2)[1]
+        jax.block_until_ready(up)
+        ups[mode] = np.asarray(up, np.float32)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn(variables, i1, i2))
+        dt = (time.perf_counter() - t0) / max(reps, 1)
+        out[f"{mode}_ms_per_batch"] = round(dt * 1e3, 3)
+        out[f"{mode}_pairs_per_sec"] = round(batch / dt, 3)
+    for mode in ("bf16", "int8"):
+        out[f"{mode}_speedup_vs_fp32"] = round(
+            out["fp32_ms_per_batch"]
+            / max(out[f"{mode}_ms_per_batch"], 1e-9), 3)
+        out[f"{mode}_max_abs_diff_vs_fp32"] = float(
+            np.abs(ups[mode] - ups["fp32"]).max())
+    return out
+
+
 def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
                            reps: int) -> float:
     """Run the reference PyTorch model (random weights) on CPU at the same
@@ -931,6 +988,13 @@ def main() -> None:
                         "megakernel, ops/pallas_gru.py), reporting both "
                         "timings, the speedup and the max |disparity| "
                         "gap; --quick = interpret-mode parity smoke")
+    p.add_argument("--quant", action="store_true",
+                   help="A/B the accuracy-tier precision modes: the same "
+                        "weights through the test-mode forward at fp32, "
+                        "bf16 and int8-corr+bf16 (the serving tiers, "
+                        "ops/quant.py), reporting all three timings, the "
+                        "speedups over fp32 and the max |disparity| gaps; "
+                        "--quick = CPU parity smoke")
     p.add_argument("--cluster", action="store_true",
                    help="benchmark replicated serving: N engine replicas "
                         "(one per device; --replicas, default 2) behind "
@@ -967,7 +1031,7 @@ def main() -> None:
     # refuse to run while the static-analysis baseline has entries
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
     if args.quick or args.serve or args.stream or args.sched \
-            or args.cluster or args.gru:
+            or args.cluster or args.gru or args.quant:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -1156,6 +1220,35 @@ def main() -> None:
             "metric": f"gru fused-vs-xla pairs/sec @{w}x{h}, "
                       f"{args.iters} GRU iters, batch {batch}",
             "value": summary["fused_pairs_per_sec"],
+            "unit": "pairs/sec",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
+
+    if args.quant:
+        h, w = args.height, args.width
+        batch = args.batch
+        reps = args.reps
+        if args.quick:
+            # Tiny model + shape: the int8 path runs the XLA integer
+            # einsum on CPU, so this is a parity smoke, not a perf
+            # number.  An explicitly given flag wins, same contract as
+            # --height everywhere else.
+            if not explicit_hw:
+                h, w = 64, 96
+            if not explicit_iters:
+                args.iters = 4
+            if not explicit_reps:
+                reps = 2
+        summary = bench_quant(h, w, batch, args.iters, args.corr,
+                              reps, quick=args.quick)
+        record = {
+            "metric": f"quant tier A/B pairs/sec @{w}x{h}, "
+                      f"{args.iters} GRU iters, batch {batch} "
+                      f"(fp32 vs bf16 vs int8-corr)",
+            "value": summary["int8_pairs_per_sec"],
             "unit": "pairs/sec",
             "vs_baseline": 0.0,
         }

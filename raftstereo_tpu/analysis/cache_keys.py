@@ -17,10 +17,13 @@ which names flow into them (transitively through simple assignments and
 method reaches the key:
 
 * key-relevant = the parameter name contains ``iters``, ``mode``,
-  ``precision``, ``dtype`` or ``backend`` — the inputs that select a
-  distinct executable (shape inputs are carried by the bucket, which
-  every key already starts from; ``backend`` covers kernel-backend
-  selectors like the fused-GRU ``gru_backend``, serve/engine.py).
+  ``precision``, ``dtype``, ``backend``, ``accuracy``, ``tier`` or
+  ``quant`` — the inputs that select a distinct executable (shape inputs
+  are carried by the bucket, which every key already starts from;
+  ``backend`` covers kernel-backend selectors like the fused-GRU
+  ``gru_backend``, and ``accuracy``/``tier``/``quant`` the per-request
+  accuracy tiers whose precision mode joins every serving key,
+  serve/engine.py + ops/quant.py).
 
 Codes:
 
@@ -40,7 +43,8 @@ from .core import Finding, SourceFile, qualname_of
 __all__ = ["check"]
 
 _METHOD_RE = re.compile(r"^(infer|warmup)_")
-_KEY_TOKENS = ("iters", "mode", "precision", "dtype", "backend")
+_KEY_TOKENS = ("iters", "mode", "precision", "dtype", "backend",
+               "accuracy", "tier", "quant")
 _CACHE_ATTR_RE = re.compile(r"compiled|cache", re.IGNORECASE)
 _DISPATCH_RE = re.compile(r"dispatch", re.IGNORECASE)
 

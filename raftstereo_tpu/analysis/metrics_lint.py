@@ -59,10 +59,11 @@ def run_metrics_lint() -> List[Finding]:
     # Populate one child per labeled family (families render no samples
     # until first use) and validate the full exposition.
     serve.requests.labels(endpoint="predict", outcome="ok").inc()
+    serve.tier_requests.labels(tier="default").inc()
     serve.compile_misses.labels(bucket="64x96", iters="8",
-                                mode="batch").inc()
+                                mode="batch", tier="fp32").inc()
     serve.compile_hits.labels(bucket="64x96", iters="8",
-                              mode="stream").inc()
+                              mode="stream", tier="bf16").inc()
     serve.stream_cold_frames.labels(reason="new").inc()
     serve.latency.observe(0.01)
     cluster.set_states({"ready": 1})

@@ -1,0 +1,108 @@
+"""Certify serving accuracy tiers: measure per-tier EPE deltas vs fp32.
+
+    python -m raftstereo_tpu.cli.certify --restore_ckpt models/sf.pth \
+        --tiers fast turbo --out certification.json
+
+Runs the certification harness (eval/certify.py) on synthetic stereo
+pairs with exact ground truth and writes the certification manifest the
+server validates at startup (``cli.serve --tiers ... --cert_manifest``)
+before advertising a tier on ``/predict``.  Exits non-zero when any
+requested tier measures over its bound — wire it as the CI gate between
+"quantized kernels changed" and "tier deployed".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from ..config import add_model_args, model_config_from_args
+from .common import load_variables, setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def _parse_bound(text: str):
+    try:
+        tier, px = text.split("=")
+        bound = float(px)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"bound {text!r} is not TIER=PX (e.g. fast=0.5)")
+    if tier not in ("fast", "turbo"):
+        # A typo here must not silently fall back to the loose default
+        # bound — the override would be ignored and the tier certified
+        # against a 5x weaker gate than the operator asked for.
+        raise argparse.ArgumentTypeError(
+            f"bound tier {tier!r} is not certifiable (fast/turbo)")
+    return tier, bound
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", default=None,
+                   help=".pth or Orbax weights to certify (default: "
+                        "random weights — smoke/dev only)")
+    p.add_argument("--tiers", nargs="+", default=["fast", "turbo"],
+                   choices=["fast", "turbo"], metavar="TIER",
+                   help="tiers to measure ('certified' is the fp32 "
+                        "reference itself and needs no certificate)")
+    p.add_argument("--out", default="certification.json",
+                   help="manifest path the server's --cert_manifest reads")
+    p.add_argument("--cert_height", type=int, default=256)
+    p.add_argument("--cert_width", type=int, default=320)
+    p.add_argument("--cert_pairs", type=int, default=4,
+                   help="synthetic pairs in the certification set")
+    p.add_argument("--cert_iters", type=int, default=16,
+                   help="GRU iterations per certification forward")
+    p.add_argument("--cert_seed", type=int, default=0)
+    p.add_argument("--bound", type=_parse_bound, nargs="+", default=[],
+                   metavar="TIER=PX",
+                   help="override a tier's mean-EPE-delta bound in px "
+                        "(defaults: eval/certify.DEFAULT_BOUNDS)")
+    add_model_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    config = model_config_from_args(args)
+
+    import jax
+
+    from ..eval.certify import certify_tiers, write_manifest
+    from ..models import RAFTStereo
+
+    model = RAFTStereo(config)
+    if args.restore_ckpt:
+        variables = load_variables(args.restore_ckpt, config, model)
+        logger.info("Loaded checkpoint %s", args.restore_ckpt)
+    else:
+        variables = model.init(jax.random.key(0),
+                               (args.cert_height, args.cert_width))
+        logger.warning("No --restore_ckpt: certifying RANDOM weights "
+                       "(smoke/dev only — the manifest fingerprints the "
+                       "architecture, not the weights)")
+
+    manifest = certify_tiers(
+        config, variables, tuple(args.tiers),
+        hw=(args.cert_height, args.cert_width), n_pairs=args.cert_pairs,
+        iters=args.cert_iters, seed=args.cert_seed,
+        bounds=dict(args.bound) or None)
+    write_manifest(manifest, args.out)
+    summary = {tier: {k: e[k] for k in ("epe_delta", "bound", "certified")}
+               for tier, e in manifest["tiers"].items()}
+    print(json.dumps({"manifest": args.out, "tiers": summary}))
+    uncertified = [t for t, e in manifest["tiers"].items()
+                   if not e["certified"]]
+    if uncertified:
+        logger.error("tiers over bound: %s", uncertified)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-replay load: frames per synthetic video "
                         "session, sent with session_id/seq_no so the "
                         "server warm-starts them (docs/streaming.md)")
+    g.add_argument("--accuracy", default=None,
+                   choices=["certified", "fast", "turbo"],
+                   help="accuracy tier sent with every load-gen request "
+                        "(the server must advertise it; docs/serving.md "
+                        "\"Accuracy tiers\")")
     p.add_argument("--no_stream", action="store_true",
                    help="disable the session-aware streaming path "
                         "(session_id/seq_no on /predict)")
@@ -92,7 +97,8 @@ def run_loadgen(args) -> int:
         synthetic_pair_pool(h, w, n=min(8, args.requests)),
         requests=args.requests, concurrency=args.concurrency,
         mode="open" if args.open_rate else "closed", rate=args.open_rate,
-        iters=args.request_iters, sequence_len=args.sequence_len)
+        iters=args.request_iters, sequence_len=args.sequence_len,
+        accuracy=args.accuracy)
     print(json.dumps(stats))
     return 0
 

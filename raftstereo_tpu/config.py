@@ -57,6 +57,14 @@ class RAFTStereoConfig:
     # half the MXU cost), "default" (single bf16 pass).  Only consulted when
     # the inputs are fp32 — bf16 corr_dtype always takes the native path.
     corr_precision: str = "highest"
+    # Int8-quantized correlation volume (ops/quant.py): symmetric per-row
+    # int8 quantization of both feature maps, int8 x int8 -> int32
+    # all-pairs product, scales folded into the dequant epilogue.  Forces
+    # a precomputed-volume lookup backend (the on-demand backends would
+    # re-quantize per lookup); the serving "turbo" accuracy tier sets it
+    # via ops/quant.config_for_mode.  Inference-only numerics knob —
+    # training always runs unquantized.
+    corr_quant: bool = False
 
     # Fused Pallas encoder stem (ops/pallas_encoder.py).  None = auto
     # (enabled on TPU backends, incl. under a partitionable corr mesh via
@@ -434,6 +442,18 @@ class ServeConfig:
     # routing.  None keeps the single-engine path.
     cluster: Optional[ClusterConfig] = None
 
+    # Per-request accuracy tiers (ops/quant.py, docs/serving.md "Accuracy
+    # tiers"): tier names ("certified"/"fast"/"turbo") the server should
+    # OFFER on /predict's ``accuracy`` field.  "fast"/"turbo" are only
+    # ADVERTISED (accepted + warmed) when ``cert_manifest`` certifies
+    # their EPE delta within bound for this model (eval/certify.py;
+    # python -m raftstereo_tpu.cli.certify writes it) — an uncertified
+    # tier is refused with a clean 400, never served silently.  Empty =
+    # the historical single-precision server: any ``accuracy`` field is
+    # a 400 and no extra executables are compiled.
+    tiers: Tuple[str, ...] = ()
+    cert_manifest: Optional[str] = None
+
     # Observability (obs/, docs/observability.md): capacity of the span
     # ring buffer behind /debug/trace.  Spans are a few hundred bytes; the
     # ring bounds memory no matter the traffic.
@@ -443,6 +463,13 @@ class ServeConfig:
         if isinstance(self.buckets, list):
             object.__setattr__(
                 self, "buckets", tuple(tuple(b) for b in self.buckets))
+        if isinstance(self.tiers, list):
+            object.__setattr__(self, "tiers", tuple(self.tiers))
+        _known_tiers = ("certified", "fast", "turbo")  # ops/quant.TIERS
+        bad_tiers = [t for t in self.tiers if t not in _known_tiers]
+        assert not bad_tiers, (
+            f"unknown accuracy tiers {bad_tiers}; choose from "
+            f"{list(_known_tiers)}")
         # Degradation can only reduce work: a degraded_iters above iters
         # (e.g. the default 16 with --serve_iters 8) clamps down rather
         # than rejecting the config.
@@ -530,6 +557,17 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--trace_buffer", type=int, default=d.trace_buffer,
                    help="span ring-buffer capacity behind /debug/trace "
                         "(docs/observability.md)")
+    g.add_argument("--tiers", nargs="+", default=list(d.tiers),
+                   choices=["certified", "fast", "turbo"], metavar="TIER",
+                   help="accuracy tiers offered on /predict's 'accuracy' "
+                        "field (certified=fp32, fast=bf16, turbo=int8 "
+                        "corr + bf16); fast/turbo also need a "
+                        "--cert_manifest certifying their EPE delta "
+                        "(docs/serving.md \"Accuracy tiers\")")
+    g.add_argument("--cert_manifest", default=d.cert_manifest,
+                   help="certification manifest written by "
+                        "'python -m raftstereo_tpu.cli.certify'; "
+                        "validated at startup before a tier is advertised")
 
 
 def add_sched_args(parser: argparse.ArgumentParser) -> None:
@@ -720,6 +758,8 @@ def serve_config_from_args(args: argparse.Namespace,
         max_image_dim=args.max_image_dim,
         cold_buckets=not args.no_cold_buckets,
         trace_buffer=args.trace_buffer,
+        tiers=tuple(args.tiers),
+        cert_manifest=args.cert_manifest,
     )
 
 
@@ -750,6 +790,11 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                    default="highest",
                    help="MXU multiply precision for fp32 correlation matmuls "
                         "(highest=exact 6-pass, high=3-pass, default=1-pass)")
+    g.add_argument("--corr_quant", action="store_true",
+                   help="int8-quantized correlation volume (symmetric "
+                        "per-row scales, int8 matmul + dequant epilogue; "
+                        "ops/quant.py) — the 'turbo' serving tier's "
+                        "numeric policy, inference only")
     g.add_argument("--gru_backend", choices=["auto", "fused", "xla"],
                    default="auto",
                    help="test-mode GRU step backend: 'auto' = fused Pallas "
@@ -775,6 +820,7 @@ def model_config_from_args(args: argparse.Namespace) -> RAFTStereoConfig:
         compute_dtype="bfloat16" if args.mixed_precision else "float32",
         corr_dtype=args.corr_dtype,
         corr_precision=args.corr_precision,
+        corr_quant=args.corr_quant,
         gru_backend=args.gru_backend,
         remat=args.remat,
     )

@@ -1,0 +1,257 @@
+"""Accuracy-tier certification: measured EPE deltas vs the fp32 reference.
+
+The serving layer's accuracy tiers (ops/quant.py, docs/serving.md
+"Accuracy tiers") trade numerics for throughput — ``fast`` runs bf16,
+``turbo`` adds the int8-quantized correlation volume.  A tier is only
+worth offering if its accuracy cost is KNOWN and BOUNDED, so this module
+is the gate between "implemented" and "advertised":
+
+* :func:`certify_tiers` runs synthetic stereo pairs with exact ground
+  truth (data/synthetic.ShiftStereoDataset — matched textures, so the
+  correlation volume is genuinely informative) through the fp32
+  reference forward and through each tier's model (same weights, only
+  the numeric-policy config fields swapped), and records each tier's
+  mean-EPE delta against its bound;
+* the resulting **certification manifest** (JSON, written by
+  ``python -m raftstereo_tpu.cli.certify``) travels with the checkpoint;
+* :func:`resolve_tiers` is what the server calls at startup
+  (serve/server.build_server, serve/cluster/replica.py): a tier is
+  advertised on ``/predict`` only when the manifest certifies it for
+  this model — over-bound, missing, stale-architecture or unreadable
+  manifests all refuse the tier with a recorded reason (a request for it
+  is a clean 400, never a silently-degraded answer).
+
+The deltas are measured on synthetic data — they certify the numeric
+envelope of the tier's kernels, not benchmark leaderboard deltas; the
+bounds are deliberately loose screens against implementation regressions
+(a broken dequant shows up px-large), not sub-pixel accuracy claims.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.quant import (TIER_MODES, TIERS, config_for_mode,
+                         mode_for_accuracy)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DEFAULT_BOUNDS", "certify_tiers", "load_manifest",
+           "resolve_tiers", "tier_ok", "write_manifest"]
+
+MANIFEST_VERSION = 1
+
+# Default mean-EPE-delta bounds (px) per tier on the synthetic
+# certification set.  Loose by design: they catch implementation breakage
+# (a wrong dequant scale or a mis-keyed executable is pixels-large), while
+# the measured delta itself is recorded in the manifest for operators who
+# want tighter SLOs.
+DEFAULT_BOUNDS = {"fast": 0.5, "turbo": 1.0}
+
+# Model-config fields that must match between certification time and
+# serving time for the certificate to transfer: everything that changes
+# the traced program or its numerics APART from the three fields the tier
+# itself swaps (compute_dtype/corr_dtype/corr_quant — config_for_mode
+# overrides those identically on both sides, so base-config differences
+# there are irrelevant to the tier programs).  Backend selectors with
+# "auto" resolution (corr_implementation, gru_backend, fused_encoder)
+# are fingerprinted as the RAW config strings; their platform-dependent
+# resolution is covered by the separate platform check in tier_ok.
+ARCH_FIELDS = ("corr_levels", "corr_radius", "n_downsample", "n_gru_layers",
+               "hidden_dims", "slow_fast_gru", "shared_backbone",
+               "context_norm", "corr_implementation", "corr_precision",
+               "fused_encoder", "gru_backend")
+
+
+def _arch_of(config) -> Dict[str, object]:
+    d = dataclasses.asdict(config)
+    return {k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in d.items() if k in ARCH_FIELDS}
+
+
+def certify_tiers(config, variables, tiers: Sequence[str] = ("fast",
+                                                             "turbo"), *,
+                  hw: Tuple[int, int] = (64, 96), n_pairs: int = 4,
+                  iters: int = 12, seed: int = 0,
+                  bounds: Optional[Dict[str, float]] = None) -> Dict:
+    """Measure per-tier EPE deltas vs the fp32 reference and build the
+    certification manifest.
+
+    One batched test-mode forward per tier (fp32 reference included), all
+    at the same program shape so the comparison is apples-to-apples.
+    ``bounds`` overrides :data:`DEFAULT_BOUNDS` per tier.  The returned
+    manifest is self-contained: measured EPEs, deltas, bounds, the
+    certified verdicts, and the model-architecture fingerprint
+    :func:`tier_ok` later checks it against.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..data.synthetic import ShiftStereoDataset
+    from ..models.raft_stereo import RAFTStereo
+
+    bad = [t for t in tiers if t not in TIERS or t == "certified"]
+    assert not bad, (f"cannot certify tiers {bad}: choose from "
+                     f"{[t for t in TIERS if t != 'certified']}")
+    bounds = {**DEFAULT_BOUNDS, **(bounds or {})}
+    ds = ShiftStereoDataset(n=n_pairs, hw=hw, seed=seed)
+    lefts = np.stack([ds[i][1] for i in range(n_pairs)])
+    rights = np.stack([ds[i][2] for i in range(n_pairs)])
+    gts = np.stack([ds[i][3] for i in range(n_pairs)])   # (N, H, W, 1)
+
+    def run(mode: str) -> np.ndarray:
+        model = RAFTStereo(config_for_mode(config, mode))
+        fn = jax.jit(lambda v, a, b, m=model: m.forward(
+            v, a, b, iters=iters, test_mode=True)[1])
+        up = fn(variables, jnp.asarray(lefts), jnp.asarray(rights))
+        return np.asarray(up, np.float32)
+
+    ref = run("fp32")
+    epe_ref = float(np.mean(np.abs(ref - gts)))
+    entries: Dict[str, Dict] = {}
+    for tier in tiers:
+        pred = run(TIER_MODES[tier])
+        epe = float(np.mean(np.abs(pred - gts)))
+        delta = epe - epe_ref
+        bound = float(bounds[tier])
+        entries[tier] = {
+            "mode": TIER_MODES[tier],
+            "epe": round(epe, 6),
+            "epe_delta": round(delta, 6),
+            "bound": bound,
+            "max_abs_disp_diff": round(float(np.abs(pred - ref).max()), 6),
+            "certified": bool(delta <= bound),
+        }
+        logger.info("certify %s: epe %.4f (ref %.4f, delta %+.4f, bound "
+                    "%.3f) -> %s", tier, epe, epe_ref, delta, bound,
+                    "CERTIFIED" if entries[tier]["certified"]
+                    else "OVER BOUND")
+    return {
+        "version": MANIFEST_VERSION,
+        "created": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
+        # The platform the deltas were MEASURED on: "auto" backends and
+        # kernel selection resolve per platform, so a CPU-measured
+        # manifest must not certify the TPU kernels (tier_ok refuses).
+        "platform": jax.default_backend(),
+        "model": _arch_of(config),
+        "eval": {"hw": list(hw), "n_pairs": n_pairs, "iters": iters,
+                 "seed": seed, "epe_ref": round(epe_ref, 6),
+                 "data": "synthetic ShiftStereoDataset (exact GT)"},
+        "tiers": entries,
+    }
+
+
+def write_manifest(manifest: Dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_manifest(path: str) -> Dict:
+    """Parse + shape-check a manifest; raises ``ValueError`` on anything
+    that should refuse certification loudly (bad JSON, wrong version,
+    missing sections) rather than half-working."""
+    with open(path) as f:
+        try:
+            manifest = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"certification manifest {path!r} is not "
+                             f"valid JSON: {e}") from e
+    if not isinstance(manifest, dict) \
+            or manifest.get("version") != MANIFEST_VERSION \
+            or not isinstance(manifest.get("tiers"), dict):
+        raise ValueError(
+            f"certification manifest {path!r} has unsupported layout/"
+            f"version (want version {MANIFEST_VERSION} with a 'tiers' "
+            f"table)")
+    return manifest
+
+
+def tier_ok(manifest: Optional[Dict], tier: str,
+            model_config=None) -> Tuple[bool, str]:
+    """Whether ``manifest`` certifies ``tier`` (optionally for
+    ``model_config``'s architecture).  Returns ``(ok, reason)`` — the
+    reason is what the server records and returns in the 400."""
+    if tier not in TIER_MODES:
+        return False, f"unknown tier {tier!r}"
+    if manifest is None:
+        return False, "no certification manifest"
+    entry = manifest["tiers"].get(tier)
+    if entry is None:
+        return False, "tier not present in the certification manifest"
+    if not entry.get("certified"):
+        return False, (f"tier measured over bound (epe_delta "
+                       f"{entry.get('epe_delta')} > bound "
+                       f"{entry.get('bound')})")
+    delta, bound = entry.get("epe_delta"), entry.get("bound")
+    if not (isinstance(delta, (int, float)) and isinstance(bound,
+                                                           (int, float))
+            and delta <= bound):
+        # Belt-and-braces: a hand-edited certified=true with an
+        # over-bound delta must not advertise.
+        return False, (f"manifest inconsistent: epe_delta {delta!r} vs "
+                       f"bound {bound!r}")
+    plat = manifest.get("platform")
+    if plat is not None:
+        import jax
+
+        if plat != jax.default_backend():
+            # "auto" backends resolve per platform: deltas measured on
+            # CPU kernels say nothing about the TPU kernels /predict
+            # would actually run.
+            return False, (f"manifest measured on platform {plat!r}, "
+                           f"serving on {jax.default_backend()!r} — "
+                           f"re-certify on this platform")
+    if model_config is not None:
+        want = _arch_of(model_config)
+        have = manifest.get("model", {})
+        if have != want:
+            diff = sorted(k for k in want
+                          if have.get(k) != want[k])
+            return False, (f"manifest certifies a different model "
+                           f"architecture (mismatched: {diff})")
+    return True, "certified"
+
+
+def resolve_tiers(serve_cfg, model_config=None
+                  ) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """The server's startup gate: which requested tiers may be advertised.
+
+    Returns ``(advertised, refused)``: ``advertised`` maps tier name ->
+    precision mode (what /predict accepts and warmup compiles),
+    ``refused`` maps tier name -> the human-readable reason (what the
+    400 carries and /healthz reports).  ``certified`` needs no manifest —
+    it IS the fp32 reference the others are certified against."""
+    advertised: Dict[str, str] = {}
+    refused: Dict[str, str] = {}
+    if not serve_cfg.tiers:
+        return advertised, refused
+    manifest = None
+    manifest_err = None
+    if serve_cfg.cert_manifest:
+        try:
+            manifest = load_manifest(serve_cfg.cert_manifest)
+        except (OSError, ValueError) as e:
+            manifest_err = str(e)
+    for tier in serve_cfg.tiers:
+        if tier == "certified":
+            advertised[tier] = mode_for_accuracy(tier)
+            continue
+        if manifest is None:
+            refused[tier] = manifest_err or "no certification manifest " \
+                "(--cert_manifest; python -m raftstereo_tpu.cli.certify)"
+            continue
+        ok, reason = tier_ok(manifest, tier, model_config)
+        if ok:
+            advertised[tier] = mode_for_accuracy(tier)
+        else:
+            refused[tier] = reason
+    for tier, reason in refused.items():
+        logger.warning("accuracy tier %r NOT advertised: %s", tier, reason)
+    return advertised, refused

@@ -210,12 +210,16 @@ class RAFTStereo:
     def _corr_setup(self, update_vars: Dict, test_mode: bool,
                     fused: bool = False):
         """Static correlation-lookup policy shared by the monolithic and
-        phase-split forwards: the volume dtype, whether the motion
-        encoder's convc1 is fused into the lookup kernel (and its
-        parameters), and the lane-friendly channel pad."""
+        phase-split forwards: the volume dtype, the int8-quant gate,
+        whether the motion encoder's convc1 is fused into the lookup
+        kernel (and its parameters), and the lane-friendly channel pad."""
         cfg = self.config
         corr_dtype = (jnp.bfloat16 if cfg.corr_dtype == "bfloat16"
                       else jnp.float32)
+        # Int8-quantized volume (ops/quant.py): inference-only — the int8
+        # rounding defines no useful gradient, so train-mode traces always
+        # build the unquantized volume regardless of the config flag.
+        quant = bool(cfg.corr_quant) and test_mode
         # Test mode fuses the motion encoder's convc1 (1x1, cor_planes->64)
         # into the lookup kernel as a relu epilogue: the separate conv
         # re-read the correlation features at 75 GB/s (60 us/iter, round-5
@@ -231,18 +235,18 @@ class RAFTStereo:
         # megakernel, which reads the correlation features exactly once),
         # so it asks the lookup for RAW features instead.
         use_epi = (test_mode and not fused and self.dtype == jnp.bfloat16
-                   and corr_epilogue_active(cfg.corr_implementation))
+                   and corr_epilogue_active(cfg.corr_implementation, quant))
         epi = (update_vars["params"]["encoder"]["convc1"] if use_epi
                else None)
         # out_channels: the pallas_alt backend zero-pads the correlation
         # features to a lane-multiple-friendly width in-kernel (36 lanes
         # made the motion encoder's 1x1 conv fusion memory-bound); the
         # motion encoder's padded conv accepts either width.
-        return corr_dtype, use_epi, epi, -(-cfg.cor_planes // 64) * 64
+        return corr_dtype, use_epi, epi, -(-cfg.cor_planes // 64) * 64, quant
 
     def _step_body(self, update_vars: Dict, zqr_list, corr_fn, grid,
                    test_mode: bool, use_epi: bool, fused: bool = False,
-                   out_channels: int = 0):
+                   out_channels: int = 0, quant: bool = False):
         """The per-iteration refinement body, identical between the
         monolithic ``forward`` scan and the scheduler's single-iteration
         step executable (``forward_step``) — sharing the code is what
@@ -268,7 +272,8 @@ class RAFTStereo:
             # caller's _corr_setup — the SAME call that built corr_fn);
             # every other backend returns the natural cor_planes.
             corr_width = (out_channels
-                          if resolve_implementation(cfg.corr_implementation)
+                          if resolve_implementation(cfg.corr_implementation,
+                                                    quant)
                           == "pallas_alt" else cfg.cor_planes)
             ext_dim = cfg.hidden_dims[1] if n > 1 else 0
             wpack = pack_update_params(update_vars["params"], corr_width,
@@ -343,7 +348,7 @@ class RAFTStereo:
                                                         image2)
         update_vars = self._split_vars(variables, "update")
         fused = self._use_fused_gru(test_mode)
-        corr_dtype, use_epi, epi, out_channels = self._corr_setup(
+        corr_dtype, use_epi, epi, out_channels, quant = self._corr_setup(
             update_vars, test_mode, fused)
         corr_fn = make_corr_fn(cfg.corr_implementation, fmap1, fmap2,
                                cfg.corr_levels, cfg.corr_radius,
@@ -351,7 +356,7 @@ class RAFTStereo:
                                precision=cfg.corr_precision,
                                out_dtype=self.dtype,
                                out_channels=out_channels,
-                               epilogue=epi)
+                               epilogue=epi, quant=quant)
 
         h0, w0 = net_list[0].shape[1:3]
         grid = coords_grid_x(b, h0, w0)
@@ -361,7 +366,7 @@ class RAFTStereo:
 
         step = self._step_body(update_vars, zqr_list, corr_fn, grid,
                                test_mode, use_epi, fused=fused,
-                               out_channels=out_channels)
+                               out_channels=out_channels, quant=quant)
         body = jax.checkpoint(step) if cfg.remat else step
         # ``unroll`` feeds lax.scan's unroll factor.  Perf-neutral by default
         # (1); bench.py's FLOP accounting compiles fully-unrolled variants
@@ -412,11 +417,12 @@ class RAFTStereo:
         cfg = self.config
         net_list, zqr_list, fmap1, fmap2 = self._encode(variables, image1,
                                                         image2)
-        corr_dtype, _, _, _ = self._corr_setup(
+        corr_dtype, _, _, _, quant = self._corr_setup(
             self._split_vars(variables, "update"), test_mode=True)
         corr_state = build_corr_state(cfg.corr_implementation, fmap1, fmap2,
                                       cfg.corr_levels, dtype=corr_dtype,
-                                      precision=cfg.corr_precision)
+                                      precision=cfg.corr_precision,
+                                      quant=quant)
         b, h0, w0 = net_list[0].shape[:3]
         disp = jnp.zeros((b, h0, w0, 1), jnp.float32)
         if flow_init is not None:
@@ -433,21 +439,20 @@ class RAFTStereo:
         cfg = self.config
         update_vars = self._split_vars(variables, "update")
         fused = self._use_fused_gru(test_mode=True)
-        _, use_epi, epi, out_channels = self._corr_setup(update_vars,
-                                                         test_mode=True,
-                                                         fused=fused)
+        _, use_epi, epi, out_channels, quant = self._corr_setup(
+            update_vars, test_mode=True, fused=fused)
         corr_fn = corr_fn_from_state(cfg.corr_implementation, state["corr"],
                                      cfg.corr_levels, cfg.corr_radius,
                                      precision=cfg.corr_precision,
                                      out_dtype=self.dtype,
                                      out_channels=out_channels,
-                                     epilogue=epi)
+                                     epilogue=epi, quant=quant)
         disp = state["disp"]
         b, h0, w0 = disp.shape[:3]
         grid = coords_grid_x(b, h0, w0)
         step = self._step_body(update_vars, state["zqr"], corr_fn, grid,
                                test_mode=True, use_epi=use_epi, fused=fused,
-                               out_channels=out_channels)
+                               out_channels=out_channels, quant=quant)
         (nets, disp), _ = jax.lax.scan(step, (tuple(state["nets"]), disp),
                                        None, length=iters)
         return dict(state, nets=tuple(nets), disp=disp)

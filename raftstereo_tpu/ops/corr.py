@@ -103,13 +103,27 @@ def _reg_lookup(pyramid: Sequence[jax.Array], radius: int,
     return jnp.concatenate(out, axis=-1)
 
 
+def _build_volume(fmap1: jax.Array, fmap2: jax.Array, dtype, precision: str,
+                  quant: bool) -> jax.Array:
+    """The one volume-construction seam: fp32 einsum (``build_corr_volume``)
+    or the int8-quantized product with its dequant epilogue (ops/quant.py).
+    ``precision`` only applies to the fp32 path — the int8 accumulator is
+    exact integer arithmetic, there is no multiply precision to pick."""
+    if quant:
+        from .quant import quant_corr_volume
+        return quant_corr_volume(fmap1.astype(jnp.float32),
+                                 fmap2.astype(jnp.float32), dtype=dtype)
+    return build_corr_volume(fmap1.astype(jnp.float32),
+                             fmap2.astype(jnp.float32), dtype=dtype,
+                             precision=precision)
+
+
 def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                      radius: int, dtype=jnp.float32,
-                     precision: str = "highest") -> CorrFn:
+                     precision: str = "highest",
+                     quant: bool = False) -> CorrFn:
     """Precomputed-volume backend (reference: CorrBlock1D, core/corr.py:110-156)."""
-    volume = build_corr_volume(fmap1.astype(jnp.float32),
-                               fmap2.astype(jnp.float32), dtype=dtype,
-                               precision=precision)
+    volume = _build_volume(fmap1, fmap2, dtype, precision, quant)
     pyramid = build_corr_pyramid(volume, num_levels)
 
     return lambda coords: _reg_lookup(pyramid, radius, coords)
@@ -231,7 +245,8 @@ def _corr_shard_mesh(b: int, h: int):
 
 def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                         radius: int, dtype=jnp.float32,
-                        precision: str = "highest") -> CorrFn:
+                        precision: str = "highest",
+                        quant: bool = False) -> CorrFn:
     """Precomputed-pyramid backend with the Pallas TPU lookup kernel.
 
     Each pyramid level is flattened + W1-padded to the kernel's layout ONCE
@@ -246,9 +261,7 @@ def make_pallas_corr_fn(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                               preflatten_volume)
 
     def construct(f1, f2):
-        volume = build_corr_volume(f1.astype(jnp.float32),
-                                   f2.astype(jnp.float32), dtype=dtype,
-                                   precision=precision)
+        volume = _build_volume(f1, f2, dtype, precision, quant)
         # Lane-padded level concat along W2: every per-iteration lookup is
         # ONE kernel launch covering all levels (same as pallas_alt).
         pyr = [pad_vol_lane(preflatten_volume(v))
@@ -396,22 +409,33 @@ def make_pallas_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array,
 corr_epilogue_enabled = True
 
 
-def resolve_implementation(implementation: str) -> str:
+def resolve_implementation(implementation: str, quant: bool = False) -> str:
     """'auto' -> the fastest backend for the active platform.  The ONE
     resolver — make_corr_fn, corr_epilogue_active, and bench.py must agree,
     or the model could set corr_preact for a backend that ignores the
-    epilogue (skipping convc1 on raw features entirely)."""
+    epilogue (skipping convc1 on raw features entirely).
+
+    ``quant`` (the int8 corr volume, ops/quant.py) overrides the choice
+    to a PRECOMPUTED-VOLUME backend regardless of the configured one: the
+    int8 win is the one-shot volume matmul, and the on-demand backends
+    would re-quantize (and re-pay the int8 pack) at every lookup.  On TPU
+    that is the Pallas lookup kernel over the dequantized volume, the XLA
+    gather path elsewhere."""
+    if quant:
+        return "pallas" if jax.default_backend() == "tpu" else "reg"
     if implementation == "auto":
         return "pallas_alt" if jax.default_backend() == "tpu" else "reg"
     return implementation
 
 
-def corr_epilogue_active(implementation: str) -> bool:
+def corr_epilogue_active(implementation: str, quant: bool = False) -> bool:
     """Whether ``make_corr_fn`` would honor a convc1 ``epilogue`` for this
     implementation — the model consults this to decide if the motion
-    encoder's convc1 is fused into the lookup kernel (pallas_alt only)."""
+    encoder's convc1 is fused into the lookup kernel (pallas_alt only;
+    never under the quantized volume path, which resolves away from
+    pallas_alt)."""
     return (corr_epilogue_enabled
-            and resolve_implementation(implementation) == "pallas_alt")
+            and resolve_implementation(implementation, quant) == "pallas_alt")
 
 
 def _roundup(x: int, m: int) -> int:
@@ -445,7 +469,8 @@ def _pack_state_rows(x: jax.Array, hp: int, w_axis: int,
 def build_corr_state(implementation: str, fmap1: jax.Array,
                      fmap2: jax.Array, num_levels: int,
                      dtype=jnp.float32,
-                     precision: str = "highest") -> Tuple[jax.Array, ...]:
+                     precision: str = "highest",
+                     quant: bool = False) -> Tuple[jax.Array, ...]:
     """Backend-specific correlation state as a FLAT TUPLE of batch-leading
     arrays — the carried-state form of ``make_corr_fn``'s closure, for
     executables that split one request across several XLA programs (the
@@ -469,20 +494,16 @@ def build_corr_state(implementation: str, fmap1: jax.Array,
     """
     from .pallas_corr import _BLOCK_ROWS, _block_w1
 
-    implementation = resolve_implementation(implementation)
+    implementation = resolve_implementation(implementation, quant)
     if implementation == "reg":
-        volume = build_corr_volume(fmap1.astype(jnp.float32),
-                                   fmap2.astype(jnp.float32),
-                                   dtype=jnp.float32, precision=precision)
+        volume = _build_volume(fmap1, fmap2, jnp.float32, precision, quant)
         return tuple(build_corr_pyramid(volume, num_levels))
     if implementation == "alt":
         return ((fmap1.astype(jnp.float32),)
                 + tuple(build_fmap2_pyramid(fmap2.astype(jnp.float32),
                                             num_levels)))
     if implementation == "pallas":
-        volume = build_corr_volume(fmap1.astype(jnp.float32),
-                                   fmap2.astype(jnp.float32), dtype=dtype,
-                                   precision=precision)
+        volume = _build_volume(fmap1, fmap2, dtype, precision, quant)
         pyr = build_corr_pyramid(volume, num_levels)
         b, h, w1 = pyr[0].shape[:3]
         hp = _roundup(h, _BLOCK_ROWS)
@@ -514,16 +535,19 @@ def build_corr_state(implementation: str, fmap1: jax.Array,
 def corr_fn_from_state(implementation: str, state: Sequence[jax.Array],
                        num_levels: int, radius: int,
                        precision: str = "highest", out_dtype=jnp.float32,
-                       out_channels: int = 0, epilogue=None) -> CorrFn:
+                       out_channels: int = 0, epilogue=None,
+                       quant: bool = False) -> CorrFn:
     """Rebuild a lookup function over ``build_corr_state`` output.
 
-    Static parameters (radius/precision/out_*/epilogue) are passed per
-    call — the state itself is a pure array pytree, so it can live on
+    Static parameters (radius/precision/out_*/epilogue/quant) are passed
+    per call — the state itself is a pure array pytree, so it can live on
     device between step executables.  Semantics match ``make_corr_fn``
     for the same backend (the epilogue/out_channels knobs are honored
-    exactly where that function honors them: pallas_alt only).
+    exactly where that function honors them: pallas_alt only).  ``quant``
+    only steers implementation resolution — the state arrays are already
+    the DEQUANTIZED volume pyramid, so the lookups are the stock ones.
     """
-    implementation = resolve_implementation(implementation)
+    implementation = resolve_implementation(implementation, quant)
     if implementation == "reg":
         pyramid = tuple(state)
         fn = lambda coords: _reg_lookup(pyramid, radius, coords)  # noqa: E731
@@ -595,7 +619,8 @@ def corr_fn_from_state(implementation: str, state: Sequence[jax.Array],
 def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
                  num_levels: int, radius: int, dtype=jnp.float32,
                  precision: str = "highest", out_dtype=jnp.float32,
-                 out_channels: int = 0, epilogue=None) -> CorrFn:
+                 out_channels: int = 0, epilogue=None,
+                 quant: bool = False) -> CorrFn:
     """Backend dispatch (reference: core/raft_stereo.py:90-100).
 
     ``auto`` resolves to the fastest backend for the active platform: the
@@ -611,17 +636,25 @@ def make_corr_fn(implementation: str, fmap1: jax.Array, fmap2: jax.Array,
     ``out_channels`` (> num_levels*(2r+1)) asks the pallas_alt backend to
     zero-pad the channel axis in-kernel to a lane-friendly width; other
     backends return the natural width (consumers must accept both — the
-    motion encoder's padded 1x1 conv does)."""
-    implementation = resolve_implementation(implementation)
+    motion encoder's padded 1x1 conv does).
+
+    ``quant`` swaps the volume construction for the int8-quantized
+    product (ops/quant.py) and forces a precomputed-volume backend (see
+    ``resolve_implementation``) — lookups over the dequantized volume
+    are the stock ones, so monolithic, stream and phase-split callers
+    all share the same quantized numerics."""
+    implementation = resolve_implementation(implementation, quant)
     if implementation == "reg":
         fn = make_reg_corr_fn(fmap1, fmap2, num_levels, radius,
-                              dtype=jnp.float32, precision=precision)
+                              dtype=jnp.float32, precision=precision,
+                              quant=quant)
     elif implementation == "alt":
         fn = make_alt_corr_fn(fmap1, fmap2, num_levels, radius,
                               precision=precision)
     elif implementation == "pallas":
         fn = make_pallas_corr_fn(fmap1, fmap2, num_levels, radius,
-                                 dtype=dtype, precision=precision)
+                                 dtype=dtype, precision=precision,
+                                 quant=quant)
     elif implementation == "pallas_alt":
         return make_pallas_alt_corr_fn(fmap1, fmap2, num_levels, radius,
                                        dtype=dtype, precision=precision,

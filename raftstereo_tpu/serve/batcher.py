@@ -129,20 +129,26 @@ class _Request:
     # Trace id of the originating request (obs/trace.py): the dispatch
     # worker reconstructs queue-wait/dispatch/host-fetch spans under it.
     trace_id: Optional[str] = None
+    # Resolved precision mode of the request's accuracy tier
+    # (ops/quant.py; None = the engine's default path).
+    mode: Optional[str] = None
 
 
-# Group key: (bucket_h, bucket_w, explicit iters or None).  Requests with an
-# explicit per-request iteration count cannot share a batch with adaptive
-# ones — iters is baked into the compiled executable.
-_Key = Tuple[int, int, Optional[int]]
+# Group key: (bucket_h, bucket_w, explicit iters or None, precision mode
+# or None).  Requests with an explicit per-request iteration count cannot
+# share a batch with adaptive ones — iters is baked into the compiled
+# executable — and neither can requests of different accuracy tiers: the
+# mode selects a different program with different numerics.
+_Key = Tuple[int, int, Optional[int], Optional[str]]
 
 
 class DynamicBatcher:
     """Thread-safe request queue + single dispatch worker over an engine.
 
     The engine contract is ``bucket_of(shape) -> (h, w)`` and
-    ``infer_batch(pairs, iters) -> [disparity]`` (see engine.BatchEngine;
-    tests substitute stubs).
+    ``infer_batch(pairs, iters, mode=None) -> [disparity]`` (see
+    engine.BatchEngine; tests substitute stubs — ``mode`` is the
+    request's resolved precision mode, always passed by keyword).
     """
 
     def __init__(self, engine, config: ServeConfig,
@@ -202,16 +208,19 @@ class DynamicBatcher:
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                iters: Optional[int] = None,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None,
+               mode: Optional[str] = None) -> Future:
         """Enqueue one stereo pair; returns a ``Future`` for the result.
 
         Raises ``Overloaded`` immediately when the queue is at
         ``queue_limit`` — the caller maps this to HTTP 503 so clients see a
         clear shed signal instead of an unbounded wait.  ``trace_id`` tags
         the request's spans (queue wait, dispatch, host fetch) in the
-        tracer ring.
+        tracer ring.  ``mode`` is the request's resolved precision mode
+        (accuracy tier): it joins the grouping key, so tiers never share
+        a dispatched batch.
         """
-        key: _Key = (*self.engine.bucket_of(image1.shape), iters)
+        key: _Key = (*self.engine.bucket_of(image1.shape), iters, mode)
         fut = Future()
         with self._cv:
             if self._closed:
@@ -223,7 +232,7 @@ class DynamicBatcher:
             self._seq += 1
             self._queues.setdefault(key, collections.deque()).append(
                 _Request(image1, image2, iters, fut, time.perf_counter(),
-                         self._seq, trace_id))
+                         self._seq, trace_id, mode))
             self._depth += 1
             self.metrics.queue_depth.set(self._depth)
             self._cv.notify_all()
@@ -332,7 +341,8 @@ class DynamicBatcher:
         t_run0 = time.perf_counter()
         try:
             disps = self.engine.infer_batch(
-                [(r.image1, r.image2) for r in alive], iters)
+                [(r.image1, r.image2) for r in alive], iters,
+                mode=key[3])
         except Exception as e:  # fail the batch, keep serving
             self.metrics.errors.inc(len(alive))
             if self.tracer is not None:

@@ -181,7 +181,8 @@ class ServeClient:
                 session_id: Optional[str] = None,
                 seq_no: Optional[int] = None,
                 deadline_ms: Optional[float] = None,
-                priority: Optional[str] = None
+                priority: Optional[str] = None,
+                accuracy: Optional[str] = None
                 ) -> Tuple[np.ndarray, Dict]:
         """One stereo pair -> ((H, W) disparity, meta dict).
 
@@ -190,14 +191,19 @@ class ServeClient:
         (docs/streaming.md).  ``seq_no`` is the frame's position in the
         stream; omit it for an in-order client.  ``deadline_ms`` /
         ``priority`` (high/normal/low) are honored by servers running the
-        iteration-level scheduler (``--sched``, docs/serving.md).  Raises
-        ``ServeError`` on any non-200 status (503 = shed / 504 = timeout
-        are expected under overload; callers count them).
+        iteration-level scheduler (``--sched``, docs/serving.md).
+        ``accuracy`` picks an advertised accuracy tier
+        (certified/fast/turbo, docs/serving.md "Accuracy tiers"); an
+        unadvertised tier is a 400.  Raises ``ServeError`` on any
+        non-200 status (503 = shed / 504 = timeout are expected under
+        overload; callers count them).
         """
         payload = {"left": encode_array(np.asarray(left, np.float32)),
                    "right": encode_array(np.asarray(right, np.float32))}
         if iters is not None:
             payload["iters"] = int(iters)
+        if accuracy is not None:
+            payload["accuracy"] = str(accuracy)
         if deadline_ms is not None:
             payload["deadline_ms"] = float(deadline_ms)
         if priority is not None:
@@ -278,7 +284,8 @@ def run_load(host: str, port: int,
              mode: str = "closed", rate: Optional[float] = None,
              iters: Optional[int] = None,
              sequence_len: Optional[int] = None,
-             timeout: float = 120.0, retries: int = 0) -> Dict:
+             timeout: float = 120.0, retries: int = 0,
+             accuracy: Optional[str] = None) -> Dict:
     """Drive ``requests`` pairs at the server; returns a stats dict.
 
     ``make_pair(i)`` supplies the i-th request's images (mix shapes to
@@ -353,7 +360,8 @@ def run_load(host: str, port: int,
                     try:
                         _, meta = client.predict(left, right, iters=iters,
                                                  session_id=session,
-                                                 seq_no=seq)
+                                                 seq_no=seq,
+                                                 accuracy=accuracy)
                     except ServeError as e:
                         kind = {503: "shed", 504: "timeout"}.get(e.status,
                                                                  "error")

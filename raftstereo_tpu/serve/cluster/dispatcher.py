@@ -190,11 +190,15 @@ class ClusterDispatcher:
                iters: Optional[int] = None, *,
                priority: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None,
+               mode: Optional[str] = None) -> Future:
         """Place one cold request on the least-loaded ready replica;
         spills to the next one when a replica sheds.  Signature covers
         both backend modes — ``priority``/``deadline_ms`` are only legal
-        under ``--sched`` (the HTTP layer already enforces that)."""
+        under ``--sched`` (the HTTP layer already enforces that);
+        ``mode`` (the resolved accuracy tier, ops/quant.py) is forwarded
+        verbatim — every replica warms the same tier set, so placement is
+        tier-blind."""
         with self._lock:
             if self._closed:
                 raise ShuttingDown("cluster dispatcher stopped")
@@ -209,10 +213,12 @@ class ClusterDispatcher:
                 if replica.scheduler is not None:
                     inner = replica.scheduler.submit(
                         image1, image2, iters=iters, priority=priority,
-                        deadline_ms=deadline_ms, trace_id=trace_id)
+                        deadline_ms=deadline_ms, trace_id=trace_id,
+                        mode=mode)
                 else:
                     inner = replica.batcher.submit(
-                        image1, image2, iters, trace_id=trace_id)
+                        image1, image2, iters, trace_id=trace_id,
+                        mode=mode)
             except Overloaded as e:
                 self._record(replica.name, "shed")
                 last_exc = e
@@ -252,7 +258,8 @@ class ClusterDispatcher:
 
     def step(self, session_id: str, seq_no: Optional[int],
              left: np.ndarray, right: np.ndarray,
-             trace_id: Optional[str] = None):
+             trace_id: Optional[str] = None,
+             mode: Optional[str] = None):
         """One session frame through its pinned replica (StreamRunner
         contract).  Raises the batcher exception types on backpressure,
         which the HTTP layer already maps to 503/504."""
@@ -266,7 +273,7 @@ class ClusterDispatcher:
         replica.begin_dispatch()
         try:
             res = replica.stream.step(session_id, seq_no, left, right,
-                                      trace_id=trace_id)
+                                      trace_id=trace_id, mode=mode)
         except (Overloaded, RequestTimedOut, ShuttingDown) as e:
             replica.end_dispatch(ok=True)  # backpressure, not a failure
             self._record(replica.name, _outcome_of(e))

@@ -183,20 +183,23 @@ class Replica:
 
     # ---------------------------------------------------------- lifecycle
 
-    def warmup(self) -> None:
+    def warmup(self, modes=None) -> None:
         """In-process ladder warmup, mirroring ``build_server``: compile
-        every configured bucket (and sched phases / stream ladder levels)
-        on THIS replica's device, then become routable."""
+        every configured bucket (and sched phases / stream ladder levels
+        / advertised accuracy-tier modes) on THIS replica's device, then
+        become routable."""
         try:
             if self.cfg.sched is not None:
                 if self.cfg.warmup:
                     self.engine.warmup_sched(
-                        iters_per_step=self.cfg.sched.iters_per_step)
+                        iters_per_step=self.cfg.sched.iters_per_step,
+                        modes=modes)
             else:
                 if self.cfg.warmup:
-                    self.engine.warmup()
+                    self.engine.warmup(modes=modes)
                 if self.cfg.stream is not None and self.cfg.stream_warmup:
-                    self.engine.warmup_stream(ladder=self.cfg.stream.ladder)
+                    self.engine.warmup_stream(ladder=self.cfg.stream.ladder,
+                                              modes=modes)
         except Exception as e:
             self.mark_failed(f"warmup failed: {e}")
             raise
@@ -269,20 +272,23 @@ class ReplicaSet:
             counts[r.state] += 1
         return counts
 
-    def warmup(self) -> None:
+    def warmup(self, modes=None) -> None:
         """Warm every replica; parallel by default (each engine owns its
         own lock and compile cache, so the warmups are independent).  A
         replica whose warmup fails is marked ``failed`` and skipped —
-        the set is usable as long as one replica became ready."""
+        the set is usable as long as one replica became ready.
+        ``modes`` (precision modes incl. advertised accuracy tiers,
+        build_server) is forwarded to every replica so tier warmth is
+        cluster-uniform."""
         if not self.cluster_cfg.warmup_parallel:
             for r in self.replicas:
                 try:
-                    r.warmup()
+                    r.warmup(modes=modes)
                 except Exception:
                     logger.exception("replica %s warmup failed", r.name)
             self._require_ready()
             return
-        threads = [threading.Thread(target=self._warm_one, args=(r,),
+        threads = [threading.Thread(target=self._warm_one, args=(r, modes),
                                     name=f"warmup-{r.name}", daemon=True)
                    for r in self.replicas]
         for t in threads:
@@ -291,9 +297,9 @@ class ReplicaSet:
             t.join()
         self._require_ready()
 
-    def _warm_one(self, replica: Replica) -> None:
+    def _warm_one(self, replica: Replica, modes=None) -> None:
         try:
-            replica.warmup()
+            replica.warmup(modes=modes)
         except Exception:  # already marked failed; keep the others going
             logger.exception("replica %s warmup failed", replica.name)
 

@@ -1,7 +1,8 @@
 """Shape-bucketed, padded-batch compiled inference engine.
 
 The serving analogue of ``eval/runner.Evaluator``: one compiled executable
-per (shape bucket, GRU iterations, GRU backend), reused across requests.
+per (shape bucket, GRU iterations, GRU backend, precision mode), reused
+across requests.
 Three shape decisions keep the XLA compile count small and predictable:
 
 * every image is padded with the SAME ``BucketPadder`` policy the Evaluator
@@ -36,6 +37,7 @@ import numpy as np
 from ..config import ServeConfig
 from ..ops.image import BucketPadder
 from ..ops.pallas_gru import resolve_gru_backend
+from ..ops.quant import MODES, config_for_mode, default_mode
 from .metrics import ServeMetrics
 
 logger = logging.getLogger(__name__)
@@ -73,15 +75,30 @@ class BatchEngine:
         # reference backend keeps their keys well-formed.)
         self.gru_backend = ("xla" if model is None
                             else resolve_gru_backend(model.config))
+        # Precision modes (ops/quant.py): every executable key carries the
+        # resolved mode ("fp32"/"bf16"/"int8") as its LAST component — the
+        # per-request ``accuracy`` tier compiles a different program with
+        # different numerics, so a key that omitted it could serve one
+        # tier's executable to another tier's request.  ``default_mode``
+        # is the base config's own numeric policy: requests without an
+        # ``accuracy`` field resolve to it and run the base model
+        # UNCHANGED (same executables, bitwise-identical results).
+        self.default_mode = ("fp32" if model is None
+                             else default_mode(model.config))
+        # mode -> RAFTStereo sharing ``variables`` (tier configs only
+        # change numeric-policy fields, so the fp32 weights apply to all;
+        # flax casts per-module at apply time).  Built lazily: a server
+        # with no tiers never constructs the extra models.
+        self._models = {self.default_mode: model}  # guarded_by: _lock
         self._fns: Dict[object, object] = {}  # guarded_by: _lock
-        # (keyed iters | ("stream", iters))
+        # (keyed (iters, mode) | ("stream", iters, mode) | sched phases)
         self._lock = threading.RLock()
         # Fine-grained lock for _compiled only: stat readers (/healthz)
         # must not block behind _lock, which is held across a whole device
         # dispatch (seconds) or compile (minutes).
         self._stats_lock = threading.Lock()
-        # Compiled keys: (h, w, iters, gru_backend) for the plain
-        # forward and (h, w, iters, "stream", gru_backend) for the
+        # Compiled keys: (h, w, iters, gru_backend, mode) for the plain
+        # forward and (h, w, iters, "stream", gru_backend, mode) for the
         # warm-start (flow_init) forward.
         self._compiled: Set[Tuple] = set()  # guarded_by: _stats_lock
         self.last_batch_runtime: float = float("nan")  # guarded_by: _lock
@@ -129,16 +146,21 @@ class BatchEngine:
         with self._stats_lock:
             return set(self._compiled)
 
-    def is_warm(self, hw: Tuple[int, int], iters: int) -> bool:
-        """Whether (bucket, iters) already has a compiled executable."""
+    def is_warm(self, hw: Tuple[int, int], iters: int,
+                mode: Optional[str] = None) -> bool:
+        """Whether (bucket, iters, mode) already has a compiled
+        executable."""
         with self._stats_lock:
-            return (hw[0], hw[1], iters, self.gru_backend) in self._compiled
+            return (hw[0], hw[1], iters, self.gru_backend,
+                    self._mode(mode)) in self._compiled
 
-    def is_stream_warm(self, hw: Tuple[int, int], iters: int) -> bool:
-        """Whether (bucket, iters) has a compiled WARM-START executable."""
+    def is_stream_warm(self, hw: Tuple[int, int], iters: int,
+                       mode: Optional[str] = None) -> bool:
+        """Whether (bucket, iters, mode) has a compiled WARM-START
+        executable."""
         with self._stats_lock:
-            return (hw[0], hw[1], iters, "stream",
-                    self.gru_backend) in self._compiled
+            return (hw[0], hw[1], iters, "stream", self.gru_backend,
+                    self._mode(mode)) in self._compiled
 
     def low_hw(self, hw: Tuple[int, int]) -> Tuple[int, int]:
         """The 1/factor grid a padded bucket's disparity field lives on —
@@ -146,53 +168,83 @@ class BatchEngine:
         f = self.model.config.factor
         return hw[0] // f, hw[1] // f
 
+    # -------------------------------------------------------- precision modes
+
+    def _mode(self, mode: Optional[str]) -> str:
+        """Resolve an optional precision mode to the concrete cache-key
+        component (None = the base config's own mode — the default path,
+        which may be the non-tier ``"base"`` token when the config's
+        numeric mix matches no canonical tier config)."""
+        if mode is None or mode == self.default_mode:
+            return self.default_mode
+        assert mode in MODES, f"unknown precision mode {mode!r}"
+        return mode
+
+    def _model_for(self, mode: str):  # guarded_by: _lock
+        """The model a precision mode traces with.  Tier models are the
+        base architecture with only the numeric-policy config fields
+        swapped (ops/quant.config_for_mode) and share ``self.variables``
+        — construction is pure Python module wiring, done once."""
+        model = self._models.get(mode)
+        if model is None:
+            from ..models.raft_stereo import RAFTStereo
+            model = self._models[mode] = RAFTStereo(
+                config_for_mode(self.model.config, mode))
+        return model
+
     # -------------------------------------------------------------- execution
 
-    def _fn(self, iters: int):  # guarded_by: _lock
-        if iters not in self._fns:
-            self._fns[iters] = jax.jit(
-                lambda v, a, b, it=iters: self.model.forward(
+    def _fn(self, iters: int, mode: str):  # guarded_by: _lock
+        key = (iters, mode)
+        if key not in self._fns:
+            model = self._model_for(mode)
+            self._fns[key] = jax.jit(
+                lambda v, a, b, it=iters, m=model: m.forward(
                     v, a, b, iters=it, test_mode=True))
-        return self._fns[iters]
+        return self._fns[key]
 
-    def _stream_fn(self, iters: int):  # guarded_by: _lock
+    def _stream_fn(self, iters: int, mode: str):  # guarded_by: _lock
         """Warm-start forward: takes a (B, H/f, W/f, 1) flow_init.  Cold
         frames pass zeros — bitwise-identical to the plain forward (tested
         in tests/test_model.py / tests/test_stream.py), so one executable
-        per (bucket, level) serves every frame of a stream."""
-        key = ("stream", iters)
+        per (bucket, level, mode) serves every frame of a stream."""
+        key = ("stream", iters, mode)
         if key not in self._fns:
-            self._fns[key] = self.model.jitted_infer_init(iters)
+            self._fns[key] = self._model_for(mode).jitted_infer_init(iters)
         return self._fns[key]
 
-    def _sched_prologue_fn(self):  # guarded_by: _lock
+    def _sched_prologue_fn(self, mode: str):  # guarded_by: _lock
         """Compiled phase 1/3 of the split forward (encode + corr build):
         (variables, img1, img2, flow_init) -> carried state.  Cold slots
         pass zero flow_inits — bitwise-identical to flow_init=None, so one
         executable serves plain requests and warm stream frames."""
-        key = ("sched", "prologue")
+        key = ("sched", "prologue", mode)
         if key not in self._fns:
+            model = self._model_for(mode)
             self._fns[key] = jax.jit(
-                lambda v, a, b, f: self.model.forward_prologue(
+                lambda v, a, b, f, m=model: m.forward_prologue(
                     v, a, b, flow_init=f))
         return self._fns[key]
 
-    def _sched_step_fn(self, iters_per_step: int):  # guarded_by: _lock
+    def _sched_step_fn(self, iters_per_step: int,
+                       mode: str):  # guarded_by: _lock
         """Compiled single-boundary step: advances the whole running batch
         by ``iters_per_step`` GRU iterations."""
-        key = ("sched", "step", iters_per_step)
+        key = ("sched", "step", iters_per_step, mode)
         if key not in self._fns:
+            model = self._model_for(mode)
             self._fns[key] = jax.jit(
-                lambda v, s, it=iters_per_step: self.model.forward_step(
+                lambda v, s, it=iters_per_step, m=model: m.forward_step(
                     v, s, iters=it))
         return self._fns[key]
 
-    def _sched_epilogue_fn(self):  # guarded_by: _lock
+    def _sched_epilogue_fn(self, mode: str):  # guarded_by: _lock
         """Compiled phase 3/3: final mask head + convex upsample."""
-        key = ("sched", "epilogue")
+        key = ("sched", "epilogue", mode)
         if key not in self._fns:
+            model = self._model_for(mode)
             self._fns[key] = jax.jit(
-                lambda v, s: self.model.forward_epilogue(v, s))
+                lambda v, s, m=model: m.forward_epilogue(v, s))
         return self._fns[key]
 
     def _sched_join_fn(self):  # guarded_by: _lock
@@ -210,14 +262,17 @@ class BatchEngine:
             self._fns[key] = jax.jit(join)
         return self._fns[key]
 
-    def warmup(self, buckets=None, iters_list=None) -> List[Tuple[int, int,
-                                                                  int]]:
+    def warmup(self, buckets=None, iters_list=None,
+               modes: Optional[Sequence[str]] = None) -> List[Tuple]:
         """Compile the configured buckets before serving traffic.
 
         Covers both iteration levels (normal + degraded) so flipping into
         graceful degradation under load never stalls the queue behind a
-        compile — exactly the moment a compile is least affordable.
-        Returns the (h, w, iters, gru_backend) keys warmed.
+        compile — exactly the moment a compile is least affordable — and
+        every requested precision mode (``modes``; default = the base
+        config's mode only) so a warmed accuracy tier never compiles
+        under traffic either.  Returns the
+        (h, w, iters, gru_backend, mode) keys warmed.
         """
         buckets = list(buckets or self.cfg.buckets)
         # sorted, not set-ordered: the default {iters, degraded_iters} set
@@ -225,46 +280,53 @@ class BatchEngine:
         # vary run to run.
         iters_list = sorted(iters_list
                             or {self.cfg.iters, self.cfg.degraded_iters})
+        modes = list(modes or [self.default_mode])
         warmed = []
         for h, w in buckets:
             bh, bw = self.bucket_of((h, w, 3))
             for iters in iters_list:
-                key = (bh, bw, iters, self.gru_backend)
-                # is_warm, not a bare `in self._compiled`: membership is
-                # guarded by _stats_lock (RSA301).
-                if self.is_warm((bh, bw), iters):
-                    continue
-                zero = np.zeros((h, w, 3), np.float32)
-                t0 = time.perf_counter()
-                self.infer_batch([(zero, zero)], iters)
-                logger.info("warmup: bucket %dx%d iters=%d compiled in %.1fs",
-                            bh, bw, iters, time.perf_counter() - t0)
-                warmed.append(key)
+                for mode in modes:
+                    key = (bh, bw, iters, self.gru_backend, mode)
+                    # is_warm, not a bare `in self._compiled`: membership
+                    # is guarded by _stats_lock (RSA301).
+                    if self.is_warm((bh, bw), iters, mode):
+                        continue
+                    zero = np.zeros((h, w, 3), np.float32)
+                    t0 = time.perf_counter()
+                    self.infer_batch([(zero, zero)], iters, mode=mode)
+                    logger.info("warmup: bucket %dx%d iters=%d mode=%s "
+                                "compiled in %.1fs", bh, bw, iters, mode,
+                                time.perf_counter() - t0)
+                    warmed.append(key)
         return warmed
 
-    def warmup_stream(self, buckets=None,
-                      ladder: Sequence[int] = ()) -> List[Tuple]:
+    def warmup_stream(self, buckets=None, ladder: Sequence[int] = (),
+                      modes: Optional[Sequence[str]] = None) -> List[Tuple]:
         """Compile the warm-start executables for every (bucket, ladder
-        level) before serving streams, so the adaptive controller can move
-        between levels mid-stream without ever stalling a session behind an
-        XLA compile.  Returns the (h, w, iters, "stream") keys warmed."""
+        level, mode) before serving streams, so the adaptive controller
+        can move between levels mid-stream without ever stalling a session
+        behind an XLA compile.  Returns the (h, w, iters, "stream",
+        gru_backend, mode) keys warmed."""
         buckets = list(buckets or self.cfg.buckets)
+        modes = list(modes or [self.default_mode])
         warmed = []
         for h, w in buckets:
             bh, bw = self.bucket_of((h, w, 3))
             # sorted for reproducible compile order/logs, same policy as
             # ``warmup`` (the ladder is descending by construction).
             for iters in sorted(ladder):
-                key = (bh, bw, iters, "stream", self.gru_backend)
-                if self.is_stream_warm((bh, bw), iters):
-                    continue
-                zero = np.zeros((h, w, 3), np.float32)
-                t0 = time.perf_counter()
-                self.infer_stream_batch([(zero, zero)], iters, [None])
-                logger.info("stream warmup: bucket %dx%d iters=%d compiled "
-                            "in %.1fs", bh, bw, iters,
-                            time.perf_counter() - t0)
-                warmed.append(key)
+                for mode in modes:
+                    key = (bh, bw, iters, "stream", self.gru_backend, mode)
+                    if self.is_stream_warm((bh, bw), iters, mode):
+                        continue
+                    zero = np.zeros((h, w, 3), np.float32)
+                    t0 = time.perf_counter()
+                    self.infer_stream_batch([(zero, zero)], iters, [None],
+                                            mode=mode)
+                    logger.info("stream warmup: bucket %dx%d iters=%d "
+                                "mode=%s compiled in %.1fs", bh, bw, iters,
+                                mode, time.perf_counter() - t0)
+                    warmed.append(key)
         return warmed
 
     @property
@@ -317,9 +379,12 @@ class BatchEngine:
         ``(host_outputs, included_compile)`` — the flag is per-call, not
         read back from shared engine state, so concurrent callers cannot
         race each other's compile accounting."""
-        mode = "stream" if len(key) == 5 else "batch"
+        kind = "stream" if "stream" in key else "batch"
+        # tier = the key's precision-mode component (always last): a
+        # compile under traffic must be attributable to the tier whose
+        # warmup missed it.
         labels = dict(bucket=f"{key[0]}x{key[1]}", iters=str(key[2]),
-                      mode=mode)
+                      mode=kind, tier=key[-1])
         with self._lock:
             with self._stats_lock:
                 miss = key not in self._compiled
@@ -355,19 +420,25 @@ class BatchEngine:
         return out, miss
 
     def infer_batch(self, pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
-                    iters: int) -> List[np.ndarray]:
-        """Run a coalesced batch; returns one (H, W) disparity per pair."""
+                    iters: int, mode: Optional[str] = None
+                    ) -> List[np.ndarray]:
+        """Run a coalesced batch; returns one (H, W) disparity per pair.
+        ``mode`` is the resolved precision mode (None = the default
+        path); the micro-batcher groups by it, so a batch is always
+        single-mode."""
         padders, hw, i1, i2, _ = self._pad_pairs(pairs)
-        key = (hw[0], hw[1], iters, self.gru_backend)
+        m = self._mode(mode)
+        key = (hw[0], hw[1], iters, self.gru_backend, m)
         (flow_up,), _ = self._dispatch(
-            key, lambda: [self._fn(iters)(self.variables, i1, i2)[1]])
+            key, lambda: [self._fn(iters, m)(self.variables, i1, i2)[1]])
         return [padder.unpad(flow_up[i:i + 1])[0, ..., 0]
                 for i, padder in enumerate(padders)]
 
     def infer_stream_batch(self, pairs: Sequence[Tuple[np.ndarray,
                                                        np.ndarray]],
                            iters: int,
-                           flow_inits: Sequence[Optional[np.ndarray]]
+                           flow_inits: Sequence[Optional[np.ndarray]],
+                           mode: Optional[str] = None
                            ) -> List[Tuple[np.ndarray, np.ndarray, bool]]:
         """Warm-start batch: per pair an optional low-res ``flow_init``
         ((H/f, W/f) at the padded bucket shape; None = cold, zeros are
@@ -397,9 +468,11 @@ class BatchEngine:
             fi = jnp.concatenate(inits, axis=0)
             if pad_rows:
                 fi = jnp.pad(fi, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
-        key = (hw[0], hw[1], iters, "stream", self.gru_backend)
+        m = self._mode(mode)
+        key = (hw[0], hw[1], iters, "stream", self.gru_backend, m)
         (low, up), miss = self._dispatch(
-            key, lambda: self._stream_fn(iters)(self.variables, i1, i2, fi))
+            key, lambda: self._stream_fn(iters, m)(self.variables, i1, i2,
+                                                   fi))
         # .copy(): the low-res slice becomes long-lived session state; a
         # view would pin the whole (max_batch_size, ...) batch array in the
         # session store for its TTL.
@@ -412,26 +485,28 @@ class BatchEngine:
     # The phase executables behind serve/sched/ (docs/serving.md): the
     # split forward runs as prologue -> step x N -> epilogue, with the
     # carried state device-resident between boundaries.  All four phases
-    # live in the same compile cache under arity-5 keys
-    # (h, w, iters_per_step, phase, gru_backend) — iters_per_step is 0
-    # for the phases it cannot affect — so /healthz, the RSA401 checker
-    # and the warmup accounting see them like every other executable.
+    # live in the same compile cache under arity-6 keys
+    # (h, w, iters_per_step, phase, gru_backend, mode) — iters_per_step
+    # is 0 for the phases it cannot affect — so /healthz, the RSA401
+    # checker and the warmup accounting see them like every other
+    # executable.
 
-    def _sched_keys(self, hw: Tuple[int, int],
-                    iters_per_step: int) -> List[Tuple]:
+    def _sched_keys(self, hw: Tuple[int, int], iters_per_step: int,
+                    mode: Optional[str] = None) -> List[Tuple]:
         g = self.gru_backend
-        return [(hw[0], hw[1], 0, "sched_prologue", g),
-                (hw[0], hw[1], iters_per_step, "sched_step", g),
-                (hw[0], hw[1], 0, "sched_epilogue", g),
-                (hw[0], hw[1], 0, "sched_join", g)]
+        m = self._mode(mode)
+        return [(hw[0], hw[1], 0, "sched_prologue", g, m),
+                (hw[0], hw[1], iters_per_step, "sched_step", g, m),
+                (hw[0], hw[1], 0, "sched_epilogue", g, m),
+                (hw[0], hw[1], 0, "sched_join", g, m)]
 
-    def is_sched_warm(self, hw: Tuple[int, int],
-                      iters_per_step: int) -> bool:
+    def is_sched_warm(self, hw: Tuple[int, int], iters_per_step: int,
+                      mode: Optional[str] = None) -> bool:
         """Whether all four phase executables are compiled for (bucket,
-        iters_per_step)."""
+        iters_per_step, mode)."""
         with self._stats_lock:
             return all(k in self._compiled
-                       for k in self._sched_keys(hw, iters_per_step))
+                       for k in self._sched_keys(hw, iters_per_step, mode))
 
     def _dispatch_state(self, key, call):
         """``_dispatch`` minus the host fetch: the scheduler's carried
@@ -439,7 +514,7 @@ class BatchEngine:
         here means block_until_ready, not a host copy.  Same lock
         serialization and compile-cache bookkeeping."""
         labels = dict(bucket=f"{key[0]}x{key[1]}", iters=str(key[2]),
-                      mode=key[3])
+                      mode=key[3], tier=key[-1])
         with self._lock:
             with self._stats_lock:
                 miss = key not in self._compiled
@@ -471,7 +546,8 @@ class BatchEngine:
     def infer_sched_prologue(self, pairs: Sequence[Tuple[np.ndarray,
                                                          np.ndarray]],
                              flow_inits: Sequence[Optional[np.ndarray]],
-                             slots: Sequence[int]):
+                             slots: Sequence[int],
+                             mode: Optional[str] = None):
         """Run the prologue for joining requests, each placed at its
         assigned batch slot (remaining slots are zero images — dead
         weight, exactly like batch padding rows).
@@ -513,67 +589,82 @@ class BatchEngine:
                     f"{(lh, lw)} (bucket {hw})")
                 fi[slot, :, :, 0] = init
         self._seg.pad = (t_pad0, time.perf_counter())
-        key = (hw[0], hw[1], 0, "sched_prologue", self.gru_backend)
+        m = self._mode(mode)
+        key = (hw[0], hw[1], 0, "sched_prologue", self.gru_backend, m)
         state, miss = self._dispatch_state(
-            key, lambda: self._sched_prologue_fn()(self.variables, i1, i2,
-                                                   fi))
+            key, lambda: self._sched_prologue_fn(m)(self.variables, i1, i2,
+                                                    fi))
         return hw, state, miss
 
     def infer_sched_step(self, hw: Tuple[int, int], state,
-                         iters_per_step: int):
+                         iters_per_step: int, mode: Optional[str] = None):
         """Advance the running batch by one boundary (``iters_per_step``
         GRU iterations); returns ``(state, included_compile)``."""
+        m = self._mode(mode)
         key = (hw[0], hw[1], iters_per_step, "sched_step",
-               self.gru_backend)
+               self.gru_backend, m)
         return self._dispatch_state(
-            key, lambda: self._sched_step_fn(iters_per_step)(
+            key, lambda: self._sched_step_fn(iters_per_step, m)(
                 self.variables, state))
 
     def infer_sched_join(self, hw: Tuple[int, int], running, incoming,
-                         mask: np.ndarray):
+                         mask: np.ndarray, mode: Optional[str] = None):
         """Merge ``incoming`` into ``running`` where ``mask`` (B,) is
-        True; returns ``(state, included_compile)``."""
+        True; returns ``(state, included_compile)``.  The join body is
+        mode-agnostic (a dtype-polymorphic tree select) but the key
+        carries the mode: each tier's state pytree compiles its own
+        program, and the warmup accounting must see that."""
         with self._device_ctx():  # the mask joins device-resident state
-            m = jnp.asarray(mask, bool)
-        assert m.shape == (self.cfg.max_batch_size,), m.shape
-        key = (hw[0], hw[1], 0, "sched_join", self.gru_backend)
+            mk = jnp.asarray(mask, bool)
+        assert mk.shape == (self.cfg.max_batch_size,), mk.shape
+        m = self._mode(mode)
+        key = (hw[0], hw[1], 0, "sched_join", self.gru_backend, m)
         return self._dispatch_state(
-            key, lambda: self._sched_join_fn()(running, incoming, m))
+            key, lambda: self._sched_join_fn()(running, incoming, mk))
 
-    def infer_sched_epilogue(self, hw: Tuple[int, int], state):
+    def infer_sched_epilogue(self, hw: Tuple[int, int], state,
+                             mode: Optional[str] = None):
         """Final mask + upsample for the whole batch, fetched to host:
         ``(disp_low (B, H/f, W/f, 1), disp_up (B, H, W, 1),
         included_compile)`` — the scheduler unpads per leaving slot
         (``padder_of``)."""
-        key = (hw[0], hw[1], 0, "sched_epilogue", self.gru_backend)
+        m = self._mode(mode)
+        key = (hw[0], hw[1], 0, "sched_epilogue", self.gru_backend, m)
         (low, up), miss = self._dispatch_state(
-            key, lambda: self._sched_epilogue_fn()(self.variables, state))
+            key, lambda: self._sched_epilogue_fn(m)(self.variables, state))
         return (np.asarray(low, np.float32), np.asarray(up, np.float32),
                 miss)
 
-    def warmup_sched(self, buckets=None,
-                     iters_per_step: int = 1) -> List[Tuple]:
+    def warmup_sched(self, buckets=None, iters_per_step: int = 1,
+                     modes: Optional[Sequence[str]] = None) -> List[Tuple]:
         """Compile all four phase executables for every configured bucket
-        before scheduled traffic, so joins/steps/leaves never stall a
-        running batch behind an XLA compile.  Sorted like ``warmup`` for
-        reproducible compile order.  Returns the keys warmed."""
+        (and every requested precision mode) before scheduled traffic, so
+        joins/steps/leaves never stall a running batch behind an XLA
+        compile.  Sorted like ``warmup`` for reproducible compile order.
+        Returns the keys warmed."""
         buckets = list(buckets or self.cfg.buckets)
+        modes = list(modes or [self.default_mode])
         bsz = self.cfg.max_batch_size
         warmed = []
         for h, w in buckets:
             bh, bw = self.bucket_of((h, w, 3))
-            if self.is_sched_warm((bh, bw), iters_per_step):
-                continue
-            zero = np.zeros((h, w, 3), np.float32)
-            t0 = time.perf_counter()
-            hw, state, _ = self.infer_sched_prologue([(zero, zero)], [None],
-                                                     [0])
-            state, _ = self.infer_sched_step(hw, state, iters_per_step)
-            state, _ = self.infer_sched_join(hw, state, state,
-                                             np.zeros(bsz, bool))
-            self.infer_sched_epilogue(hw, state)
-            logger.info("sched warmup: bucket %dx%d iters_per_step=%d "
-                        "compiled in %.1fs", bh, bw, iters_per_step,
-                        time.perf_counter() - t0)
-            warmed.extend(self._sched_keys((bh, bw), iters_per_step))
+            for mode in modes:
+                if self.is_sched_warm((bh, bw), iters_per_step, mode):
+                    continue
+                zero = np.zeros((h, w, 3), np.float32)
+                t0 = time.perf_counter()
+                hw, state, _ = self.infer_sched_prologue(
+                    [(zero, zero)], [None], [0], mode=mode)
+                state, _ = self.infer_sched_step(hw, state, iters_per_step,
+                                                 mode=mode)
+                state, _ = self.infer_sched_join(hw, state, state,
+                                                 np.zeros(bsz, bool),
+                                                 mode=mode)
+                self.infer_sched_epilogue(hw, state, mode=mode)
+                logger.info("sched warmup: bucket %dx%d iters_per_step=%d "
+                            "mode=%s compiled in %.1fs", bh, bw,
+                            iters_per_step, mode,
+                            time.perf_counter() - t0)
+                warmed.extend(self._sched_keys((bh, bw), iters_per_step,
+                                               mode))
         return warmed

@@ -211,6 +211,12 @@ class ServeMetrics:
             labels=("endpoint", "outcome"))
         self.responses = r.counter(
             "serve_responses_total", "requests answered successfully")
+        self.tier_requests = r.counter(
+            "serve_tier_requests_total",
+            "/predict requests by resolved accuracy tier "
+            "(certified/fast/turbo; 'default' = no accuracy field — the "
+            "base precision path; docs/serving.md \"Accuracy tiers\")",
+            labels=("tier",))
         self.shed = r.counter(
             "serve_shed_total",
             "requests rejected at admission because the queue was full")
@@ -225,11 +231,13 @@ class ServeMetrics:
         self.compile_hits = r.counter(
             "serve_compile_cache_hits_total",
             "batches dispatched to an already-compiled executable",
-            labels=("bucket", "iters", "mode"))
+            labels=("bucket", "iters", "mode", "tier"))
         self.compile_misses = r.counter(
             "serve_compile_cache_misses_total",
-            "batches whose (bucket, iters) shape triggered an XLA compile",
-            labels=("bucket", "iters", "mode"))
+            "batches whose (bucket, iters, precision mode) triggered an "
+            "XLA compile — tier= is the resolved precision mode, so a "
+            "per-tier compile under traffic is attributable",
+            labels=("bucket", "iters", "mode", "tier"))
         self.queue_depth = r.gauge(
             "serve_queue_depth", "requests currently waiting in the queue")
         self.batch_size = r.histogram(

@@ -87,6 +87,17 @@ class _QueueItem:
     seq: int
     bucket: Tuple[int, int]
     trace_id: Optional[str] = None
+    # Resolved precision mode of the request's accuracy tier
+    # (ops/quant.py; None = the engine's default path).  Joins the
+    # running-batch group: tiers never share carried state.
+    mode: Optional[str] = None
+
+    @property
+    def group(self) -> Tuple:
+        """Running-batch grouping key: one running batch per (bucket,
+        precision mode) — slots of different tiers cannot share a state
+        pytree (different dtypes AND different numerics)."""
+        return (self.bucket, self.mode)
 
 
 class _Slot:
@@ -100,11 +111,14 @@ class _Slot:
 
 
 class _RunningBatch:
-    """Per-bucket running batch: device state + slot table (worker-thread
-    state; readers go through ``IterationScheduler.stats``)."""
+    """Per-(bucket, mode) running batch: device state + slot table
+    (worker-thread state; readers go through
+    ``IterationScheduler.stats``)."""
 
-    def __init__(self, hw: Tuple[int, int], n_slots: int):
+    def __init__(self, hw: Tuple[int, int], n_slots: int,
+                 mode: Optional[str] = None):
         self.hw = hw
+        self.mode = mode           # precision mode of every slot's state
         self.state = None          # device pytree, set at first join
         self.slots: List[Optional[_Slot]] = [None] * n_slots
         self.step_est_s = 0.0      # EMA of boundary latency (deadline est)
@@ -136,8 +150,9 @@ class IterationScheduler:
         # Snapshot for /healthz + /debug/vars.
         self._stats = {"active_slots": 0, "buckets": {}}  # guarded_by: _cv
         # The running batches are worker-thread-confined (only the
-        # scheduling loop touches them); readers use stats().
-        self._running: Dict[Tuple[int, int], _RunningBatch] = {}
+        # scheduling loop touches them); readers use stats().  Keyed by
+        # _QueueItem.group = (bucket, precision mode).
+        self._running: Dict[Tuple, _RunningBatch] = {}
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- lifecycle
@@ -209,7 +224,8 @@ class IterationScheduler:
                flow_init: Optional[np.ndarray] = None,
                priority: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None,
+               mode: Optional[str] = None) -> Future:
         """Enqueue one stereo pair; returns a ``Future`` resolving to a
         :class:`SchedResult`.
 
@@ -249,7 +265,7 @@ class IterationScheduler:
             self._queue.append(_QueueItem(
                 image1, image2, flow_init, target, deadline_s, cls,
                 PRIORITIES[cls], fut, self._now(), self._seq, bucket,
-                trace_id))
+                trace_id, mode))
             if self.metrics is not None:
                 self.metrics.sched_queue_depth.labels(
                     priority=PRIORITIES[cls]).add(1)
@@ -293,31 +309,31 @@ class IterationScheduler:
         an injected clock; the worker thread just loops it)."""
         now = self._now()
         joins = self._select_joins(now)
-        for bucket, items in joins.items():
-            self._join(bucket, items)
+        for group, items in joins.items():
+            self._join(group, items)
         did_work = bool(joins)
-        for bucket, rb in list(self._running.items()):
+        for group, rb in list(self._running.items()):
             if not rb.occupied():
-                del self._running[bucket]
+                del self._running[group]
                 continue
             did_work = True
             self._step(rb)
             self._leave(rb)
             if not rb.occupied():
-                del self._running[bucket]
+                del self._running[group]
         self._update_stats()
         return did_work
 
     # ---------------------------------------------------------- round phases
 
-    def _select_joins(self, now: float) -> Dict[Tuple[int, int],
+    def _select_joins(self, now: float) -> Dict[Tuple,
                                                 List[_QueueItem]]:
         """Pop this boundary's joiners under the queue lock: time out
         stale requests, order the rest by (aged priority, FIFO), grant
-        free slots per bucket."""
+        free slots per (bucket, mode) group."""
         sc = self.sched_cfg
         timeout_s = self.cfg.request_timeout_ms / 1000.0
-        joins: Dict[Tuple[int, int], List[_QueueItem]] = {}
+        joins: Dict[Tuple, List[_QueueItem]] = {}
         timed_out: List[_QueueItem] = []
         with self._cv:
             keep: List[_QueueItem] = []
@@ -333,17 +349,17 @@ class IterationScheduler:
             keep.sort(key=lambda it: queue_sort_key(
                 it.cls, it.t_enqueue, it.seq, now,
                 sc.starvation_ms / 1000.0))
-            free: Dict[Tuple[int, int], int] = {}
+            free: Dict[Tuple, int] = {}
             granted: List[_QueueItem] = []
             for it in keep:
-                if it.bucket not in free:
-                    rb = self._running.get(it.bucket)
-                    free[it.bucket] = (len(rb.free()) if rb is not None
-                                       else self.cfg.max_batch_size)
-                if free[it.bucket] > 0:
-                    free[it.bucket] -= 1
+                if it.group not in free:
+                    rb = self._running.get(it.group)
+                    free[it.group] = (len(rb.free()) if rb is not None
+                                      else self.cfg.max_batch_size)
+                if free[it.group] > 0:
+                    free[it.group] -= 1
                     granted.append(it)
-                    joins.setdefault(it.bucket, []).append(it)
+                    joins.setdefault(it.group, []).append(it)
             for it in granted:
                 keep.remove(it)
                 if self.metrics is not None:
@@ -362,20 +378,21 @@ class IterationScheduler:
                 f"{timeout_s:.3f}s limit"))
         return joins
 
-    def _join(self, bucket: Tuple[int, int],
+    def _join(self, group: Tuple,
               items: List[_QueueItem]) -> None:
         """Prologue the joiners at their assigned slots and merge them
-        into the bucket's running batch."""
-        rb = self._running.get(bucket)
+        into the group's running batch."""
+        bucket, mode = group
+        rb = self._running.get(group)
         if rb is None:
-            rb = self._running[bucket] = _RunningBatch(
-                bucket, self.cfg.max_batch_size)
+            rb = self._running[group] = _RunningBatch(
+                bucket, self.cfg.max_batch_size, mode)
         slots = rb.free()[:len(items)]
         assert len(slots) == len(items), (slots, len(items))
         try:
             hw, incoming, miss = self.engine.infer_sched_prologue(
                 [(it.image1, it.image2) for it in items],
-                [it.flow_init for it in items], slots)
+                [it.flow_init for it in items], slots, mode=mode)
             assert hw == bucket, (hw, bucket)
             # Before the join dispatch overwrites it: the prologue's own
             # timing window, for the per-request sched_prologue spans.
@@ -386,7 +403,7 @@ class IterationScheduler:
                 mask = np.zeros(self.cfg.max_batch_size, bool)
                 mask[slots] = True
                 rb.state, join_miss = self.engine.infer_sched_join(
-                    bucket, rb.state, incoming, mask)
+                    bucket, rb.state, incoming, mask, mode=mode)
                 miss = miss or join_miss
         except Exception as e:  # fail the joiners, keep the batch alive
             if self.metrics is not None:
@@ -416,7 +433,7 @@ class IterationScheduler:
         t0 = self._now()
         try:
             rb.state, miss = self.engine.infer_sched_step(rb.hw, rb.state,
-                                                          ips)
+                                                          ips, mode=rb.mode)
         except Exception as e:  # fail the whole batch, drop its state
             occ = rb.occupied()
             if self.metrics is not None:
@@ -460,8 +477,8 @@ class IterationScheduler:
         if not leavers:
             return
         try:
-            low, up, miss = self.engine.infer_sched_epilogue(rb.hw,
-                                                             rb.state)
+            low, up, miss = self.engine.infer_sched_epilogue(rb.hw, rb.state,
+                                                             mode=rb.mode)
         except Exception as e:
             if self.metrics is not None:
                 self.metrics.errors.inc(len(leavers))
@@ -502,10 +519,16 @@ class IterationScheduler:
     def _update_stats(self) -> None:
         buckets = {}
         total = 0
-        for bucket, rb in self._running.items():
+        for (bucket, mode), rb in self._running.items():
             n = len(rb.occupied())
             total += n
-            buckets[f"{bucket[0]}x{bucket[1]}"] = {
+            # Default-mode batches keep the bare "HxW" stats key (the
+            # historical schema); tier batches are suffixed with their
+            # precision mode.
+            name = f"{bucket[0]}x{bucket[1]}"
+            if mode is not None:
+                name = f"{name}@{mode}"
+            buckets[name] = {
                 "active_slots": n,
                 "occupancy": round(n / self.cfg.max_batch_size, 4),
                 "step_est_ms": round(rb.step_est_s * 1e3, 3),

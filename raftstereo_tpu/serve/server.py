@@ -144,6 +144,12 @@ class _Handler(JsonRequestHandler):
                 "max_batch_size": srv.config.max_batch_size,
                 "iters": srv.config.iters,
             }
+            if srv.config.tiers:
+                health["tiers"] = {
+                    "advertised": {t: srv.tiers[t]
+                                   for t in sorted(srv.tiers)},
+                    "refused": dict(srv.tier_reasons),
+                }
             if srv.cluster is not None:
                 health["cluster"] = srv.cluster.stats()
             if srv.scheduler is not None:
@@ -181,6 +187,8 @@ class _Handler(JsonRequestHandler):
                           if srv.scheduler is not None else None),
                 "cluster": (srv.cluster.stats()
                             if srv.cluster is not None else None),
+                "tiers": {"advertised": dict(srv.tiers),
+                          "refused": dict(srv.tier_reasons)},
                 "ready": srv.is_ready,
                 "draining": srv.draining,
                 "trace": {"capacity": srv.tracer.capacity,
@@ -291,6 +299,7 @@ class _Handler(JsonRequestHandler):
                 seq_no = payload.get("seq_no")
                 deadline_ms = payload.get("deadline_ms")
                 priority = payload.get("priority")
+                accuracy = payload.get("accuracy")
             except Exception as e:
                 srv.end_predict()
                 self._finish(400, {"error": f"bad request: {e}"},
@@ -300,15 +309,16 @@ class _Handler(JsonRequestHandler):
         try:
             self._predict_admitted(srv, endpoint, rid, t_req0, left, right,
                                    iters, session_id, seq_no, deadline_ms,
-                                   priority)
+                                   priority, accuracy)
         finally:
             srv.end_predict()
 
     def _predict_admitted(self, srv: "StereoServer", endpoint, rid, t_req0,
                           left, right, iters, session_id, seq_no,
-                          deadline_ms, priority) -> None:
+                          deadline_ms, priority, accuracy=None) -> None:
         """Validation + dispatch of one admitted (gate-passed, decoded,
         in-flight-counted) /predict request."""
+        mode = None
         try:
             if left.ndim != 3 or left.shape[-1] != 3 \
                     or left.shape != right.shape:
@@ -319,6 +329,28 @@ class _Handler(JsonRequestHandler):
                 raise ValueError(
                     f"image side {max(left.shape[:2])} exceeds "
                     f"max_image_dim {srv.config.max_image_dim}")
+            if accuracy is not None:
+                # Accuracy tiers (ops/quant.py, docs/serving.md): only
+                # ADVERTISED tiers resolve — a tier the certification
+                # manifest refused (or a server without tiers) answers
+                # with the recorded reason, never a silently-degraded
+                # result or an unwarmed compile.
+                accuracy = str(accuracy)
+                if accuracy not in srv.tiers:
+                    reason = srv.tier_reasons.get(
+                        accuracy, "tier not offered by this server "
+                                  "(--tiers)")
+                    raise ValueError(
+                        f"accuracy tier {accuracy!r} not advertised: "
+                        f"{reason}")
+                mode = srv.tiers[accuracy]
+                if mode == srv.engine.default_mode:
+                    # The tier IS the default path's program (e.g.
+                    # "certified" on an fp32 server): normalize to None
+                    # so the batcher/scheduler group it WITH default
+                    # traffic — same executable, shared batches, one
+                    # running state per bucket.
+                    mode = None
             if srv.scheduler is None and (deadline_ms is not None
                                           or priority is not None):
                 raise ValueError(
@@ -352,14 +384,16 @@ class _Handler(JsonRequestHandler):
                         # every ladder level is served by the same step
                         # executable, so warmth is per bucket, not level.
                         if not srv.engine.is_sched_warm(
-                                hw, srv.config.sched.iters_per_step):
+                                hw, srv.config.sched.iters_per_step,
+                                mode=mode):
                             raise ValueError(
                                 f"shape {tuple(left.shape[:2])} -> bucket "
                                 f"{hw} not sched-warmed; configure "
                                 f"--buckets")
                     else:
                         missing = [lv for lv in srv.config.stream.ladder
-                                   if not srv.engine.is_stream_warm(hw, lv)]
+                                   if not srv.engine.is_stream_warm(
+                                       hw, lv, mode=mode)]
                         if missing:
                             raise ValueError(
                                 f"shape {tuple(left.shape[:2])} -> bucket "
@@ -397,14 +431,15 @@ class _Handler(JsonRequestHandler):
                 hw = srv.engine.bucket_of(left.shape)
                 if srv.scheduler is not None:
                     if not srv.engine.is_sched_warm(
-                            hw, srv.config.sched.iters_per_step):
+                            hw, srv.config.sched.iters_per_step,
+                            mode=mode):
                         raise ValueError(
                             f"shape {tuple(left.shape[:2])} -> bucket "
                             f"{hw} not sched-warmed; configure it in "
                             f"--buckets")
                 else:
                     want = iters if iters is not None else srv.config.iters
-                    if not srv.engine.is_warm(hw, want):
+                    if not srv.engine.is_warm(hw, want, mode=mode):
                         raise ValueError(
                             f"shape {tuple(left.shape[:2])} -> bucket {hw} "
                             f"(iters {want}) not warmed; configure it in "
@@ -440,7 +475,7 @@ class _Handler(JsonRequestHandler):
                 srv.stream_inflight += 1
             try:
                 res = srv.stream.step(session_id, seq_no, left, right,
-                                      trace_id=rid)
+                                      trace_id=rid, mode=mode)
             except Overloaded as e:
                 # Sched mode: the frame is a scheduler job and admission
                 # can shed it there too — same backpressure contract as
@@ -470,8 +505,15 @@ class _Handler(JsonRequestHandler):
                     "warm": res.warm,
                     "update_ema": round(res.update_ema, 4),
                     "latency_ms": round(res.latency_s * 1e3, 3)}
+            if accuracy is not None:
+                meta["accuracy"] = accuracy
             if res.replica is not None:
                 meta["replica"] = res.replica
+            # Counted at the 200, not at admission: a request shed or
+            # 400'd downstream was not SERVED at this tier, and the
+            # metric is the per-tier adoption signal.
+            srv.metrics.tier_requests.labels(
+                tier=accuracy or "default").inc()
             self._finish(200, {
                 "disparity": encode_array(res.disparity),
                 "meta": meta,
@@ -485,20 +527,21 @@ class _Handler(JsonRequestHandler):
         hw = srv.engine.bucket_of(left.shape)
         if srv.scheduler is not None:
             warm = srv.engine.is_sched_warm(
-                hw, srv.config.sched.iters_per_step)
+                hw, srv.config.sched.iters_per_step, mode=mode)
         else:
             levels = ([iters] if iters is not None
                       else [srv.config.iters, srv.config.degraded_iters])
-            warm = all(srv.engine.is_warm(hw, lv) for lv in levels)
+            warm = all(srv.engine.is_warm(hw, lv, mode=mode)
+                       for lv in levels)
         slack = 60.0 if warm else 600.0
         try:
             if srv.scheduler is not None:
                 fut = srv.scheduler.submit(
                     left, right, iters=iters, priority=priority,
-                    deadline_ms=deadline_ms, trace_id=rid)
+                    deadline_ms=deadline_ms, trace_id=rid, mode=mode)
             else:
                 fut = srv.batcher.submit(left, right, iters,
-                                         trace_id=rid)
+                                         trace_id=rid, mode=mode)
         except ValueError as e:  # bad priority/deadline/target (sched)
             self._finish(400, {"error": f"bad request: {e}"},
                          endpoint, rid, t_req0)
@@ -540,8 +583,13 @@ class _Handler(JsonRequestHandler):
             meta = {"iters": res.iters, "degraded": res.degraded,
                     "batch_size": res.batch_size,
                     "latency_ms": round(res.latency_s * 1e3, 3)}
+        if accuracy is not None:
+            meta["accuracy"] = accuracy
         if res.replica is not None:
             meta["replica"] = res.replica
+        # Counted at the 200 (see the session path): only requests
+        # actually served at the tier feed the adoption signal.
+        srv.metrics.tier_requests.labels(tier=accuracy or "default").inc()
         self._finish(200, {
             "disparity": encode_array(res.disparity),
             "meta": meta,
@@ -561,11 +609,20 @@ class StereoServer(ThreadingHTTPServer):
                  batcher: Optional[DynamicBatcher], metrics: ServeMetrics,
                  stream=None, tracer: Optional[Tracer] = None,
                  scheduler: Optional[IterationScheduler] = None,
-                 cluster=None, start_ready: bool = True):
+                 cluster=None, start_ready: bool = True,
+                 tiers: Optional[Dict[str, str]] = None,
+                 tier_reasons: Optional[Dict[str, str]] = None):
         assert (batcher is None) != (scheduler is None), (
             "exactly one of batcher (monolithic dispatch) or scheduler "
             "(iteration-level continuous batching) must be set")
         self.config = config
+        # Advertised accuracy tiers (tier -> precision mode) and the
+        # refusal reasons for requested-but-uncertified ones
+        # (eval/certify.resolve_tiers; build_server fills both).  Direct
+        # construction defaults to NO tiers — any `accuracy` field is a
+        # clean 400, and no tier executables are ever compiled.
+        self.tiers = dict(tiers or {})
+        self.tier_reasons = dict(tier_reasons or {})
         self._engine = engine
         self.batcher = batcher
         self.scheduler = scheduler
@@ -727,6 +784,26 @@ def build_server(model, variables, config: ServeConfig,
     """
     metrics = metrics or ServeMetrics()
     tracer = tracer or Tracer(capacity=config.trace_buffer)
+    # Accuracy tiers: validated against the certification manifest BEFORE
+    # anything is advertised or warmed (eval/certify.py) — an uncertified
+    # tier is refused with a recorded reason, and its executables are
+    # never compiled.
+    tiers: Dict[str, str] = {}
+    tier_reasons: Dict[str, str] = {}
+    warm_modes = None
+    if config.tiers:
+        from ..eval.certify import resolve_tiers
+
+        tiers, tier_reasons = resolve_tiers(
+            config, model.config if model is not None else None)
+        if tiers:
+            from ..ops.quant import default_mode
+
+            # model=None mirrors BatchEngine's own fallback (engine
+            # stubs never dispatch; their keys just stay well-formed).
+            base = ("fp32" if model is None
+                    else default_mode(model.config))
+            warm_modes = [base] + sorted(set(tiers.values()) - {base})
     cluster = None
     stream = None
     if config.cluster is not None:
@@ -744,7 +821,7 @@ def build_server(model, variables, config: ServeConfig,
             stream = cluster  # sticky session routing via the dispatcher
 
         def warm():
-            rset.warmup()
+            rset.warmup(modes=warm_modes)
     else:
         engine = BatchEngine(model, variables, config, metrics)
         scheduler = None
@@ -772,16 +849,19 @@ def build_server(model, variables, config: ServeConfig,
             if config.sched is not None:
                 if config.warmup:
                     engine.warmup_sched(
-                        iters_per_step=config.sched.iters_per_step)
+                        iters_per_step=config.sched.iters_per_step,
+                        modes=warm_modes)
             else:
                 if config.warmup:
-                    engine.warmup()
+                    engine.warmup(modes=warm_modes)
                 if config.stream is not None and config.stream_warmup:
-                    engine.warmup_stream(ladder=config.stream.ladder)
+                    engine.warmup_stream(ladder=config.stream.ladder,
+                                         modes=warm_modes)
 
     server = StereoServer(config, engine, batcher, metrics, stream=stream,
                           tracer=tracer, scheduler=scheduler,
-                          cluster=cluster, start_ready=False)
+                          cluster=cluster, start_ready=False,
+                          tiers=tiers, tier_reasons=tier_reasons)
 
     def warm_then_ready():
         try:

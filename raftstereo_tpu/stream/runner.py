@@ -80,10 +80,15 @@ class StreamRunner:
 
     def step(self, session_id: str, seq_no: Optional[int],
              left: np.ndarray, right: np.ndarray,
-             trace_id: Optional[str] = None) -> StreamResult:
+             trace_id: Optional[str] = None,
+             mode: Optional[str] = None) -> StreamResult:
         """Run one frame of a session; always answers (cold on any session
         miss — new, expired, evicted, out-of-sequence, or resized).
-        ``trace_id`` tags the frame's warp/forward spans in the tracer."""
+        ``trace_id`` tags the frame's warp/forward spans in the tracer.
+        ``mode`` is the frame's resolved precision mode (accuracy tier,
+        ops/quant.py): it selects the executable only — session state is
+        a plain fp32 disparity field, so frames of one session may move
+        between tiers without losing the warm start."""
         sess, _ = self.store.get_or_create(session_id)
         ctl = self.controller
         tracer = self.tracer
@@ -126,7 +131,8 @@ class StreamRunner:
                 # the scheduler under this trace id).
                 res = self.scheduler.submit(
                     left, right, iters=iters, flow_init=init,
-                    priority="high", trace_id=trace_id).result(timeout=600)
+                    priority="high", trace_id=trace_id,
+                    mode=mode).result(timeout=600)
                 disp, low, compiled = (res.disparity, res.disp_low,
                                        res.included_compile)
                 if tracer is not None:
@@ -138,7 +144,7 @@ class StreamRunner:
                                          "sched": True})
             else:
                 disp, low, compiled = self.engine.infer_stream_batch(
-                    [(left, right)], iters, [init])[0]
+                    [(left, right)], iters, [init], mode=mode)[0]
                 if tracer is not None:
                     seg = getattr(self.engine, "last_segments", None)
                     fwd_end = (seg["dispatch"][1] if seg

@@ -62,3 +62,18 @@ class Engine:
             if key in self._compiled:   # gru_backend missing: RSA401
                 continue
             self._dispatch(key, lambda: None)
+
+    def infer_tiered(self, pairs, iters, accuracy):
+        # Accuracy-tier executable (serve/engine.py + ops/quant.py):
+        # the resolved tier selects a different program.
+        h, w = 64, 96
+        key = (h, w, iters)             # accuracy NOT in the key
+        return self._dispatch(key, lambda: (pairs, accuracy))  # RSA401
+
+    def warmup_tiers(self, buckets, iters_list, tier):
+        for h, w in buckets:
+            for iters in iters_list:
+                key = (h, w, iters, "xla")
+                if key in self._compiled:   # tier missing: RSA401
+                    continue
+                self._dispatch(key, lambda: None)

@@ -70,3 +70,20 @@ class Engine:
             if key in self._compiled:
                 continue
             self._dispatch(key, lambda: None)
+
+    def infer_tiered(self, pairs, iters, accuracy):
+        # Accuracy-tier executable (serve/engine.py + ops/quant.py):
+        # the resolved precision mode joins the key as its last
+        # component, transitively through the resolver assignment.
+        h, w = 64, 96
+        resolved = accuracy
+        key = (h, w, iters, "xla", resolved)
+        return self._dispatch(key, lambda: pairs)
+
+    def warmup_tiers(self, buckets, iters_list, tier):
+        for h, w in buckets:
+            for iters in iters_list:
+                key = (h, w, iters, "xla", tier)
+                if key in self._compiled:
+                    continue
+                self._dispatch(key, lambda: None)

@@ -92,13 +92,18 @@ class TestCacheKeys:
         assert _codes_lines(findings) == [
             ("RSA401", 16), ("RSA402", 19), ("RSA401", 23),
             ("RSA401", 30), ("RSA401", 35), ("RSA401", 44),
-            ("RSA401", 50), ("RSA401", 57), ("RSA401", 62)]
+            ("RSA401", 50), ("RSA401", 57), ("RSA401", 62),
+            ("RSA401", 71), ("RSA401", 77)]
         assert "precision" in findings[0].message
         assert "mode" in findings[2].message
         # Kernel-backend selectors are key-relevant too: an infer call
         # and a warmup membership test whose keys omit gru_backend.
         assert "gru_backend" in findings[7].message
         assert "gru_backend" in findings[8].message
+        # Accuracy-tier executables (serve/engine.py + ops/quant.py): an
+        # infer call dropping the tier and a warmup ladder dropping it.
+        assert "accuracy" in findings[9].message
+        assert "tier" in findings[10].message
         # The scheduler's phase-executable keys (serve/engine.py): a step
         # key missing iters_per_step, and a warmup membership test whose
         # key omits it.

@@ -51,3 +51,16 @@ def test_gru_mode_contract():
             "max_abs_diff"} <= set(r)
     import math
     assert math.isfinite(r["max_abs_diff"])
+
+
+@pytest.mark.slow
+def test_quant_mode_contract():
+    r = _run(["--quant", "--quick"])
+    assert r["unit"] == "pairs/sec" and r["value"] > 0
+    assert {"fp32_ms_per_batch", "bf16_ms_per_batch", "int8_ms_per_batch",
+            "bf16_speedup_vs_fp32", "int8_speedup_vs_fp32",
+            "int8_max_abs_diff_vs_fp32"} <= set(r)
+    import math
+    assert math.isfinite(r["int8_max_abs_diff_vs_fp32"])
+    # The tiers genuinely diverge numerically from fp32 (quant engaged).
+    assert r["int8_max_abs_diff_vs_fp32"] > 0

@@ -106,7 +106,7 @@ class StubReplica:
         self.futures = []
 
     # batcher contract
-    def submit(self, image1, image2, iters=None, trace_id=None):
+    def submit(self, image1, image2, iters=None, trace_id=None, mode=None):
         if self.overloaded:
             raise Overloaded("full")
         self.submitted.append(iters)
@@ -115,7 +115,8 @@ class StubReplica:
         return fut
 
     # stream contract
-    def step(self, session_id, seq_no, left, right, trace_id=None):
+    def step(self, session_id, seq_no, left, right, trace_id=None,
+             mode=None):
         from raftstereo_tpu.stream.runner import StreamResult
 
         self.stepped.append((session_id, seq_no))

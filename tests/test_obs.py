@@ -156,9 +156,10 @@ class TestValidator:
         m.timeouts.inc()
         m.errors.inc()
         m.degraded_batches.inc()
-        m.compile_hits.labels(bucket="64x96", iters="8", mode="batch").inc()
+        m.compile_hits.labels(bucket="64x96", iters="8", mode="batch",
+                              tier="fp32").inc()
         m.compile_misses.labels(bucket="64x96", iters="8",
-                                mode="stream").inc()
+                                mode="stream", tier="bf16").inc()
         m.queue_depth.set(3)
         m.batch_size.observe(4)
         m.latency.observe(0.02)
@@ -328,7 +329,7 @@ class _StubStreamEngine:
     def low_hw(self, hw):
         return self.low
 
-    def infer_stream_batch(self, pairs, iters, inits):
+    def infer_stream_batch(self, pairs, iters, inits, mode=None):
         return [(np.zeros(p[0].shape[:2], np.float32),
                  np.zeros(self.low, np.float32), False) for p in pairs]
 

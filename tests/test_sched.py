@@ -119,25 +119,25 @@ class StubSchedEngine:
     def padder_of(self, shape):
         return self._padder(shape)
 
-    def infer_sched_prologue(self, pairs, flow_inits, slots):
+    def infer_sched_prologue(self, pairs, flow_inits, slots, mode=None):
         hw = self.bucket_of(pairs[0][0].shape)
         vals = np.zeros(self.max_batch_size, np.float32)
         for (im1, _), s in zip(pairs, slots):
             vals[s] = float(im1.flat[0])
         self.join_slots.append(tuple(slots))
-        return hw, {"vals": vals}, False
+        return hw, {"vals": vals, "mode": mode}, False
 
-    def infer_sched_join(self, hw, running, incoming, mask):
+    def infer_sched_join(self, hw, running, incoming, mask, mode=None):
         return {"vals": np.where(mask, incoming["vals"],
-                                 running["vals"])}, False
+                                 running["vals"]), "mode": mode}, False
 
-    def infer_sched_step(self, hw, state, iters_per_step):
+    def infer_sched_step(self, hw, state, iters_per_step, mode=None):
         self.steps += 1
         if self.clock is not None and self.step_cost:
             self.clock.advance(self.step_cost)
         return state, False
 
-    def infer_sched_epilogue(self, hw, state):
+    def infer_sched_epilogue(self, hw, state, mode=None):
         b = self.max_batch_size
         low = np.zeros((b, hw[0] // 4, hw[1] // 4, 1), np.float32)
         up = np.tile(state["vals"][:, None, None, None],
@@ -298,10 +298,10 @@ class TestSchedEngine:
                            min_duration_s=0.5) as cold:
             warmed = engine.warmup_sched()
         assert sorted(warmed) == [
-            (64, 96, 0, "sched_epilogue", "xla"),
-            (64, 96, 0, "sched_join", "xla"),
-            (64, 96, 0, "sched_prologue", "xla"),
-            (64, 96, 1, "sched_step", "xla")]
+            (64, 96, 0, "sched_epilogue", "xla", "fp32"),
+            (64, 96, 0, "sched_join", "xla", "fp32"),
+            (64, 96, 0, "sched_prologue", "xla", "fp32"),
+            (64, 96, 1, "sched_step", "xla", "fp32")]
         # The step executable (the GRU body) is a model-scale compile:
         # if the 0.5 s floor ever rises above the real compile times, the
         # warm budget-0 guard below would pass vacuously — keep that loud.

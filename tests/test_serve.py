@@ -57,7 +57,7 @@ class StubEngine:
         return BucketPadder(shape, divis_by=self.divis_by,
                             bucket_multiple=self.bucket_multiple).bucket_hw
 
-    def infer_batch(self, pairs, iters):
+    def infer_batch(self, pairs, iters, mode=None):
         if self.gate is not None:
             self.gate.wait(10.0)
         if self.delay:
@@ -207,8 +207,8 @@ class TestEngine:
         eng = BatchEngine(model, variables, cfg)
         # Warmup compiles the configured bucket at BOTH iteration levels.
         warmed = eng.warmup()
-        assert sorted(warmed) == [(64, 96, 1, "xla"),
-                                  (64, 96, 2, "xla")]
+        assert sorted(warmed) == [(64, 96, 1, "xla", "fp32"),
+                                  (64, 96, 2, "xla", "fp32")]
         a, b = _img(60, 90, 1), _img(64, 96, 2)  # same 64x96 bucket
         eng.infer_batch([(a, a)], iters=2)
         assert not eng.last_included_compile  # warmup paid the compile
@@ -239,7 +239,8 @@ class TestMetrics:
         m.queue_depth.set(2)
         m.latency.observe(0.05)
         m.batch_size.observe(4)
-        m.compile_misses.labels(bucket="64x96", iters="8", mode="batch").inc()
+        m.compile_misses.labels(bucket="64x96", iters="8", mode="batch",
+                                tier="fp32").inc()
         text = m.render()
         for line in text.strip().splitlines():
             if line.startswith("#"):
@@ -256,7 +257,7 @@ class TestMetrics:
         assert 'serve_request_latency_seconds_bucket{le="+Inf"} 1' in text
         assert "serve_batch_size_count 1" in text
         assert ('serve_compile_cache_misses_total{bucket="64x96",iters="8",'
-                'mode="batch"} 1') in text
+                'mode="batch",tier="fp32"} 1') in text
 
     def test_duplicate_metric_name_rejected(self):
         from raftstereo_tpu.serve import MetricsRegistry
@@ -367,7 +368,7 @@ class TestEndToEnd:
             # would pass vacuously — this assert makes that loud.
             assert cold_report.compiles == 2, cold_report.durations
             assert server.engine.compiled_keys == {
-                (64, 96, 3, "xla"), (96, 128, 3, "xla")}
+                (64, 96, 3, "xla", "fp32"), (96, 128, 3, "xla", "fp32")}
             assert metrics.compile_misses.value == 2
 
             # (2) bitwise equality with the single-image Evaluator under
@@ -464,7 +465,8 @@ class TestEndToEnd:
             health = client.healthz()
             assert health["status"] == "ok"
             assert sorted(tuple(k) for k in health["compiled_buckets"]) \
-                == [(64, 96, 3, "xla"), (96, 128, 3, "xla")]
+                == [(64, 96, 3, "xla", "fp32"),
+                    (96, 128, 3, "xla", "fp32")]
             client.close()
         finally:
             server.close()
