@@ -883,6 +883,60 @@ def bench_quant(height: int, width: int, batch: int, iters: int, corr: str,
     return out
 
 
+def bench_sl(height: int, width: int, batch: int, iters: int, corr: str,
+             reps: int, quick: bool):
+    """Structured-light vs passive forward A/B at one bucket (mirrors
+    --gru/--quant): the passive model on random RGB pairs and the SL
+    model (12-channel pattern-conditioned inputs through the learned
+    projection front, sl/) on exact-GT synthetic SL stacks, reporting
+    per-batch time for both and the SL slowdown factor — the cost of the
+    pattern front is one extra 3x3 conv per image, so the ratio should
+    stay near 1.  --quick runs the tiny model on CPU (a wiring smoke,
+    not a perf number)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from raftstereo_tpu.config import RAFTStereoConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.sl import SLShiftStereoDataset
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    rng = np.random.default_rng(0)
+    ds = SLShiftStereoDataset(n=batch, hw=(height, width))
+    inputs = {
+        "passive": tuple(
+            jnp.asarray(rng.integers(0, 255, (batch, height, width, 3)),
+                        jnp.float32) for _ in range(2)),
+        "sl": tuple(
+            jnp.asarray(np.stack([ds[i][j] for i in range(batch)]))
+            for j in (1, 2)),
+    }
+    out = {}
+    for name, (i1, i2) in inputs.items():
+        cfg = RAFTStereoConfig(corr_implementation=corr, input_mode=name,
+                               **model_kw)
+        model = RAFTStereo(cfg)
+        variables = model.init(jax.random.key(0), (height, width))
+        fn = jax.jit(lambda v, a, b, m=model: m.forward(
+            v, a, b, iters=iters, test_mode=True))
+        jax.block_until_ready(fn(variables, i1, i2))  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn(variables, i1, i2))
+        dt = (time.perf_counter() - t0) / max(reps, 1)
+        out[f"{name}_ms_per_batch"] = round(dt * 1e3, 3)
+        out[f"{name}_pairs_per_sec"] = round(batch / dt, 3)
+    out["sl_slowdown_vs_passive"] = round(
+        out["sl_ms_per_batch"] / max(out["passive_ms_per_batch"], 1e-9), 3)
+    return out
+
+
 def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
                            reps: int) -> float:
     """Run the reference PyTorch model (random weights) on CPU at the same
@@ -995,6 +1049,13 @@ def main() -> None:
                         "ops/quant.py), reporting all three timings, the "
                         "speedups over fp32 and the max |disparity| gaps; "
                         "--quick = CPU parity smoke")
+    p.add_argument("--sl", action="store_true",
+                   help="A/B the structured-light workload: the passive "
+                        "model on RGB pairs vs the SL model on 12-channel "
+                        "pattern-conditioned stacks (sl/, "
+                        "docs/structured_light.md), reporting both "
+                        "timings and the SL slowdown factor; --quick = "
+                        "CPU wiring smoke")
     p.add_argument("--cluster", action="store_true",
                    help="benchmark replicated serving: N engine replicas "
                         "(one per device; --replicas, default 2) behind "
@@ -1031,7 +1092,7 @@ def main() -> None:
     # refuse to run while the static-analysis baseline has entries
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
     if args.quick or args.serve or args.stream or args.sched \
-            or args.cluster or args.gru or args.quant:
+            or args.cluster or args.gru or args.quant or args.sl:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -1249,6 +1310,33 @@ def main() -> None:
                       f"{args.iters} GRU iters, batch {batch} "
                       f"(fp32 vs bf16 vs int8-corr)",
             "value": summary["int8_pairs_per_sec"],
+            "unit": "pairs/sec",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
+
+    if args.sl:
+        h, w = args.height, args.width
+        batch = args.batch
+        reps = args.reps
+        if args.quick:
+            # Tiny model + shape: CPU wiring smoke, not a perf number.
+            # An explicitly given flag wins, same contract as --height
+            # everywhere else.
+            if not explicit_hw:
+                h, w = 64, 96
+            if not explicit_iters:
+                args.iters = 4
+            if not explicit_reps:
+                reps = 2
+        summary = bench_sl(h, w, batch, args.iters, args.corr,
+                           reps, quick=args.quick)
+        record = {
+            "metric": f"sl-vs-passive pairs/sec @{w}x{h}, "
+                      f"{args.iters} GRU iters, batch {batch}",
+            "value": summary["sl_pairs_per_sec"],
             "unit": "pairs/sec",
             "vs_baseline": 0.0,
         }

@@ -44,7 +44,7 @@ __all__ = ["check"]
 
 _METHOD_RE = re.compile(r"^(infer|warmup)_")
 _KEY_TOKENS = ("iters", "mode", "precision", "dtype", "backend",
-               "accuracy", "tier", "quant")
+               "accuracy", "tier", "quant", "input_mode")
 _CACHE_ATTR_RE = re.compile(r"compiled|cache", re.IGNORECASE)
 _DISPATCH_RE = re.compile(r"dispatch", re.IGNORECASE)
 

@@ -9,5 +9,7 @@
   ``/predict`` for video streams (docs/streaming.md)
 * ``python -m raftstereo_tpu.cli.stream``    — offline warm-start streaming
   runner: warm vs cold on a synthetic sequence (docs/streaming.md)
+* ``python -m raftstereo_tpu.cli.sl``        — structured-light workload:
+  dataset stats + offline masked-EPE run (docs/structured_light.md)
 * ``python -m raftstereo_tpu.cli.sl_smoke``  — structured-light data check
 """

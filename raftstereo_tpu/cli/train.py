@@ -32,6 +32,7 @@ from ..data.datasets import (build_aug_params, fetch_dataset,
                              take_photometric_params)
 from ..data.loader import DataLoader, prefetch_to_device
 from ..eval import validate_things
+from ..eval.validate import validate_sl
 from ..models import RAFTStereo
 from ..models.raft_stereo import count_parameters
 from ..parallel import batch_sharded, make_mesh, replicated
@@ -49,6 +50,14 @@ logger = logging.getLogger(__name__)
 def add_train_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("training")
     g.add_argument("--name", default="raft-stereo")
+    g.add_argument("--workload", choices=["passive", "sl"],
+                   default="passive",
+                   help="training workload: passive stereo (the default "
+                        "pipeline, unchanged) or structured light — "
+                        "pattern-conditioned 12-channel inputs with the "
+                        "masked sequence loss over the valid-modulation "
+                        "region (requires --input_mode sl; "
+                        "docs/structured_light.md)")
     g.add_argument("--restore_ckpt", default=None,
                    help=".pth or Orbax weights to start from")
     g.add_argument("--batch_size", type=int, default=6)
@@ -149,16 +158,32 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
           num_workers=None, no_validation: bool = False,
           dataset_root=None, profile_steps=None,
           fault_plan=None, metrics_port=None,
-          metrics_host="127.0.0.1") -> "TrainState":  # noqa: F821
+          metrics_host="127.0.0.1",
+          workload: str = "passive") -> "TrainState":  # noqa: F821
     """The training loop; returns the final state.  ``dataset`` injection
     lets tests run the full loop on synthetic data; ``fault_plan``
     (default: the ``RAFTSTEREO_FAULTS`` env var) injects deterministic
     failures for chaos testing (utils/faults.py).  ``metrics_port`` mounts
-    the opt-in telemetry exporter (obs/, docs/observability.md)."""
+    the opt-in telemetry exporter (obs/, docs/observability.md).
+    ``workload`` selects the data/validation recipe: "passive" (default,
+    unchanged) or "sl" — structured-light training with the modulation
+    gate folded into the loss's ``valid`` mask (docs/structured_light.md);
+    the loss itself is the standard masked sequence loss either way."""
     import jax
 
     from ..obs import Tracer, TelemetryServer
     from ..train.telemetry import TrainMetrics
+
+    if workload not in ("passive", "sl"):
+        raise ValueError(f"unknown workload {workload!r}")
+    if (workload == "sl") != (model_cfg.input_mode == "sl"):
+        # A passive model cannot consume 12-channel SL stacks and an SL
+        # model cannot consume RGB pairs — catching it here beats a shape
+        # error three layers down in the first jitted step.
+        raise ValueError(
+            f"workload {workload!r} requires a matching model input mode, "
+            f"got input_mode={model_cfg.input_mode!r} (pass --workload sl "
+            f"together with --input_mode sl)")
 
     np.random.seed(cfg.seed)
     plan = FaultPlan.from_env() if fault_plan is None else fault_plan
@@ -229,14 +254,32 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
                 count_parameters({"params": state.params}) / 1e6)
 
     if dataset is None:
-        aug = build_aug_params(cfg.image_size, cfg.spatial_scale,
-                               cfg.noyjitter, cfg.saturation_range,
-                               cfg.img_gamma, cfg.do_flip)
-        roots = ({k: dataset_root for k in
-                  ("sceneflow", "kitti", "middlebury", "sintel",
-                   "falling_things", "tartanair", "sl")}
-                 if dataset_root else None)
-        dataset = fetch_dataset(cfg.train_datasets, aug, roots)
+        if workload == "sl":
+            # SL trains from the capture-tree reader + the train view that
+            # stacks pattern channels and folds the modulation gate into
+            # ``valid`` (sl/adapter.py).  No photometric augmentation by
+            # design: it would decorrelate the ambient images from the
+            # pattern masks the projector physically produced.
+            if not dataset_root:
+                raise ValueError(
+                    "--workload sl needs --dataset_root pointing at an SL "
+                    "capture tree (data/sl.py layout; "
+                    "sl.make_learnable_sl writes a synthetic one)")
+            from ..data.sl import StructuredLightDataset
+            from ..sl import SLTrainView
+            dataset = SLTrainView(
+                StructuredLightDataset(dataset_root, split="training",
+                                       scale=1.0, with_depth=True),
+                crop_size=cfg.image_size)
+        else:
+            aug = build_aug_params(cfg.image_size, cfg.spatial_scale,
+                                   cfg.noyjitter, cfg.saturation_range,
+                                   cfg.img_gamma, cfg.do_flip)
+            roots = ({k: dataset_root for k in
+                      ("sceneflow", "kitti", "middlebury", "sintel",
+                       "falling_things", "tartanair", "sl")}
+                     if dataset_root else None)
+            dataset = fetch_dataset(cfg.train_datasets, aug, roots)
     photometric_params = None
     if cfg.device_photometric:
         # Disables host jitter+eraser on EVERY leaf (including
@@ -263,7 +306,25 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
     # skipping it would let a training run go fully unchecked).  Probing at
     # startup also means the validation dataset is built exactly once.
     val_dataset = None
-    if not no_validation:
+    if not no_validation and workload == "sl":
+        from ..data.sl import StructuredLightDataset
+        from ..sl import SLTrainView
+        try:
+            val_dataset = SLTrainView(StructuredLightDataset(
+                dataset_root, split="validation", scale=1.0,
+                with_depth=True))
+        except Exception as e:
+            raise ValueError(
+                "in-training SL validation requires the capture tree's "
+                f"validation split and it could not be loaded ({e}); fix "
+                "--dataset_root or pass --no_validation to opt out "
+                "explicitly") from e
+        if len(val_dataset) == 0:
+            raise ValueError(
+                "in-training SL validation dataset is empty; fix "
+                "--dataset_root or pass --no_validation to opt out "
+                "explicitly")
+    elif not no_validation:
         from ..data import datasets as ds
         try:
             val_dataset = ds.SceneFlowDatasets(
@@ -292,7 +353,8 @@ def train(model_cfg, cfg: TrainConfig, dataset=None,
         if no_validation:
             return
         try:
-            results = validate_things(
+            validator = validate_sl if workload == "sl" else validate_things
+            results = validator(
                 model, state.variables, iters=cfg.valid_iters,
                 dataset=val_dataset, max_images=200)
         except Exception as e:
@@ -536,7 +598,7 @@ def main(argv=None) -> int:
           num_workers=args.num_workers, no_validation=args.no_validation,
           dataset_root=args.dataset_root, profile_steps=args.profile_steps,
           fault_plan=plan, metrics_port=args.metrics_port,
-          metrics_host=args.metrics_host)
+          metrics_host=args.metrics_host, workload=args.workload)
     return 0
 
 

@@ -91,6 +91,18 @@ class RAFTStereoConfig:
     # for inference (no backward pass to rematerialize for).
     remat: bool = False
 
+    # Input modality (sl/, docs/structured_light.md).  "passive" is the
+    # classic 3-channel RGB pair; "sl" stacks the 9 projected-pattern
+    # channels from data/sl.py onto each side (ambient 3 + patterns 9 = 12
+    # channels per image) and routes both stacks through a learned
+    # projection before the shared feature encoders.  The passive path is
+    # bitwise-unchanged: no projection module exists, no extra params are
+    # created, and the traced program is identical to pre-SL builds.
+    # Serving executables are cache-keyed by this field (serve/engine.py),
+    # and it joins the certification architecture fingerprint
+    # (eval/certify.ARCH_FIELDS).
+    input_mode: str = "passive"
+
     def __post_init__(self):
         if isinstance(self.hidden_dims, list):
             object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
@@ -99,6 +111,7 @@ class RAFTStereoConfig:
         assert self.corr_precision in (
             "highest", "high", "default"), self.corr_precision
         assert self.gru_backend in ("auto", "fused", "xla"), self.gru_backend
+        assert self.input_mode in ("passive", "sl"), self.input_mode
         assert 1 <= self.n_gru_layers <= 3, self.n_gru_layers
         assert len(self.hidden_dims) >= self.n_gru_layers
 
@@ -111,6 +124,12 @@ class RAFTStereoConfig:
     def cor_planes(self) -> int:
         """Correlation feature channels fed to the motion encoder."""
         return self.corr_levels * (2 * self.corr_radius + 1)
+
+    @property
+    def input_channels(self) -> int:
+        """Channels per input image: 3 (passive RGB) or 12 (ambient RGB +
+        9 pattern channels, sl/adapter.py)."""
+        return 3 if self.input_mode == "passive" else 12
 
 
 @dataclasses.dataclass(frozen=True)
@@ -804,6 +823,12 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                    help="rematerialize each GRU iteration in backward: "
                         "O(1) activation memory instead of O(iters); "
                         "needed to fit the full training recipe on one chip")
+    g.add_argument("--input_mode", choices=["passive", "sl"],
+                   default="passive",
+                   help="input modality: 'passive' = 3-channel RGB pairs; "
+                        "'sl' = 12-channel structured-light stacks (ambient "
+                        "+ 9 pattern channels per side) through a learned "
+                        "projection (docs/structured_light.md)")
 
 
 def model_config_from_args(args: argparse.Namespace) -> RAFTStereoConfig:
@@ -823,4 +848,5 @@ def model_config_from_args(args: argparse.Namespace) -> RAFTStereoConfig:
         corr_quant=args.corr_quant,
         gru_backend=args.gru_backend,
         remat=args.remat,
+        input_mode=args.input_mode,
     )

@@ -66,7 +66,7 @@ DEFAULT_BOUNDS = {"fast": 0.5, "turbo": 1.0}
 ARCH_FIELDS = ("corr_levels", "corr_radius", "n_downsample", "n_gru_layers",
                "hidden_dims", "slow_fast_gru", "shared_backbone",
                "context_norm", "corr_implementation", "corr_precision",
-               "fused_encoder", "gru_backend")
+               "fused_encoder", "gru_backend", "input_mode")
 
 
 def _arch_of(config) -> Dict[str, object]:
@@ -100,10 +100,29 @@ def certify_tiers(config, variables, tiers: Sequence[str] = ("fast",
     assert not bad, (f"cannot certify tiers {bad}: choose from "
                      f"{[t for t in TIERS if t != 'certified']}")
     bounds = {**DEFAULT_BOUNDS, **(bounds or {})}
-    ds = ShiftStereoDataset(n=n_pairs, hw=hw, seed=seed)
+    if config.input_mode == "sl":
+        # SL models certify on SL data: the exact-GT synthetic twin with
+        # 12-channel pattern-conditioned inputs (sl/synthetic.py).  A
+        # passive certification set cannot even be fed to an SL model —
+        # and the fingerprint (ARCH_FIELDS) keys the manifest to the
+        # input mode, so certificates never transfer across modes.
+        from ..sl import SLShiftStereoDataset
+        ds = SLShiftStereoDataset(n=n_pairs, hw=hw, seed=seed)
+        data_desc = "synthetic SLShiftStereoDataset (exact GT, masked)"
+    else:
+        ds = ShiftStereoDataset(n=n_pairs, hw=hw, seed=seed)
+        data_desc = "synthetic ShiftStereoDataset (exact GT)"
     lefts = np.stack([ds[i][1] for i in range(n_pairs)])
     rights = np.stack([ds[i][2] for i in range(n_pairs)])
     gts = np.stack([ds[i][3] for i in range(n_pairs)])   # (N, H, W, 1)
+    # Passive synthetic pairs are valid everywhere; SL pairs carry a
+    # projector-shadow band that the EPE must skip (masked semantics).
+    valid = np.stack([np.asarray(ds[i][4], np.float32)[..., None]
+                      for i in range(n_pairs)])
+    n_valid = max(float(valid.sum()), 1.0)
+
+    def _epe(pred: np.ndarray) -> float:
+        return float((np.abs(pred - gts) * valid).sum() / n_valid)
 
     def run(mode: str) -> np.ndarray:
         model = RAFTStereo(config_for_mode(config, mode))
@@ -113,11 +132,11 @@ def certify_tiers(config, variables, tiers: Sequence[str] = ("fast",
         return np.asarray(up, np.float32)
 
     ref = run("fp32")
-    epe_ref = float(np.mean(np.abs(ref - gts)))
+    epe_ref = _epe(ref)
     entries: Dict[str, Dict] = {}
     for tier in tiers:
         pred = run(TIER_MODES[tier])
-        epe = float(np.mean(np.abs(pred - gts)))
+        epe = _epe(pred)
         delta = epe - epe_ref
         bound = float(bounds[tier])
         entries[tier] = {
@@ -125,7 +144,8 @@ def certify_tiers(config, variables, tiers: Sequence[str] = ("fast",
             "epe": round(epe, 6),
             "epe_delta": round(delta, 6),
             "bound": bound,
-            "max_abs_disp_diff": round(float(np.abs(pred - ref).max()), 6),
+            "max_abs_disp_diff": round(
+                float((np.abs(pred - ref) * valid).max()), 6),
             "certified": bool(delta <= bound),
         }
         logger.info("certify %s: epe %.4f (ref %.4f, delta %+.4f, bound "
@@ -142,7 +162,7 @@ def certify_tiers(config, variables, tiers: Sequence[str] = ("fast",
         "model": _arch_of(config),
         "eval": {"hw": list(hw), "n_pairs": n_pairs, "iters": iters,
                  "seed": seed, "epe_ref": round(epe_ref, 6),
-                 "data": "synthetic ShiftStereoDataset (exact GT)"},
+                 "data": data_desc},
         "tiers": entries,
     }
 

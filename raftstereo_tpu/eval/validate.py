@@ -155,8 +155,47 @@ def validate_middlebury(model, variables, iters: int = 32, split: str = "F",
             f"middlebury{split}-d1": 100 * float(np.mean(out_list))}
 
 
+def validate_sl(model, variables, iters: int = 32,
+                dataset=None, root: Optional[str] = None,
+                evaluator: Optional[Evaluator] = None,
+                max_images: Optional[int] = None) -> Dict[str, float]:
+    """Structured-light validation: masked EPE / bad-1px over the
+    valid-modulation region (docs/structured_light.md).
+
+    Items follow the SL train protocol — 12-channel stacks with the
+    modulation gate folded into ``valid`` — from ``sl.SLTrainView`` over a
+    real capture tree (``root``) or the in-memory exact-GT synthetic set
+    when neither ``dataset`` nor ``root`` is given.  Unlike the passive
+    validators there is no unmasked variant: the projector-shadow region
+    carries no signal by construction (sl/synthetic.py).
+    """
+    # Lazy import: eval is imported by sl.evaluate, so a module-level
+    # import here would cycle.
+    from ..sl import SLShiftStereoDataset, SLTrainView
+    if dataset is None:
+        if root is not None:
+            from ..data.sl import StructuredLightDataset
+            dataset = SLTrainView(StructuredLightDataset(
+                root, split="validation", scale=1.0, with_depth=True))
+        else:
+            dataset = SLShiftStereoDataset()
+    run = evaluator or Evaluator(model, variables, iters=iters)
+    n = len(dataset) if max_images is None else min(max_images, len(dataset))
+    epe_list, out_list = [], []
+    for i in range(n):
+        image1, image2, flow_gt, valid_gt = _unpack(dataset[i])
+        pred = run(image1, image2)
+        epe = _epe_map(pred, flow_gt).ravel()
+        val = valid_gt.ravel() >= 0.5
+        epe_list.append(float(epe[val].mean()))
+        out_list.append(epe[val] > 1.0)
+    return {"sl-epe": float(np.mean(epe_list)),
+            "sl-d1": 100 * float(np.mean(np.concatenate(out_list)))}
+
+
 VALIDATORS = {
     "eth3d": validate_eth3d,
+    "sl": validate_sl,
     "kitti": validate_kitti,
     "things": validate_things,
     "middlebury_F": lambda *a, **k: validate_middlebury(*a, split="F", **k),

@@ -58,6 +58,23 @@ class ContextZQR(nn.Module):
         return out
 
 
+class SLProjection(nn.Module):
+    """Pattern-conditioning front for structured-light inputs
+    (config.input_mode == "sl", sl/adapter.py, docs/structured_light.md):
+    a learned 3x3 projection from the 12-channel stack (ambient RGB + 9
+    pattern channels per side) down to the 3 channels the shared feature
+    encoders were designed for.  Both images of a pair share one set of
+    projection weights — the same weight-sharing contract as fnet."""
+
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.proj = conv(3, 3, dtype=self.dtype)
+
+    def __call__(self, x):
+        return self.proj(x)
+
+
 class SharedBackboneHead(nn.Module):
     """Feature head for --shared_backbone mode: one residual block + 3x3 conv
     on the context trunk (reference: core/raft_stereo.py:34-37)."""
@@ -116,6 +133,12 @@ class RAFTStereo:
                                      fused_stem=cfg.fused_encoder)
         self.zqr = ContextZQR(cfg, dtype=self.dtype)
         self.update = BasicMultiUpdateBlock(cfg, dtype=self.dtype)
+        # Structured-light front (docs/structured_light.md).  Constructed
+        # ONLY in sl mode: the passive path must stay bitwise-identical to
+        # pre-SL builds — no extra module, no extra params, no code-path
+        # change in _encode (tests/test_sl.py asserts this).
+        if cfg.input_mode == "sl":
+            self.sl_proj = SLProjection(dtype=self.dtype)
 
     # ------------------------------------------------------------------ init
 
@@ -125,7 +148,10 @@ class RAFTStereo:
         f = cfg.factor
         h0, w0 = h // f, w // f
         lvl = _level_shapes(h0, w0, cfg.n_gru_layers)
-        k = jax.random.split(rng, 4)
+        # Passive keeps its historical 4-way split untouched (bitwise-stable
+        # init); sl adds a fifth key for the projection front.
+        n_keys = 5 if cfg.input_mode == "sl" else 4
+        k = jax.random.split(rng, n_keys)
         img = jnp.zeros((1, h, w, 3), jnp.float32)
 
         variables: Dict[str, Dict] = {"params": {}, "batch_stats": {}}
@@ -134,6 +160,12 @@ class RAFTStereo:
             variables["params"][name] = v["params"]
             if "batch_stats" in v:
                 variables["batch_stats"][name] = v["batch_stats"]
+
+        if cfg.input_mode == "sl":
+            # The projection maps 12 -> 3 channels, so the encoders below
+            # init against the same 3-channel dummy as passive.
+            absorb("sl_proj", self.sl_proj.init(
+                k[4], jnp.zeros((1, h, w, cfg.input_channels), jnp.float32)))
 
         if cfg.shared_backbone:
             v = self.cnet.init(k[0], jnp.concatenate([img, img], 0),
@@ -180,6 +212,14 @@ class RAFTStereo:
 
         img1 = (2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
         img2 = (2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
+
+        if cfg.input_mode == "sl":
+            # 12-channel SL stacks (sl/adapter.py scales the binary pattern
+            # masks to [0, 255] so the shared normalization above needs no
+            # special case) projected to the encoders' 3-channel input.
+            sl_vars = self._split_vars(variables, "sl_proj")
+            img1 = self.sl_proj.apply(sl_vars, img1)
+            img2 = self.sl_proj.apply(sl_vars, img2)
 
         if cfg.shared_backbone:
             outputs, trunk = self.cnet.apply(

@@ -1,8 +1,8 @@
 """Shape-bucketed, padded-batch compiled inference engine.
 
 The serving analogue of ``eval/runner.Evaluator``: one compiled executable
-per (shape bucket, GRU iterations, GRU backend, precision mode), reused
-across requests.
+per (shape bucket, GRU iterations, GRU backend, input mode, precision
+mode), reused across requests.
 Three shape decisions keep the XLA compile count small and predictable:
 
 * every image is padded with the SAME ``BucketPadder`` policy the Evaluator
@@ -85,6 +85,21 @@ class BatchEngine:
         # UNCHANGED (same executables, bitwise-identical results).
         self.default_mode = ("fp32" if model is None
                              else default_mode(model.config))
+        # Input modality (sl/, docs/structured_light.md): joins every
+        # executable cache key right before the precision mode.  A passive
+        # and an SL model at the same bucket compile different programs
+        # over different input ranks' worth of channels — a key that
+        # omitted the modality could hand a 3-channel executable a
+        # 12-channel batch.  Fixed per engine: the modality is a model-
+        # architecture property (RAFTStereoConfig.input_mode), not a
+        # per-request knob.
+        self.input_mode = ("passive" if model is None
+                           else model.config.input_mode)
+        # Channels every raw input image carries (3 passive, 12 sl) —
+        # the warmup zero-images and scheduler batch buffers are built at
+        # this width.
+        self.input_channels = (3 if model is None
+                               else model.config.input_channels)
         # mode -> RAFTStereo sharing ``variables`` (tier configs only
         # change numeric-policy fields, so the fp32 weights apply to all;
         # flax casts per-module at apply time).  Built lazily: a server
@@ -97,9 +112,9 @@ class BatchEngine:
         # must not block behind _lock, which is held across a whole device
         # dispatch (seconds) or compile (minutes).
         self._stats_lock = threading.Lock()
-        # Compiled keys: (h, w, iters, gru_backend, mode) for the plain
-        # forward and (h, w, iters, "stream", gru_backend, mode) for the
-        # warm-start (flow_init) forward.
+        # Compiled keys: (h, w, iters, gru_backend, input_mode, mode) for
+        # the plain forward and (h, w, iters, "stream", gru_backend,
+        # input_mode, mode) for the warm-start (flow_init) forward.
         self._compiled: Set[Tuple] = set()  # guarded_by: _stats_lock
         self.last_batch_runtime: float = float("nan")  # guarded_by: _lock
         self.last_included_compile: bool = True  # guarded_by: _lock
@@ -151,7 +166,7 @@ class BatchEngine:
         """Whether (bucket, iters, mode) already has a compiled
         executable."""
         with self._stats_lock:
-            return (hw[0], hw[1], iters, self.gru_backend,
+            return (hw[0], hw[1], iters, self.gru_backend, self.input_mode,
                     self._mode(mode)) in self._compiled
 
     def is_stream_warm(self, hw: Tuple[int, int], iters: int,
@@ -160,7 +175,7 @@ class BatchEngine:
         executable."""
         with self._stats_lock:
             return (hw[0], hw[1], iters, "stream", self.gru_backend,
-                    self._mode(mode)) in self._compiled
+                    self.input_mode, self._mode(mode)) in self._compiled
 
     def low_hw(self, hw: Tuple[int, int]) -> Tuple[int, int]:
         """The 1/factor grid a padded bucket's disparity field lives on —
@@ -272,7 +287,7 @@ class BatchEngine:
         every requested precision mode (``modes``; default = the base
         config's mode only) so a warmed accuracy tier never compiles
         under traffic either.  Returns the
-        (h, w, iters, gru_backend, mode) keys warmed.
+        (h, w, iters, gru_backend, input_mode, mode) keys warmed.
         """
         buckets = list(buckets or self.cfg.buckets)
         # sorted, not set-ordered: the default {iters, degraded_iters} set
@@ -283,15 +298,16 @@ class BatchEngine:
         modes = list(modes or [self.default_mode])
         warmed = []
         for h, w in buckets:
-            bh, bw = self.bucket_of((h, w, 3))
+            bh, bw = self.bucket_of((h, w, self.input_channels))
             for iters in iters_list:
                 for mode in modes:
-                    key = (bh, bw, iters, self.gru_backend, mode)
+                    key = (bh, bw, iters, self.gru_backend,
+                           self.input_mode, mode)
                     # is_warm, not a bare `in self._compiled`: membership
                     # is guarded by _stats_lock (RSA301).
                     if self.is_warm((bh, bw), iters, mode):
                         continue
-                    zero = np.zeros((h, w, 3), np.float32)
+                    zero = np.zeros((h, w, self.input_channels), np.float32)
                     t0 = time.perf_counter()
                     self.infer_batch([(zero, zero)], iters, mode=mode)
                     logger.info("warmup: bucket %dx%d iters=%d mode=%s "
@@ -306,20 +322,21 @@ class BatchEngine:
         level, mode) before serving streams, so the adaptive controller
         can move between levels mid-stream without ever stalling a session
         behind an XLA compile.  Returns the (h, w, iters, "stream",
-        gru_backend, mode) keys warmed."""
+        gru_backend, input_mode, mode) keys warmed."""
         buckets = list(buckets or self.cfg.buckets)
         modes = list(modes or [self.default_mode])
         warmed = []
         for h, w in buckets:
-            bh, bw = self.bucket_of((h, w, 3))
+            bh, bw = self.bucket_of((h, w, self.input_channels))
             # sorted for reproducible compile order/logs, same policy as
             # ``warmup`` (the ladder is descending by construction).
             for iters in sorted(ladder):
                 for mode in modes:
-                    key = (bh, bw, iters, "stream", self.gru_backend, mode)
+                    key = (bh, bw, iters, "stream", self.gru_backend,
+                           self.input_mode, mode)
                     if self.is_stream_warm((bh, bw), iters, mode):
                         continue
-                    zero = np.zeros((h, w, 3), np.float32)
+                    zero = np.zeros((h, w, self.input_channels), np.float32)
                     t0 = time.perf_counter()
                     self.infer_stream_batch([(zero, zero)], iters, [None],
                                             mode=mode)
@@ -428,7 +445,7 @@ class BatchEngine:
         single-mode."""
         padders, hw, i1, i2, _ = self._pad_pairs(pairs)
         m = self._mode(mode)
-        key = (hw[0], hw[1], iters, self.gru_backend, m)
+        key = (hw[0], hw[1], iters, self.gru_backend, self.input_mode, m)
         (flow_up,), _ = self._dispatch(
             key, lambda: [self._fn(iters, m)(self.variables, i1, i2)[1]])
         return [padder.unpad(flow_up[i:i + 1])[0, ..., 0]
@@ -469,7 +486,8 @@ class BatchEngine:
             if pad_rows:
                 fi = jnp.pad(fi, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
         m = self._mode(mode)
-        key = (hw[0], hw[1], iters, "stream", self.gru_backend, m)
+        key = (hw[0], hw[1], iters, "stream", self.gru_backend,
+               self.input_mode, m)
         (low, up), miss = self._dispatch(
             key, lambda: self._stream_fn(iters, m)(self.variables, i1, i2,
                                                    fi))
@@ -485,20 +503,21 @@ class BatchEngine:
     # The phase executables behind serve/sched/ (docs/serving.md): the
     # split forward runs as prologue -> step x N -> epilogue, with the
     # carried state device-resident between boundaries.  All four phases
-    # live in the same compile cache under arity-6 keys
-    # (h, w, iters_per_step, phase, gru_backend, mode) — iters_per_step
-    # is 0 for the phases it cannot affect — so /healthz, the RSA401
-    # checker and the warmup accounting see them like every other
-    # executable.
+    # live in the same compile cache under arity-7 keys
+    # (h, w, iters_per_step, phase, gru_backend, input_mode, mode) —
+    # iters_per_step is 0 for the phases it cannot affect — so /healthz,
+    # the RSA401 checker and the warmup accounting see them like every
+    # other executable.
 
     def _sched_keys(self, hw: Tuple[int, int], iters_per_step: int,
                     mode: Optional[str] = None) -> List[Tuple]:
         g = self.gru_backend
+        im = self.input_mode
         m = self._mode(mode)
-        return [(hw[0], hw[1], 0, "sched_prologue", g, m),
-                (hw[0], hw[1], iters_per_step, "sched_step", g, m),
-                (hw[0], hw[1], 0, "sched_epilogue", g, m),
-                (hw[0], hw[1], 0, "sched_join", g, m)]
+        return [(hw[0], hw[1], 0, "sched_prologue", g, im, m),
+                (hw[0], hw[1], iters_per_step, "sched_step", g, im, m),
+                (hw[0], hw[1], 0, "sched_epilogue", g, im, m),
+                (hw[0], hw[1], 0, "sched_join", g, im, m)]
 
     def is_sched_warm(self, hw: Tuple[int, int], iters_per_step: int,
                       mode: Optional[str] = None) -> bool:
@@ -572,8 +591,8 @@ class BatchEngine:
         # Host-side assembly, ONE transfer at dispatch: out-of-jit
         # ``.at[slot].set`` would copy the whole (B, H, W, 3) batch
         # buffer once per joiner (same rationale as _pad_pairs).
-        i1 = np.zeros((bsz, hw[0], hw[1], 3), np.float32)
-        i2 = np.zeros((bsz, hw[0], hw[1], 3), np.float32)
+        i1 = np.zeros((bsz, hw[0], hw[1], self.input_channels), np.float32)
+        i2 = np.zeros((bsz, hw[0], hw[1], self.input_channels), np.float32)
         fi = np.zeros((bsz, lh, lw, 1), np.float32)
         for (im1, im2), padder, init, slot in zip(pairs, padders,
                                                   flow_inits, slots):
@@ -590,7 +609,8 @@ class BatchEngine:
                 fi[slot, :, :, 0] = init
         self._seg.pad = (t_pad0, time.perf_counter())
         m = self._mode(mode)
-        key = (hw[0], hw[1], 0, "sched_prologue", self.gru_backend, m)
+        key = (hw[0], hw[1], 0, "sched_prologue", self.gru_backend,
+               self.input_mode, m)
         state, miss = self._dispatch_state(
             key, lambda: self._sched_prologue_fn(m)(self.variables, i1, i2,
                                                     fi))
@@ -602,7 +622,7 @@ class BatchEngine:
         GRU iterations); returns ``(state, included_compile)``."""
         m = self._mode(mode)
         key = (hw[0], hw[1], iters_per_step, "sched_step",
-               self.gru_backend, m)
+               self.gru_backend, self.input_mode, m)
         return self._dispatch_state(
             key, lambda: self._sched_step_fn(iters_per_step, m)(
                 self.variables, state))
@@ -618,7 +638,8 @@ class BatchEngine:
             mk = jnp.asarray(mask, bool)
         assert mk.shape == (self.cfg.max_batch_size,), mk.shape
         m = self._mode(mode)
-        key = (hw[0], hw[1], 0, "sched_join", self.gru_backend, m)
+        key = (hw[0], hw[1], 0, "sched_join", self.gru_backend,
+               self.input_mode, m)
         return self._dispatch_state(
             key, lambda: self._sched_join_fn()(running, incoming, mk))
 
@@ -629,7 +650,8 @@ class BatchEngine:
         included_compile)`` — the scheduler unpads per leaving slot
         (``padder_of``)."""
         m = self._mode(mode)
-        key = (hw[0], hw[1], 0, "sched_epilogue", self.gru_backend, m)
+        key = (hw[0], hw[1], 0, "sched_epilogue", self.gru_backend,
+               self.input_mode, m)
         (low, up), miss = self._dispatch_state(
             key, lambda: self._sched_epilogue_fn(m)(self.variables, state))
         return (np.asarray(low, np.float32), np.asarray(up, np.float32),
@@ -647,11 +669,11 @@ class BatchEngine:
         bsz = self.cfg.max_batch_size
         warmed = []
         for h, w in buckets:
-            bh, bw = self.bucket_of((h, w, 3))
+            bh, bw = self.bucket_of((h, w, self.input_channels))
             for mode in modes:
                 if self.is_sched_warm((bh, bw), iters_per_step, mode):
                     continue
-                zero = np.zeros((h, w, 3), np.float32)
+                zero = np.zeros((h, w, self.input_channels), np.float32)
                 t0 = time.perf_counter()
                 hw, state, _ = self.infer_sched_prologue(
                     [(zero, zero)], [None], [0], mode=mode)

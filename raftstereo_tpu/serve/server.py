@@ -320,10 +320,17 @@ class _Handler(JsonRequestHandler):
         in-flight-counted) /predict request."""
         mode = None
         try:
-            if left.ndim != 3 or left.shape[-1] != 3 \
+            # Channel count follows the model's input mode (sl/,
+            # docs/structured_light.md): 3 for passive RGB, 12 for SL
+            # pattern-conditioned stacks.  A mismatched request is a clean
+            # 400 — there is no executable (nor cache key) for the other
+            # modality on this engine.
+            want_c = srv.engine.input_channels
+            if left.ndim != 3 or left.shape[-1] != want_c \
                     or left.shape != right.shape:
                 raise ValueError(
-                    f"expected matching (H, W, 3) pairs, got "
+                    f"expected matching (H, W, {want_c}) pairs for "
+                    f"input_mode={srv.engine.input_mode!r}, got "
                     f"{left.shape} / {right.shape}")
             if max(left.shape[:2]) > srv.config.max_image_dim:
                 raise ValueError(

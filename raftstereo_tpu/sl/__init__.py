@@ -1,0 +1,29 @@
+"""Structured light as a first-class workload (docs/structured_light.md).
+
+The data layer (data/sl.py) already reads real SL capture trees — ambient
+pair, 9 projected-pattern masks per side, three-phase modulation gating,
+depth-derived disparity.  This package makes that modality trainable,
+certifiable and servable:
+
+* :mod:`adapter`   — the pattern-conditioning front: stacks the gated
+  pattern channels onto the ambient pair as 12-channel model inputs
+  (``RAFTStereoConfig.input_mode == "sl"``), plus the train-protocol view
+  whose ``valid`` mask folds the modulation gate into the sequence loss.
+* :mod:`synthetic` — exact-GT synthetic SL: projected stripe/speckle
+  patterns over integer-shift scenes, in-memory
+  (:class:`~raftstereo_tpu.sl.synthetic.SLShiftStereoDataset`) and
+  on-disk in the ``data/sl.py`` tree layout
+  (:func:`~raftstereo_tpu.sl.synthetic.make_learnable_sl`).
+* :mod:`evaluate`  — the offline masked-EPE/bad-px evaluator, with a
+  serving-parity mode whose disparities are bitwise-identical to
+  ``/predict`` answers (tests/test_sl.py).
+"""
+
+from .adapter import NUM_PATTERNS, SL_CHANNELS, SLTrainView, stack_sl_inputs
+from .evaluate import masked_epe
+from .synthetic import SLShiftStereoDataset, make_learnable_sl
+
+__all__ = [
+    "NUM_PATTERNS", "SL_CHANNELS", "SLTrainView", "stack_sl_inputs",
+    "masked_epe", "SLShiftStereoDataset", "make_learnable_sl",
+]

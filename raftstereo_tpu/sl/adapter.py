@@ -1,0 +1,106 @@
+"""Pattern-conditioning input adapter for the structured-light workload.
+
+``data/sl.py`` emits per sample an ambient stereo pair plus an 18-channel
+gated pattern stack (``num_patterns`` RIGHT channels first, then the LEFT
+channels — that order is the dataset's contract, data/sl.py:143-152).
+The model consumes SL input as one 12-channel image per side: ambient RGB
+plus that side's 9 pattern channels, projected down to the encoders'
+3-channel input by a learned front (models/raft_stereo.SLProjection,
+``RAFTStereoConfig.input_mode == "sl"``).
+
+This module owns the stacking convention.  Every consumer — the train
+view below, the offline evaluator (sl/evaluate.py), serving clients, the
+certification path (eval/certify.py) — builds its 12-channel stacks HERE,
+which is what makes offline and ``/predict`` results comparable bitwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Projected patterns per side in the SL capture layout (data/sl.py).
+NUM_PATTERNS = 9
+# Channels per 12-channel model input: ambient RGB + that side's patterns.
+SL_CHANNELS = 3 + NUM_PATTERNS
+
+
+def stack_sl_inputs(img_l: np.ndarray, img_r: np.ndarray,
+                    mask18: np.ndarray,
+                    num_patterns: int = NUM_PATTERNS
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Build the (left, right) 12-channel model inputs from one SL sample.
+
+    ``mask18`` is the dataset's gated 0/1 pattern stack, RIGHT channels
+    first (data/sl.py).  The binary masks are scaled to 0/255 so the
+    model's uniform ``x / 255 * 2 - 1`` input normalization
+    (models/raft_stereo._encode) treats pattern channels exactly like the
+    ambient ones — no per-channel special case anywhere downstream.
+    """
+    mask18 = np.asarray(mask18, np.float32)
+    assert mask18.shape[-1] == 2 * num_patterns, (
+        f"pattern stack has {mask18.shape[-1]} channels, expected "
+        f"{2 * num_patterns} ({num_patterns} right + {num_patterns} left)")
+    pats_r = mask18[..., :num_patterns] * 255.0
+    pats_l = mask18[..., num_patterns:] * 255.0
+    left = np.concatenate([np.asarray(img_l, np.float32), pats_l], axis=-1)
+    right = np.concatenate([np.asarray(img_r, np.float32), pats_r], axis=-1)
+    return left, right
+
+
+class SLTrainView:
+    """Train-protocol view over ``StructuredLightDataset(with_depth=True)``:
+    items are ``(meta, left12, right12, flow_px, valid)``.
+
+    * ``left12``/``right12`` come from :func:`stack_sl_inputs` — the same
+      stacks serving and the offline evaluator consume.
+    * ``flow_px`` is the left->right disparity in the framework's
+      negative-x-flow pixel convention (core/stereo_datasets.py:77).
+    * ``valid`` folds the MODULATION GATE into depth validity, so the
+      standard masked sequence loss (train/step.sequence_loss) scores only
+      the valid-modulation region — the SL masked loss needs no new loss
+      code.  The gate is read from the left pattern-0 channel: SL rigs
+      project an all-on reference pattern first (sl/synthetic.py writes
+      one; real captures use it for albedo/modulation estimation), so
+      after the dataset's thresholding that channel IS the 0/1 gate.
+
+    Cropping mirrors ``data/sl.SLStereoView``: fixed-size random crops for
+    static jitted shapes; no photometric augmentation (it would destroy
+    the projected-pattern structure the masks encode).
+    """
+
+    def __init__(self, dataset, crop_size: Optional[Tuple[int, int]] = None):
+        assert dataset.with_depth, "SL train view needs with_depth=True"
+        self._ds = dataset
+        self.crop_size = tuple(crop_size) if crop_size else None
+        self.rng = np.random.default_rng(0)
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+        self._ds.reseed(seed)
+
+    def __len__(self) -> int:
+        return len(self._ds)
+
+    def __getitem__(self, index: int):
+        img_l, img_r, mask18, disparity, depth_mask = self._ds[index]
+        n = self._ds.num_patterns
+        left, right = stack_sl_inputs(img_l, img_r, mask18, n)
+        w = disparity.shape[1]
+        flow = (-disparity[..., 1:2] * w).astype(np.float32)  # px, negative
+        gate = mask18[..., n]  # left pattern 0 = all-on reference
+        valid = (depth_mask[..., 1] * gate).astype(np.float32)
+        meta = list(self._ds.samples[index])
+        if self.crop_size is not None:
+            ch, cw = self.crop_size
+            h, w_ = left.shape[:2]
+            if h < ch or w_ < cw:
+                raise ValueError(f"SL frame {h}x{w_} smaller than crop "
+                                 f"{ch}x{cw}; lower crop_size or raise scale")
+            y0 = int(self.rng.integers(0, h - ch + 1))
+            x0 = int(self.rng.integers(0, w_ - cw + 1))
+            sl = np.s_[y0:y0 + ch, x0:x0 + cw]
+            left, right = left[sl], right[sl]
+            flow, valid = flow[sl], valid[sl]
+        return meta, left, right, flow, valid

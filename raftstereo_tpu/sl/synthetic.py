@@ -1,0 +1,186 @@
+"""Exact-GT synthetic structured light.
+
+The passive workloads earn their deterministic EPE gates from
+``data/synthetic.ShiftStereoDataset`` — learnable integer-shift scenes
+with exact ground truth.  This module is the SL twin: the same
+integer-shift construction, but the matchable texture comes from the
+PROJECTOR, not the scene — the ambient pair is deliberately textureless
+(flat gray), so a model can only drive masked EPE toward zero by using
+the pattern channels through the learned SL front.  That property is what
+makes the SL train-convergence gate (tests/test_sl.py) a genuine test of
+pattern conditioning rather than of passive stereo wearing extra
+channels.
+
+Pattern battery per scene (all shifted consistently with the scene, so
+``right(y) = left(y + d)`` holds channel-by-channel, exactly):
+
+* pattern 0 — all-on reference (real rigs capture one for
+  albedo/modulation estimation); after gating it IS the modulation gate,
+  which is how the train view recovers ``valid`` (sl/adapter.py).
+* patterns 1-4 — vertical stripes of distinct periods and random phase
+  (the classic stripe/phase battery); any single stripe is ambiguous
+  modulo its period, the battery jointly is not.
+* patterns 5-8 — random binary speckle (the active-stereo speckle
+  projector), locally unique along every epipolar line.
+
+A configurable band of scene columns returns no projector light: the
+modulation gate is zero there, patterns are dark, and the region is
+excluded from ``valid`` — predictions there are unconstrained garbage,
+which is exactly why the MASKED metrics matter (unmasked EPE on these
+scenes is large; masked EPE trains to ~0).
+
+Two forms, same construction math:
+
+* :class:`SLShiftStereoDataset` — in-memory, items already in the
+  train protocol with 12-channel stacks (tests, certification).
+* :func:`make_learnable_sl` — on-disk, writing the ``data/sl.py`` capture
+  tree layout (ambient_light/, pattern_k/, three_phase/, depth/) so the
+  REAL reader + train view run end-to-end; depth is written as
+  ``focal * baseline / d`` so the reader's depth->disparity conversion
+  returns the integer shift to float32 precision.
+"""
+
+from __future__ import annotations
+
+import os
+from os.path import join
+
+import numpy as np
+from PIL import Image
+
+from ..data.sl import SLCalibration
+from .adapter import NUM_PATTERNS, stack_sl_inputs
+
+__all__ = ["SLShiftStereoDataset", "make_learnable_sl"]
+
+# Flat ambient gray level: textureless on purpose (see module docstring).
+_AMBIENT_GRAY = 96.0
+# Half-periods of the stripe patterns (distinct, so the battery jointly
+# disambiguates shifts any single stripe aliases).
+_STRIPE_HALF_PERIODS = (2, 3, 4, 6)
+
+
+def _make_patterns(rng: np.random.Generator, h: int, span: int,
+                   n: int = NUM_PATTERNS) -> np.ndarray:
+    """(h, span, n) binary 0/1 projector patterns over the scene strip."""
+    pats = [np.ones((h, span), np.float32)]  # pattern 0: all-on reference
+    x = np.arange(span)
+    for p in _STRIPE_HALF_PERIODS:
+        phase = int(rng.integers(2 * p))
+        row = (((x + phase) // p) % 2).astype(np.float32)
+        pats.append(np.tile(row, (h, 1)))
+    while len(pats) < n:
+        pats.append((rng.random((h, span)) > 0.5).astype(np.float32))
+    return np.stack(pats[:n], axis=-1)
+
+
+def _scene(rng: np.random.Generator, hw, max_disp: int, invalid_band: int):
+    """One integer-shift SL scene: returns (di, ambient_l, ambient_r,
+    mask18, gate_l) with the dataset's right-channels-first mask order."""
+    h, w = hw
+    di = int(rng.integers(2, max_disp + 1))
+    span = w + di
+    pats = _make_patterns(rng, h, span)
+    gate = np.ones((h, span), np.float32)
+    if invalid_band:
+        gate[:, :invalid_band] = 0.0  # no projector return here
+    ambient = np.full((h, span, 3), _AMBIENT_GRAY, np.float32)
+    # left(x) matches right(x - d): right(y) = left(y + d), per channel.
+    amb_l, amb_r = ambient[:, :w], ambient[:, di:di + w]
+    gate_l, gate_r = gate[:, :w], gate[:, di:di + w]
+    pat_l = pats[:, :w] * gate_l[..., None]
+    pat_r = pats[:, di:di + w] * gate_r[..., None]
+    mask18 = np.concatenate([pat_r, pat_l], axis=-1).astype(np.float32)
+    return di, amb_l, amb_r, mask18, gate_l
+
+
+class SLShiftStereoDataset:
+    """In-memory exact-GT SL pairs in the train protocol:
+    ``(meta, left12, right12, flow(H,W,1), valid)``.
+
+    The 12-channel stacks are built by :func:`~raftstereo_tpu.sl.adapter.
+    stack_sl_inputs` — the same adapter every other consumer uses, so the
+    items feed training, the offline evaluator and serving unchanged.
+    ``valid`` is the modulation gate (zero over the projector-shadow
+    band); ground truth is the integer shift, exact.
+    """
+
+    def __init__(self, n=16, hw=(64, 96), max_disp=8, seed=0,
+                 invalid_band=6):
+        rng = np.random.default_rng(seed)
+        self._items = []
+        self.disps = []
+        for i in range(n):
+            di, amb_l, amb_r, mask18, gate_l = _scene(
+                rng, hw, max_disp, invalid_band)
+            left, right = stack_sl_inputs(amb_l, amb_r, mask18)
+            flow = np.full((*hw, 1), -float(di), np.float32)
+            self._items.append((["sl", i], left, right, flow,
+                                gate_l.astype(np.float32)))
+            self.disps.append(di)
+
+    def reseed(self, seed):  # loader protocol; the set is static
+        pass
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i % len(self._items)]
+
+
+def make_learnable_sl(root, scenes=("sceneA",), poses=("0001",),
+                      hw=(64, 96), max_disp=8, invalid_band=6,
+                      calibration: SLCalibration = SLCalibration(),
+                      rng=None):
+    """Learnable exact-GT SL capture tree in the ``data/sl.py`` layout.
+
+    The on-disk twin of :class:`SLShiftStereoDataset` (same construction,
+    different transport), the way ``make_learnable_kitti`` twins
+    ``ShiftStereoDataset`` for the passive pipeline: reading it back
+    through ``StructuredLightDataset(with_depth=True, scale=1.0)`` + the
+    SL train view reproduces integer-shift ground truth to float32
+    precision, including the modulation gate.
+
+    Three-phase images are constant per side — equal brightness (zero
+    modulation) over the invalid band, 60-gray-level phase steps
+    elsewhere, so the reader's validation threshold 5.0 AND any training
+    threshold ``|10 + 9·N(0,1)|`` both reproduce the written gate.
+    """
+    rng = rng or np.random.default_rng(0)
+    root = str(root)
+    h, w = hw
+    num = calibration.focal * calibration.baseline
+    for scene in scenes:
+        for pose in poses:
+            di, amb_l, amb_r, mask18, _gate_l = _scene(
+                rng, hw, max_disp, invalid_band)
+            amb = join(root, scene, "ambient_light")
+            os.makedirs(amb, exist_ok=True)
+            for side, img in (("L", amb_l), ("R", amb_r)):
+                Image.fromarray(img.astype(np.uint8)).save(
+                    join(amb, f"{pose}_{side}.png"))
+            tp = join(root, scene, "three_phase")
+            os.makedirs(tp, exist_ok=True)
+            gates = {"l": mask18[..., NUM_PATTERNS],  # left pattern 0
+                     "r": mask18[..., 0]}             # right pattern 0
+            for side, gate in gates.items():
+                for i in range(3):
+                    img = np.where(gate > 0.5, 100 + 60 * i, 100)
+                    Image.fromarray(img.astype(np.uint8)).save(
+                        join(tp, f"{pose}_tp{i + 1}_{side}.png"))
+            for k in range(NUM_PATTERNS):
+                pd = join(root, scene, f"pattern_{k}")
+                os.makedirs(pd, exist_ok=True)
+                # The stored stack is already gated; re-lighting the
+                # shadow band would not survive the reader's gate anyway,
+                # so write the gated masks as the capture.
+                for side, ch in (("l", NUM_PATTERNS + k), ("r", k)):
+                    Image.fromarray(
+                        (mask18[..., ch] * 255).astype(np.uint8)).save(
+                        join(pd, f"{pose}_B_{side}.png"))
+            dp = join(root, scene, "depth")
+            os.makedirs(dp, exist_ok=True)
+            depth = np.full((h, w), num / di, np.float32)
+            for side in ("L", "R"):
+                np.save(join(dp, f"{pose}_depth_{side}.npy"), depth)

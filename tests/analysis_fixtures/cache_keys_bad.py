@@ -77,3 +77,18 @@ class Engine:
                 if key in self._compiled:   # tier missing: RSA401
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_modal(self, pairs, iters, input_mode):
+        # Input-modality selector (sl/, serve/engine.py): a key without
+        # it hands a 3-channel executable a 12-channel batch.
+        h, w = 64, 96
+        key = (h, w, iters, "xla", "fp32")
+        return self._dispatch(key, lambda: (pairs, input_mode))  # RSA401
+
+    def warmup_modal_buckets(self, buckets, iters_list, input_mode):
+        for h, w in buckets:
+            for iters in iters_list:
+                key = (h, w, iters, "xla", "fp32")
+                if key in self._compiled:   # input_mode missing: RSA401
+                    continue
+                self._dispatch(key, lambda: None)

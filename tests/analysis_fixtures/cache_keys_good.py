@@ -87,3 +87,19 @@ class Engine:
                 if key in self._compiled:
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_modal(self, pairs, iters, input_mode):
+        # Input-modality selector (sl/, serve/engine.py): passive and SL
+        # compile different programs over different channel counts, so
+        # the modality joins the key right before the precision mode.
+        h, w = 64, 96
+        key = (h, w, iters, "xla", input_mode, "fp32")
+        return self._dispatch(key, lambda: pairs)
+
+    def warmup_modal_buckets(self, buckets, iters_list, input_mode):
+        for h, w in buckets:
+            for iters in iters_list:
+                key = (h, w, iters, "xla", input_mode, "fp32")
+                if key in self._compiled:
+                    continue
+                self._dispatch(key, lambda: None)

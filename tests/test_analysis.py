@@ -93,7 +93,8 @@ class TestCacheKeys:
             ("RSA401", 16), ("RSA402", 19), ("RSA401", 23),
             ("RSA401", 30), ("RSA401", 35), ("RSA401", 44),
             ("RSA401", 50), ("RSA401", 57), ("RSA401", 62),
-            ("RSA401", 71), ("RSA401", 77)]
+            ("RSA401", 71), ("RSA401", 77), ("RSA401", 86),
+            ("RSA401", 92)]
         assert "precision" in findings[0].message
         assert "mode" in findings[2].message
         # Kernel-backend selectors are key-relevant too: an infer call
@@ -114,6 +115,10 @@ class TestCacheKeys:
         # precision.
         assert "mode" in findings[5].message
         assert "precision" in findings[6].message
+        # Input-modality executables (sl/, serve/engine.py): an infer
+        # call and a warmup ladder whose keys drop input_mode.
+        assert "input_mode" in findings[11].message
+        assert "input_mode" in findings[12].message
 
     def test_good_fixture_is_clean(self):
         # Includes the phase-executable shapes: prologue (no key-relevant

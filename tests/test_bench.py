@@ -54,6 +54,16 @@ def test_gru_mode_contract():
 
 
 @pytest.mark.slow
+def test_sl_mode_contract():
+    r = _run(["--sl", "--quick"])
+    assert r["unit"] == "pairs/sec" and r["value"] > 0
+    assert {"passive_ms_per_batch", "sl_ms_per_batch",
+            "passive_pairs_per_sec", "sl_pairs_per_sec",
+            "sl_slowdown_vs_passive"} <= set(r)
+    assert r["sl_slowdown_vs_passive"] > 0
+
+
+@pytest.mark.slow
 def test_quant_mode_contract():
     r = _run(["--quant", "--quick"])
     assert r["unit"] == "pairs/sec" and r["value"] > 0

@@ -262,14 +262,15 @@ class TestEngineTiers:
         a, b = _img(seed=1), _img(seed=2)
 
         warmed = eng.warmup(iters_list=[2], modes=["fp32", "bf16", "int8"])
-        assert sorted(warmed) == [(64, 96, 2, "xla", "bf16"),
-                                  (64, 96, 2, "xla", "fp32"),
-                                  (64, 96, 2, "xla", "int8")]
+        assert sorted(warmed) == [(64, 96, 2, "xla", "passive", "bf16"),
+                                  (64, 96, 2, "xla", "passive", "fp32"),
+                                  (64, 96, 2, "xla", "passive", "int8")]
         # Stream + sched tier executables (bf16 exercises a non-default
         # mode through BOTH split paths).
         eng.warmup_stream(ladder=[2], modes=["bf16"])
         eng.warmup_sched(iters_per_step=1, modes=["bf16"])
-        assert (64, 96, 2, "stream", "xla", "bf16") in eng.compiled_keys
+        assert (64, 96, 2, "stream", "xla", "passive",
+                "bf16") in eng.compiled_keys
         assert eng.is_stream_warm((64, 96), 2, mode="bf16")
         assert not eng.is_stream_warm((64, 96), 2)  # default not warmed
         assert eng.is_sched_warm((64, 96), 1, mode="bf16")

@@ -298,10 +298,10 @@ class TestSchedEngine:
                            min_duration_s=0.5) as cold:
             warmed = engine.warmup_sched()
         assert sorted(warmed) == [
-            (64, 96, 0, "sched_epilogue", "xla", "fp32"),
-            (64, 96, 0, "sched_join", "xla", "fp32"),
-            (64, 96, 0, "sched_prologue", "xla", "fp32"),
-            (64, 96, 1, "sched_step", "xla", "fp32")]
+            (64, 96, 0, "sched_epilogue", "xla", "passive", "fp32"),
+            (64, 96, 0, "sched_join", "xla", "passive", "fp32"),
+            (64, 96, 0, "sched_prologue", "xla", "passive", "fp32"),
+            (64, 96, 1, "sched_step", "xla", "passive", "fp32")]
         # The step executable (the GRU body) is a model-scale compile:
         # if the 0.5 s floor ever rises above the real compile times, the
         # warm budget-0 guard below would pass vacuously — keep that loud.

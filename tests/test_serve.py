@@ -207,8 +207,8 @@ class TestEngine:
         eng = BatchEngine(model, variables, cfg)
         # Warmup compiles the configured bucket at BOTH iteration levels.
         warmed = eng.warmup()
-        assert sorted(warmed) == [(64, 96, 1, "xla", "fp32"),
-                                  (64, 96, 2, "xla", "fp32")]
+        assert sorted(warmed) == [(64, 96, 1, "xla", "passive", "fp32"),
+                                  (64, 96, 2, "xla", "passive", "fp32")]
         a, b = _img(60, 90, 1), _img(64, 96, 2)  # same 64x96 bucket
         eng.infer_batch([(a, a)], iters=2)
         assert not eng.last_included_compile  # warmup paid the compile
@@ -368,7 +368,7 @@ class TestEndToEnd:
             # would pass vacuously — this assert makes that loud.
             assert cold_report.compiles == 2, cold_report.durations
             assert server.engine.compiled_keys == {
-                (64, 96, 3, "xla", "fp32"), (96, 128, 3, "xla", "fp32")}
+                (64, 96, 3, "xla", "passive", "fp32"), (96, 128, 3, "xla", "passive", "fp32")}
             assert metrics.compile_misses.value == 2
 
             # (2) bitwise equality with the single-image Evaluator under
@@ -465,8 +465,8 @@ class TestEndToEnd:
             health = client.healthz()
             assert health["status"] == "ok"
             assert sorted(tuple(k) for k in health["compiled_buckets"]) \
-                == [(64, 96, 3, "xla", "fp32"),
-                    (96, 128, 3, "xla", "fp32")]
+                == [(64, 96, 3, "xla", "passive", "fp32"),
+                    (96, 128, 3, "xla", "passive", "fp32")]
             client.close()
         finally:
             server.close()

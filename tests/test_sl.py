@@ -1,0 +1,318 @@
+"""Structured-light (SL) subsystem acceptance (raftstereo_tpu/sl,
+docs/structured_light.md).
+
+The four gates:
+
+1. training on synthetic exact-GT SL captures reaches a masked-EPE gate in
+   a bounded number of steps (the workload LEARNS end to end),
+2. ``/predict`` with pattern-channel input is bitwise-identical to the
+   offline serving-parity Evaluator,
+3. a warmed SL bucket serves under a retrace budget of zero,
+4. the passive default path is bitwise-unchanged (no SL parameters in a
+   passive tree, reproducible init/forward).
+
+Plus unit coverage for the adapter's channel order, the exact-GT synthetic
+generator (in-memory and on-disk), the SL validator, and SL-aware
+certification manifests.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from raftstereo_tpu.config import RAFTStereoConfig, ServeConfig, TrainConfig
+from raftstereo_tpu.models import RAFTStereo
+from raftstereo_tpu.sl import (NUM_PATTERNS, SL_CHANNELS, SLShiftStereoDataset,
+                               SLTrainView, make_learnable_sl, masked_epe,
+                               stack_sl_inputs)
+
+from test_bench import REPO
+
+TINY = dict(corr_levels=2, corr_radius=2, n_gru_layers=2, hidden_dims=(32, 32))
+SL_CFG = RAFTStereoConfig(input_mode="sl", **TINY)
+PASSIVE_CFG = RAFTStereoConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def sl_model():
+    model = RAFTStereo(SL_CFG)
+    variables = model.init(jax.random.key(0), (64, 96))
+    return model, variables
+
+
+# ------------------------------------------------------------------ adapter
+
+class TestAdapter:
+    def test_channel_order_and_scale(self, rng):
+        """left12 = ambient RGB + LEFT patterns x255; right12 = ambient RGB
+        + RIGHT patterns x255 (mask18 is 9 right then 9 left)."""
+        h, w = 8, 10
+        img_l = rng.random((h, w, 3)).astype(np.float32) * 255
+        img_r = rng.random((h, w, 3)).astype(np.float32) * 255
+        mask18 = (rng.random((h, w, 2 * NUM_PATTERNS)) > 0.5).astype(
+            np.float32)
+        left12, right12 = stack_sl_inputs(img_l, img_r, mask18)
+        assert left12.shape == (h, w, SL_CHANNELS)
+        assert right12.shape == (h, w, SL_CHANNELS)
+        np.testing.assert_array_equal(left12[..., :3], img_l)
+        np.testing.assert_array_equal(right12[..., :3], img_r)
+        for k in range(NUM_PATTERNS):
+            np.testing.assert_array_equal(
+                left12[..., 3 + k], mask18[..., NUM_PATTERNS + k] * 255.0)
+            np.testing.assert_array_equal(
+                right12[..., 3 + k], mask18[..., k] * 255.0)
+
+    def test_config_channels(self):
+        assert SL_CHANNELS == 3 + NUM_PATTERNS == 12
+        assert PASSIVE_CFG.input_channels == 3
+        assert SL_CFG.input_channels == SL_CHANNELS
+
+
+# ------------------------------------------------------- synthetic exact GT
+
+class TestSyntheticExactGT:
+    def test_shift_consistency_and_flow(self):
+        """The generator is exact by construction: the right view is the
+        left view shifted by an integer disparity, so every pattern channel
+        obeys left[:, x] == right[:, x - d] wherever the gate is on, and
+        the GT flow is the constant -d."""
+        ds = SLShiftStereoDataset(n=4, hw=(32, 48), max_disp=5, seed=0,
+                                  invalid_band=4)
+        assert len(ds) == 4
+        for i in range(4):
+            meta, left12, right12, flow, valid = ds[i]
+            di = int(ds.disps[i])
+            assert meta == ["sl", i]
+            assert left12.shape == (32, 48, SL_CHANNELS)
+            assert flow.shape == (32, 48, 1)
+            np.testing.assert_array_equal(np.unique(flow), [-float(di)])
+            # Occlusion/shadow band: the left columns with no right match.
+            assert valid[:, :4].max() == 0.0
+            assert valid[:, 4:].min() == 1.0
+            gate = valid[..., None]
+            np.testing.assert_array_equal(
+                (left12[:, di:, 3:] * gate[:, di:]),
+                (right12[:, :-di, 3:] * gate[:, di:]))
+
+    def test_deterministic_and_reseed_noop(self):
+        a = SLShiftStereoDataset(n=3, hw=(16, 24), seed=7)
+        b = SLShiftStereoDataset(n=3, hw=(16, 24), seed=7)
+        np.testing.assert_array_equal(a[1][1], b[1][1])
+        assert a.disps == b.disps
+        a.reseed(99)  # loader-protocol no-op: items are index-deterministic
+        np.testing.assert_array_equal(a[1][1], b[1][1])
+        c = SLShiftStereoDataset(n=3, hw=(16, 24), seed=8)
+        assert any(not np.array_equal(a[i][1], c[i][1]) for i in range(3))
+
+    def test_make_learnable_sl_roundtrip(self, tmp_path):
+        """The on-disk tree re-read through the REAL reader stack
+        (StructuredLightDataset -> SLTrainView) reproduces the exact-GT
+        semantics: constant integer flow, the shadow band invalid, and
+        shift-consistent pattern channels."""
+        from raftstereo_tpu.data.sl import StructuredLightDataset
+
+        make_learnable_sl(str(tmp_path), poses=("0001", "0002"), hw=(32, 48),
+                          max_disp=6, invalid_band=6,
+                          rng=np.random.default_rng(0))
+        view = SLTrainView(StructuredLightDataset(
+            str(tmp_path), split="validation", scale=1.0, with_depth=True))
+        assert len(view) == 2
+        for i in range(2):
+            meta, img_l, img_r, flow, valid = view[i]
+            uniq = np.unique(np.round(flow[valid > 0]))
+            assert uniq.size == 1 and uniq[0] <= -2.0  # one integer shift
+            di = int(-uniq[0])
+            left12, right12 = img_l, img_r
+            gate = valid[..., None]
+            np.testing.assert_allclose(
+                left12[:, di:, 3:] * gate[:, di:],
+                right12[:, :-di, 3:] * gate[:, di:], atol=1e-5)
+            # The shadow band plus the zero-modulation strip stay masked.
+            assert valid[:, :6].max() == 0.0
+            assert valid[:, 6:].mean() == 1.0
+
+
+# ---------------------------------------------------------- validator / cli
+
+class TestValidatorAndCli:
+    def test_validate_sl_metrics(self, sl_model):
+        from raftstereo_tpu.eval.validate import VALIDATORS, validate_sl
+
+        assert VALIDATORS["sl"] is validate_sl
+        model, variables = sl_model
+        ds = SLShiftStereoDataset(n=2, hw=(32, 48), max_disp=4, seed=1)
+        results = validate_sl(model, variables, iters=2, dataset=ds)
+        assert set(results) == {"sl-epe", "sl-d1"}
+        assert np.isfinite(results["sl-epe"])
+        assert 0.0 <= results["sl-d1"] <= 100.0
+
+    def test_cli_sl_stats_only(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "raftstereo_tpu.cli.sl", "--stats_only",
+             "--pairs", "2", "--hw", "16", "24"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+        assert out.returncode == 0, out.stderr[-2000:]
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["samples"] == 2 and rec["channels"] == SL_CHANNELS
+        assert rec["valid_frac"] > 0
+
+
+# ------------------------------------------------------------- certification
+
+class TestCertifySL:
+    @pytest.mark.slow
+    def test_sl_manifest_and_cross_mode_refusal(self, sl_model):
+        from raftstereo_tpu.eval.certify import certify_tiers, tier_ok
+
+        model, variables = sl_model
+        manifest = certify_tiers(SL_CFG, variables, ("fast",), hw=(32, 48),
+                                 n_pairs=2, iters=2)
+        assert manifest["model"]["input_mode"] == "sl"
+        assert "SL" in manifest["eval"]["data"]
+        ok, _ = tier_ok(manifest, "fast", model_config=SL_CFG)
+        entry = manifest["tiers"]["fast"]
+        assert ok == bool(entry["certified"])
+        # The fingerprint keys the manifest to the input mode: a passive
+        # model (same arch otherwise) must be refused.
+        ok, reason = tier_ok(manifest, "fast", model_config=PASSIVE_CFG)
+        assert not ok and "input_mode" in reason
+
+
+# ------------------------------------------------------------- passive gate
+
+class TestPassiveUnchanged:
+    def test_passive_tree_has_no_sl_params_and_is_reproducible(self):
+        model = RAFTStereo(PASSIVE_CFG)
+        v1 = model.init(jax.random.key(0), (32, 48))
+        v2 = model.init(jax.random.key(0), (32, 48))
+        flat1 = jax.tree_util.tree_flatten_with_path(v1)[0]
+        flat2 = jax.tree_util.tree_flatten_with_path(v2)[0]
+        names = [jax.tree_util.keystr(p) for p, _ in flat1]
+        assert not any("sl_proj" in n for n in names), names
+        for (_, a), (_, b) in zip(flat1, flat2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_passive_forward_deterministic(self, tiny_model):
+        model, variables = tiny_model
+        rng = np.random.default_rng(0)
+        l = rng.random((1, 32, 48, 3)).astype(np.float32) * 255
+        r = rng.random((1, 32, 48, 3)).astype(np.float32) * 255
+        fn = jax.jit(lambda a, b: model.forward(variables, a, b, iters=2,
+                                                test_mode=True)[1])
+        np.testing.assert_array_equal(np.asarray(fn(l, r)),
+                                      np.asarray(fn(l, r)))
+
+    def test_sl_model_consumes_12_channels_only(self, sl_model):
+        model, variables = sl_model
+        rng = np.random.default_rng(0)
+        l3 = rng.random((1, 32, 48, 3)).astype(np.float32)
+        with pytest.raises(Exception):
+            model.forward(variables, l3, l3, iters=1, test_mode=True)
+
+
+# --------------------------------------------------------------- serving e2e
+
+class TestServingE2E:
+    def test_sl_predict_bitwise_and_warm_retrace_zero(self, sl_model,
+                                                      retrace_guard):
+        """SL acceptance over real HTTP: warmup compiles the SL bucket,
+        /predict with 12-channel input matches the offline serving-parity
+        Evaluator bitwise, warm traffic stays under a retrace budget of
+        ZERO, and channel-count admission is enforced for the mode."""
+        from raftstereo_tpu.eval import Evaluator
+        from raftstereo_tpu.serve import (ServeClient, ServeError,
+                                          ServeMetrics, build_server)
+
+        model, variables = sl_model
+        ds = SLShiftStereoDataset(n=2, hw=(64, 96), max_disp=8, seed=3)
+        pairs = [(ds[i][1], ds[i][2]) for i in range(2)]
+        flows = [ds[i][3] for i in range(2)]
+        valids = [ds[i][4] for i in range(2)]
+
+        cfg = ServeConfig(port=0, bucket_multiple=32, buckets=((64, 96),),
+                          warmup=True, max_batch_size=2, max_wait_ms=10.0,
+                          queue_limit=8, request_timeout_ms=120000.0,
+                          iters=3, degraded_iters=3)
+        # Offline serving-parity reference FIRST (its compile must not
+        # land inside the retrace budget below): same bucket policy, same
+        # iters, batch_pad = the engine's padded batch size.
+        metrics_off, preds = masked_epe(model, variables, ds, iters=3,
+                                        divis_by=32, bucket_multiple=32,
+                                        batch_pad=cfg.max_batch_size)
+        assert np.isfinite(metrics_off["epe"])
+
+        metrics = ServeMetrics()
+        server = build_server(model, variables, cfg, metrics)  # warms
+        assert server.engine.input_mode == "sl"
+        assert server.engine.input_channels == SL_CHANNELS
+        assert (64, 96, 3, "xla", "sl", "fp32") in server.engine.compiled_keys
+        warm_misses = metrics.compile_misses.value
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            client = ServeClient("127.0.0.1", server.port, timeout=120)
+            with retrace_guard(0, what="warmed SL bucket serves with zero "
+                                       "retraces", min_duration_s=0.5):
+                for (left12, right12), pred in zip(pairs, preds):
+                    disp, meta = client.predict(left12, right12)
+                    assert disp.shape == (64, 96)
+                    # Bitwise: identical program shapes -> identical
+                    # numerics between /predict and the offline evaluator.
+                    np.testing.assert_array_equal(disp, pred)
+            assert metrics.compile_misses.value == warm_misses
+            # The served disparities track the exact GT where valid (the
+            # model is untrained, so only consistency is asserted — the
+            # learning gate lives in TestTrainToGate).
+            for pred, flow, valid in zip(preds, flows, valids):
+                assert np.isfinite(pred[valid > 0]).all()
+            # Admission: a 3-channel pair is the WRONG modality for an SL
+            # server — a 400 naming the mode, never a fresh compile.
+            rgb = np.zeros((64, 96, 3), np.float32)
+            with pytest.raises(ServeError) as ei:
+                client.predict(rgb, rgb)
+            assert ei.value.status == 400
+            assert metrics.compile_misses.value == warm_misses
+            client.close()
+        finally:
+            server.close()
+
+
+# ------------------------------------------------------------- train-to-gate
+
+class TestTrainToGate:
+    @pytest.mark.slow
+    def test_sl_training_reaches_masked_epe_gate(self, tmp_path,
+                                                 monkeypatch):
+        """The workload LEARNS: from-scratch training on exact-GT synthetic
+        SL captures must reach the masked-EPE gate within a bounded number
+        of steps (and improve on init by a wide margin)."""
+        from raftstereo_tpu.cli.train import train
+
+        monkeypatch.chdir(tmp_path)
+        ds = SLShiftStereoDataset(n=8, hw=(32, 48), max_disp=6, seed=0)
+        model = RAFTStereo(SL_CFG)
+        v0 = model.init(jax.random.key(3), (32, 48))
+        init_metrics, _ = masked_epe(model, v0, ds, iters=8)
+
+        tcfg = TrainConfig(name="sl-gate", batch_size=4, num_steps=200,
+                           train_iters=4, image_size=(32, 48), lr=1e-3,
+                           validation_frequency=10**6, seed=3,
+                           data_parallel=1,
+                           checkpoint_dir=str(tmp_path / "ckpt"))
+        state = train(SL_CFG, tcfg, dataset=ds, num_workers=0,
+                      no_validation=True, workload="sl")
+        assert int(state.step) >= tcfg.num_steps
+
+        final_metrics, _ = masked_epe(model, state.variables, ds, iters=8)
+        # Fixed gate, calibrated with ~3x margin on this exact recipe
+        # (measured 1.37 masked EPE from an init of ~80 on CPU).
+        assert final_metrics["epe"] <= 4.0, (init_metrics, final_metrics)
+        assert final_metrics["epe"] <= 0.1 * init_metrics["epe"]

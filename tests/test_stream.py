@@ -195,8 +195,8 @@ class TestEngineStream:
         # Mixed plain/stream compile keys coexist (and stay sortable for
         # /healthz).
         keys = eng.compiled_keys
-        assert (64, 96, 12, "xla", "fp32") in keys
-        assert (64, 96, 12, "stream", "xla", "fp32") in keys
+        assert (64, 96, 12, "xla", "passive", "fp32") in keys
+        assert (64, 96, 12, "stream", "xla", "passive", "fp32") in keys
         sorted(keys)
 
     def test_flow_init_shape_validated(self, stream_engine):
@@ -392,7 +392,7 @@ class TestEndToEnd:
                 assert health["stream"]["session_limit"] == 2
                 assert sorted({k[2] for k in map(
                     tuple, health["compiled_buckets"])
-                    if len(k) == 6 and k[3] == "stream"}) == [6, 12]
+                    if len(k) == 7 and k[3] == "stream"}) == [6, 12]
                 # Stream warmup compiled the two ladder levels; the session
                 # traffic above added none — the engine-level view of the
                 # budget the retrace guard just enforced for real.
