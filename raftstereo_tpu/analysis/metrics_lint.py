@@ -69,6 +69,9 @@ def run_metrics_lint() -> List[Finding]:
     cluster.set_states({"ready": 1})
     cluster.queue_depth.labels(replica="r0").set(0)
     cluster.dispatch.labels(replica="r0", outcome="ok").inc()
+    cluster.session_repins.labels(reason="draining").inc()
+    cluster.session_handoffs.labels(outcome="warm").inc()
+    cluster.autoscale_recommendation.set(0)
     cluster.probe_failures.labels(replica="r0").inc()
     cluster.router_latency.observe(0.001)
     for msg in validate_prometheus(registry.render()):

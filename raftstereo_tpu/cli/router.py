@@ -9,10 +9,17 @@ Start two backends (possibly on different hosts/chips), then the router:
 
 Clients talk to the router exactly like a single server (`serve
 --loadgen`, `serve/client.py`): cold requests spread over the ready
-backends with failover, session frames pin to one backend, and
-``GET /metrics`` exposes the ``cluster_*`` autoscaling families.
-``POST /debug/drain`` with ``{"backend": "b0"}`` drains one backend for
-maintenance/scale-in.  Semantics: docs/serving.md "Cluster".
+backends with failover, session frames pin to one backend — and when a
+backend drains or dies the router MIGRATES the session's warm-start
+state to its new home over the backends' ``/debug/sessions`` endpoints
+(any backend can resume any stream).  ``GET /metrics`` exposes the
+``cluster_*`` autoscaling families plus the ``ops/autoscale.py`` scale
+advice.  ``POST /debug/drain`` with ``{"backend": "b0"}`` drains one
+backend for maintenance/scale-in; ``POST /debug/restart`` is the
+zero-downtime rolling-restart verb (drain -> warm session handoff ->
+operator restarts with warmup_async -> readiness-gated rejoin).
+Semantics: docs/serving.md "Cluster" and "Session migration & rolling
+restart".
 
 The router is model-free: it never imports the engine/model stack
 (jax/flax/weights — the serve package exports lazily to keep it that
@@ -50,7 +57,8 @@ def main(argv=None) -> int:
         "routing": f"http://{cfg.host}:{router.port}",
         "backends": [f"{h}:{p}" for h, p in cfg.backends],
         "endpoints": ["/predict", "/metrics", "/healthz", "/debug/trace",
-                      "/debug/threads", "/debug/vars", "/debug/drain"],
+                      "/debug/threads", "/debug/vars", "/debug/drain",
+                      "/debug/restart"],
     }), flush=True)
     try:
         router.serve_forever()

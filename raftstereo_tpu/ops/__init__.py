@@ -1,21 +1,54 @@
-"""TPU-first primitive ops: sampling, resizing, pooling, correlation, upsampling."""
+"""TPU-first primitive ops: sampling, resizing, pooling, correlation,
+upsampling — plus the stdlib-only ``autoscale`` recommendation loop.
 
-from .image import (InputPadder, avg_pool2x, avg_pool4x, avg_pool_w2,
-                    coords_grid_x, forward_interpolate, gauss_blur,
-                    replicate_pad, resize_bilinear_align_corners)
-from .sampler import linear_sample_1d, linear_sample_1d_dense
-from .upsample import convex_upsample, extract_3x3_patches, upsample_interp
-from .corr import (build_corr_pyramid, build_corr_volume,
-                   build_fmap2_pyramid, make_alt_corr_fn, make_corr_fn,
-                   make_pallas_alt_corr_fn, make_reg_corr_fn)
+Lazy (PEP 562) exports: importing this package must stay cheap so the
+model-free surfaces (cli.router, serve/cluster/router.py, ops/autoscale
+consumers) never drag in jax — every kernel submodule imports jax at
+module scope.  ``from raftstereo_tpu.ops import X`` works unchanged; the
+submodule is imported on first attribute access.
+"""
 
-__all__ = [
-    "InputPadder", "avg_pool2x", "avg_pool4x", "avg_pool_w2", "coords_grid_x",
-    "forward_interpolate", "gauss_blur", "replicate_pad",
-    "resize_bilinear_align_corners",
-    "linear_sample_1d", "linear_sample_1d_dense",
-    "convex_upsample", "extract_3x3_patches", "upsample_interp",
-    "build_corr_pyramid", "build_corr_volume", "build_fmap2_pyramid",
-    "make_alt_corr_fn", "make_corr_fn", "make_pallas_alt_corr_fn",
-    "make_reg_corr_fn",
-]
+import importlib
+
+_EXPORTS = {
+    "InputPadder": ".image",
+    "avg_pool2x": ".image",
+    "avg_pool4x": ".image",
+    "avg_pool_w2": ".image",
+    "coords_grid_x": ".image",
+    "forward_interpolate": ".image",
+    "gauss_blur": ".image",
+    "replicate_pad": ".image",
+    "resize_bilinear_align_corners": ".image",
+    "linear_sample_1d": ".sampler",
+    "linear_sample_1d_dense": ".sampler",
+    "convex_upsample": ".upsample",
+    "extract_3x3_patches": ".upsample",
+    "upsample_interp": ".upsample",
+    "build_corr_pyramid": ".corr",
+    "build_corr_volume": ".corr",
+    "build_fmap2_pyramid": ".corr",
+    "make_alt_corr_fn": ".corr",
+    "make_corr_fn": ".corr",
+    "make_pallas_alt_corr_fn": ".corr",
+    "make_reg_corr_fn": ".corr",
+    "Autoscaler": ".autoscale",
+    "AutoscalePolicy": ".autoscale",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        rel = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    value = getattr(importlib.import_module(rel, __name__), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

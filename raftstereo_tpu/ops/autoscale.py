@@ -1,0 +1,122 @@
+"""Autoscaling recommendation loop over the landed cluster signals.
+
+docs/serving.md has named ``cluster_utilization``, ``sched_occupancy``
+and the shed rate (``cluster_dispatch_total{outcome="shed"}``) as the
+autoscaling inputs since PR 8 — this module is the first consumer.  It
+is deliberately stdlib-only (no jax, no numpy): the in-process
+dispatcher and the model-free ``cli.router`` both embed it, and the
+router must stay importable without the engine stack
+(``tests/test_cluster.py::test_router_import_is_model_free``).
+
+The loop only RECOMMENDS — surfacing advice in ``/debug/vars`` and the
+``cluster_autoscale_recommendation`` gauge (positive = scale out,
+negative = scale in, 0 = hold).  Acting on it is the operator's (or an
+external controller's) job: this container cannot add chips, and a
+wrong automatic scale-in would shed real traffic.  Recommendations are
+hysteresis-damped (``AutoscalePolicy.hysteresis`` consecutive
+observations agree before advice becomes non-zero) so a single bursty
+scrape never flaps the gauge — except sheds, which mean traffic was
+REFUSED and warrant immediate scale-out advice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Tuple
+
+__all__ = ["AutoscalePolicy", "Autoscaler", "recommend"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Thresholds for the recommendation loop (fractions are 0-1)."""
+
+    # Mean occupied fraction of ready replicas' batch capacity above
+    # which the cluster is running hot (cluster_utilization).
+    high_utilization: float = 0.75
+    # Below this, capacity is idle enough to recommend scale-in.
+    low_utilization: float = 0.25
+    # Scheduler-mode occupancy (sched_occupancy) that signals the
+    # running batches themselves are saturated.
+    high_occupancy: float = 0.85
+    # Never recommend scaling below this many replicas.
+    min_replicas: int = 1
+    # Largest single-step recommendation in either direction.
+    max_step: int = 1
+    # Consecutive agreeing observations before non-shed advice fires.
+    hysteresis: int = 2
+
+
+def recommend(policy: AutoscalePolicy, *, ready: int, utilization: float,
+              occupancy: Optional[float] = None,
+              shed_delta: float = 0.0) -> Tuple[int, str]:
+    """Classify ONE observation into ``(direction, reason)`` with
+    direction in {-1, 0, +1}.  Pure — the stateful hysteresis/shed-rate
+    tracking lives in :class:`Autoscaler`."""
+    if ready <= 0:
+        return 0, "no ready replicas to measure"
+    if shed_delta > 0:
+        return 1, (f"shed {shed_delta:g} request(s) since last "
+                   "observation — capacity was refused")
+    if utilization >= policy.high_utilization:
+        return 1, (f"utilization {utilization:.2f} >= "
+                   f"{policy.high_utilization:.2f}")
+    if occupancy is not None and occupancy >= policy.high_occupancy:
+        return 1, (f"sched occupancy {occupancy:.2f} >= "
+                   f"{policy.high_occupancy:.2f}")
+    if utilization <= policy.low_utilization and \
+            ready > policy.min_replicas:
+        return -1, (f"utilization {utilization:.2f} <= "
+                    f"{policy.low_utilization:.2f} with {ready} ready")
+    return 0, "signals within band"
+
+
+class Autoscaler:
+    """Stateful wrapper: tracks the shed-counter delta and the
+    hysteresis streak across observations.  Thread-safe — the dispatcher
+    calls ``observe`` from every request-settling thread."""
+
+    def __init__(self, policy: Optional[AutoscalePolicy] = None):
+        self.policy = policy or AutoscalePolicy()
+        self._lock = threading.Lock()
+        self._last_shed = 0.0  # guarded_by: _lock
+        self._streak_dir = 0  # guarded_by: _lock
+        self._streak = 0  # guarded_by: _lock
+
+    def observe(self, *, ready: int, utilization: float,
+                occupancy: Optional[float] = None,
+                shed_total: float = 0.0) -> Dict[str, object]:
+        """Fold one observation in; returns the advice dict surfaced in
+        ``/debug/vars`` (``delta`` is what the gauge exports)."""
+        policy = self.policy
+        with self._lock:
+            shed_delta = max(0.0, shed_total - self._last_shed)
+            self._last_shed = max(self._last_shed, shed_total)
+            direction, reason = recommend(
+                policy, ready=ready, utilization=utilization,
+                occupancy=occupancy, shed_delta=shed_delta)
+            if direction == self._streak_dir:
+                self._streak += 1
+            else:
+                self._streak_dir, self._streak = direction, 1
+            # Sheds mean refused traffic: act on the first observation.
+            fire = direction != 0 and (shed_delta > 0
+                                       or self._streak >= policy.hysteresis)
+            delta = direction * policy.max_step if fire else 0
+            if delta < 0:
+                delta = -min(-delta, max(0, ready - policy.min_replicas))
+        action = ("scale_up" if delta > 0
+                  else "scale_down" if delta < 0 else "hold")
+        return {
+            "action": action,
+            "delta": delta,
+            "reason": reason,
+            "signals": {
+                "ready": ready,
+                "utilization": round(utilization, 4),
+                "occupancy": (round(occupancy, 4)
+                              if occupancy is not None else None),
+                "shed_delta": shed_delta,
+            },
+        }

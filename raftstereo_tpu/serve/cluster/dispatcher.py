@@ -13,11 +13,20 @@ Placement policy:
   spills to the next-least-loaded one — the cluster is only overloaded
   when every ready replica is;
 * **session frames are sticky**: RAFT's warm-start state (the previous
-  frame's low-res disparity) lives in the pinned replica's session
-  store, so moving a session means losing its state.  A frame re-pins
-  only when its replica is gone (failed/draining) — the new replica
-  serves it as a cold frame, never an error (the PR 3 contract), and
-  ``cluster_session_repins_total`` counts it;
+  frame's low-res disparity + controller EMA) lives in the pinned
+  replica's session store.  A frame re-pins only when its replica is
+  unusable (failed/draining/pin evicted) — and since PR 13 the re-pin
+  performs a replica-to-replica WARM HANDOFF first: the old home's state
+  is exported (``SessionStore.export_state``) and imported into the new
+  one, so the next frame runs warm whenever the engines' state-schema
+  fingerprints agree.  ``cluster_session_repins_total{reason=}`` counts
+  why the pin moved and ``cluster_session_handoffs_total{outcome=}``
+  whether the warmth survived (warm / cold_schema / cold_lost — cold is
+  a documented fallback, never an error, the PR 3 contract);
+* **drain migrates proactively**: ``drain_replica`` (the rolling-restart
+  verb behind ``POST /debug/restart``) exports every live session off
+  the draining replica and re-pins it warm BEFORE the next frame
+  arrives, so a planned restart costs zero cold frames;
 * **scheduled jobs stay put**: a request that joined a replica's running
   batch completes there; the dispatcher never migrates device-resident
   carried state.
@@ -36,6 +45,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ...config import ServeConfig
+from ...ops.autoscale import Autoscaler
 from ..batcher import Future, Overloaded, RequestTimedOut, ShuttingDown
 from ..metrics import ClusterMetrics, ServeMetrics
 from .pins import PinTable
@@ -85,6 +95,14 @@ class ClusterDispatcher:
         # exactly like a lost session: next frame re-pins and runs cold).
         self._pins = PinTable(self.rset.cluster_cfg.session_pin_limit)
         self._closed = False  # guarded_by: _lock
+        # Export-in-flight markers: one migration per session at a time
+        # (a per-frame re-pin handoff racing a drain-time sweep would
+        # export the same state twice for nothing — the store's monotonic
+        # import guard makes the race safe, the marker makes it cheap).
+        self._migrate_lock = threading.Lock()
+        self._migrating = set()  # guarded_by: _migrate_lock
+        self._autoscaler = Autoscaler()
+        self._advice: Dict[str, object] = {}
 
     # ----------------------------------------------------------- placement
 
@@ -157,6 +175,18 @@ class ClusterDispatcher:
                         + child.value
             for prio, depth in by_prio.items():
                 sm.sched_queue_depth.labels(priority=prio).set(depth)
+        # Feed the landed autoscaling signals through the recommendation
+        # loop (ops/autoscale.py) — advice surfaces in /debug/vars and
+        # the cluster_autoscale_recommendation gauge.
+        shed = sum(child.value for labels, child in cm.dispatch.series()
+                   if labels[1] == "shed")
+        advice = self._autoscaler.observe(
+            ready=len(ready), utilization=cm.utilization.value,
+            occupancy=(sm.sched_occupancy.value
+                       if self.cfg.sched is not None else None),
+            shed_total=shed)
+        cm.autoscale_recommendation.set(advice["delta"])
+        self._advice = advice
 
     # ------------------------------------------------------------ admission
 
@@ -173,6 +203,8 @@ class ClusterDispatcher:
         info = self.rset.stats()
         info["session_pins"] = len(self._pins)
         info["queue_depth"] = self.queue_depth
+        if self._advice:
+            info["autoscale"] = self._advice
         if self.cfg.sched is not None:
             # The scheduler-mode healthz block: aggregate the per-replica
             # scheduler snapshots under the usual keys.
@@ -240,11 +272,16 @@ class ClusterDispatcher:
 
     def _pin(self, session_id: str) -> Replica:
         """Sticky replica for a session, (re)pinning as needed (one
-        atomic decision inside the shared PinTable)."""
+        atomic decision inside the shared PinTable).  A re-pin attempts
+        the warm handoff from the old home before the frame runs — this
+        is how a frame arriving inside the drain window (replica marked
+        draining, sweep not there yet) still gets its state: the export
+        serializes on the session lock, so it sees the last completed
+        frame."""
         with self._lock:
             if self._closed:
                 raise ShuttingDown("cluster dispatcher stopped")
-        rid, repinned = self._pins.pin(
+        rid, repinned, old = self._pins.pin(
             session_id,
             still_ok=lambda r: self.rset.replicas[r].routable(),
             choose=lambda: (lambda c: c[0].rid if c else None)(
@@ -253,8 +290,144 @@ class ClusterDispatcher:
             raise ShuttingDown(
                 f"no ready replica for session {session_id!r}")
         if repinned:
-            self.cluster_metrics.session_repins.inc()
+            self.cluster_metrics.session_repins.labels(
+                reason=self._repin_reason(old)).inc()
+            self._handoff(session_id, old, rid)
         return self.rset.replicas[rid]
+
+    def _repin_reason(self, old_rid: Optional[int]) -> str:
+        """Why the old pin was unusable (the repins metric label)."""
+        if old_rid is None:
+            return "evicted"
+        state = self.rset.replicas[old_rid].state
+        if state in ("draining", "drained"):
+            return "draining"
+        if state == "failed":
+            return "failed"
+        return "evicted"
+
+    # ------------------------------------------------------------ migration
+
+    def _handoff(self, session_id: str, src_rid: Optional[int],
+                 dst_rid: int) -> Optional[str]:
+        """Move one session's warm-start state ``src -> dst``; returns the
+        counted outcome, or None when the move was a no-op (same replica,
+        unknown source, or another thread already migrating this
+        session).  Never raises and performs no device work — migration
+        is pure host numpy, invisible to the retrace guard."""
+        if src_rid is None or src_rid == dst_rid:
+            return None
+        with self._migrate_lock:
+            if session_id in self._migrating:
+                return None
+            self._migrating.add(session_id)
+        try:
+            outcome = self._transfer(session_id,
+                                     self.rset.replicas[src_rid],
+                                     self.rset.replicas[dst_rid])
+        finally:
+            with self._migrate_lock:
+                self._migrating.discard(session_id)
+        self.cluster_metrics.session_handoffs.labels(
+            outcome=outcome).inc()
+        return outcome
+
+    @staticmethod
+    def _transfer(session_id: str, src: Replica, dst: Replica) -> str:
+        """Export from ``src``, import into ``dst`` (both sides are
+        StreamRunner seams; a replica without one — or without anything
+        warm to export — is the cold_lost fallback)."""
+        exporter = getattr(src.stream, "export_session", None) \
+            if src.stream is not None else None
+        importer = getattr(dst.stream, "import_session", None) \
+            if dst.stream is not None else None
+        if exporter is None or importer is None:
+            return "cold_lost"
+        snapshot = exporter(session_id)
+        if snapshot is None:
+            return "cold_lost"
+        return importer(snapshot)
+
+    def drain_replica(self, rid: int) -> Dict[str, object]:
+        """Drain ONE replica and migrate its live sessions to the
+        remaining ready replicas — the rolling-restart verb.  State moves
+        BEFORE the pins do, so each migrated session's next frame runs
+        warm on its new home; a frame that races the sweep takes the
+        re-pin handoff path instead and ends up identical (the store's
+        monotonic import guard keeps whichever state is fresher)."""
+        src = self.rset.replicas[rid]
+        src.drain()
+        self._refresh_gauges()
+        outcomes: Dict[str, str] = {}
+        cands = [r for r in self._candidates() if r.rid != rid]
+        if not cands:
+            return {"replica": src.name, "migrated": outcomes,
+                    "note": "no ready replica to migrate to"}
+        # Pinned sessions plus any state-only stragglers whose pin was
+        # LRU-evicted while their warmth survived in the store.
+        worklist = list(dict.fromkeys(
+            self._pins.pinned_to(rid)
+            + (src.stream.store.session_ids()
+               if src.stream is not None
+               and hasattr(src.stream, "store") else [])))
+        for i, sid in enumerate(worklist):
+            dst = cands[i % len(cands)]
+            outcome = self._handoff(sid, rid, dst.rid)
+            if outcome is None:
+                continue  # raced a per-frame handoff; that path counted
+            outcomes[sid] = outcome
+            cur = self._pins.peek(sid)
+            if cur in (rid, None):
+                # CAS: a concurrent pin() decision wins over the sweep.
+                self._pins.reassign(sid, cur, dst.rid)
+        self._refresh_gauges()
+        return {"replica": src.name, "migrated": outcomes}
+
+    def export_session(self, session_id: str) -> Optional[Dict]:
+        """Wire-level export (GET /debug/sessions/<id>): the pinned
+        replica's snapshot, falling back to scanning every replica (the
+        pin may be gone while the state survives)."""
+        order = []
+        pinned = self._pins.peek(session_id)
+        if pinned is not None:
+            order.append(self.rset.replicas[pinned])
+        order.extend(r for r in self.rset.replicas
+                     if pinned is None or r.rid != pinned)
+        for r in order:
+            exporter = getattr(r.stream, "export_session", None) \
+                if r.stream is not None else None
+            if exporter is None:
+                continue
+            snapshot = exporter(session_id)
+            if snapshot is not None:
+                return snapshot
+        return None
+
+    def import_session(self, snapshot: Dict) -> str:
+        """Wire-level import (POST /debug/sessions): install into the
+        session's pinned replica when it is routable, else the
+        least-loaded ready one (pinning it there on success) — counted
+        like any other handoff."""
+        sid = str(snapshot.get("session_id", ""))
+        rid = self._pins.peek(sid)
+        if rid is not None and self.rset.replicas[rid].routable():
+            replica = self.rset.replicas[rid]
+        else:
+            cands = self._candidates()
+            replica = cands[0] if cands else None
+        importer = getattr(replica.stream, "import_session", None) \
+            if replica is not None and replica.stream is not None else None
+        if importer is None:
+            outcome = "cold_lost"
+        else:
+            outcome = importer(snapshot)
+            if outcome == "warm" and replica.rid != rid:
+                cur = self._pins.peek(sid)
+                if cur in (rid, None):
+                    self._pins.reassign(sid, cur, replica.rid)
+        self.cluster_metrics.session_handoffs.labels(
+            outcome=outcome).inc()
+        return outcome
 
     def step(self, session_id: str, seq_no: Optional[int],
              left: np.ndarray, right: np.ndarray,

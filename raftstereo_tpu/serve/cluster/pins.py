@@ -5,17 +5,18 @@ stack).
 One implementation for one policy: sessions are sticky because RAFT's
 warm-start state lives next to one engine's compile cache, so both
 placement layers need the same LRU-bounded get-or-assign — an evicted or
-re-pinned session's next frame runs cold, never errors.  The whole
-decision (read pin, validate it, choose a replacement, write, evict)
-happens under ONE lock acquisition: two concurrent first frames of a
-session must agree on the pin, not race to different targets.
+re-pinned session's next frame runs cold only when the warm handoff
+(dispatcher/router migration, PR 13) cannot move its state, never errors.
+The whole decision (read pin, validate it, choose a replacement, write,
+evict) happens under ONE lock acquisition: two concurrent first frames of
+a session must agree on the pin, not race to different targets.
 """
 
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Callable, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 __all__ = ["PinTable"]
 
@@ -37,25 +38,53 @@ class PinTable:
     def pin(self, session_id: str,
             still_ok: Callable[[int], bool],
             choose: Callable[[], Optional[int]]
-            ) -> Tuple[Optional[int], bool]:
+            ) -> Tuple[Optional[int], bool, Optional[int]]:
         """Sticky target for ``session_id``: the existing pin if
         ``still_ok(target)``, else ``choose()`` (called under the table
         lock — keep it cheap and never have it take this table's lock).
 
-        Returns ``(target, repinned)``; ``(None, False)`` when the pin
-        is stale/absent and ``choose()`` found no target (the pin is
-        left untouched).  ``repinned`` is True only when a LIVE pin was
-        replaced — the caller counts it (the frame will run cold)."""
+        Returns ``(target, repinned, old)``; ``(None, False, old)`` when
+        the pin is stale/absent and ``choose()`` found no target (the pin
+        is left untouched).  ``repinned`` is True only when a LIVE pin
+        was replaced — the caller counts it and attempts the warm
+        handoff from ``old`` (which is where the session's state still
+        lives) to ``target``."""
         with self._lock:
             old = self._pins.get(session_id)
             if old is not None and still_ok(old):
                 self._pins.move_to_end(session_id)
-                return old, False
+                return old, False, old
             new = choose()
             if new is None:
-                return None, False
+                return None, False, old
             self._pins[session_id] = new
             self._pins.move_to_end(session_id)
             while len(self._pins) > self.limit:
                 self._pins.popitem(last=False)
-            return new, old is not None
+            return new, old is not None, old
+
+    def peek(self, session_id: str) -> Optional[int]:
+        """Current pin without touching LRU order (None if absent)."""
+        with self._lock:
+            return self._pins.get(session_id)
+
+    def pinned_to(self, target: int) -> List[str]:
+        """All session ids currently pinned to ``target``, LRU order —
+        the drain-time migration worklist."""
+        with self._lock:
+            return [s for s, t in self._pins.items() if t == target]
+
+    def reassign(self, session_id: str, expect: Optional[int],
+                 new: int) -> bool:
+        """Compare-and-swap the pin to ``new`` iff it still reads
+        ``expect`` (``None`` = absent).  False means a concurrent
+        ``pin()`` already moved it — the migration loop must not clobber
+        that fresher decision."""
+        with self._lock:
+            if self._pins.get(session_id) != expect:
+                return False
+            self._pins[session_id] = new
+            self._pins.move_to_end(session_id)
+            while len(self._pins) > self.limit:
+                self._pins.popitem(last=False)
+            return True

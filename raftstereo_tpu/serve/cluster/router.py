@@ -11,9 +11,15 @@ dispatcher chooses a replica:
   while it pays its warmup compiles;
 * **least outstanding work** — cold requests go to the ready backend
   with the fewest (router-side in-flight + last-probed queue) requests;
-* **session stickiness** — frames of one session pin to one backend
-  (warm-start state is backend-local); a lost backend re-pins the
-  session and the new backend serves a cold frame;
+* **session stickiness + warm migration** — frames of one session pin
+  to one backend (warm-start state is backend-local); when a backend is
+  lost or draining the router MIGRATES the session instead of merely
+  re-pinning it: state is pulled over ``GET /debug/sessions/<id>`` and
+  pushed to the new home over ``POST /debug/sessions`` (raw-bytes
+  bitwise snapshot, serve/server.py), so any backend can resume any
+  stream.  ``cluster_session_repins_total{reason=}`` says why the pin
+  moved, ``cluster_session_handoffs_total{outcome=}`` whether the
+  warmth survived (warm / cold_schema / cold_lost);
 * **bounded failover** — cold inference is idempotent (a pure function
   of the images), so a backend failure mid-request retries on another
   backend with exponential backoff + jitter, up to ``retries`` extra
@@ -26,9 +32,20 @@ dispatcher chooses a replica:
 ``POST /debug/drain`` with ``{"backend": "b0"}`` takes a backend out of
 rotation and forwards the drain: the backend stops admitting, finishes
 running batches, and reports ``drained`` on its /healthz, which the
-router's prober (and ``GET /healthz`` here) surfaces.  The
-``cluster_*`` metric families on ``GET /metrics`` are the autoscaling
-signals (docs/serving.md "Cluster").
+router's prober (and ``GET /healthz`` here) surfaces.
+
+``POST /debug/restart`` with ``{"backend": "b0"}`` is the zero-downtime
+rolling-restart verb (docs/serving.md "Session migration & rolling
+restart"): drain -> wait for the backend's in-flight work to finish ->
+migrate every pinned session warm to the remaining backends -> reply;
+the operator then restarts/upgrades the process with ``warmup_async``
+and the readiness probe gates its rejoin — no frame of a migrated
+session ever runs cold.
+
+The ``cluster_*`` metric families on ``GET /metrics`` are the
+autoscaling signals (docs/serving.md "Cluster"); ``ops/autoscale.py``
+consumes them here and surfaces scale advice in ``GET /debug/vars`` and
+the ``cluster_autoscale_recommendation`` gauge.
 """
 
 from __future__ import annotations
@@ -41,10 +58,11 @@ import threading
 import time
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, quote, urlparse
 
 from ...config import RouterConfig
 from ...obs import Tracer, build_info, dump_threads, trace_response
+from ...ops.autoscale import Autoscaler
 from ...utils.backoff import backoff_delay
 from ..httpbase import JsonRequestHandler
 from ..metrics import ClusterMetrics, MetricsRegistry
@@ -232,6 +250,7 @@ class _RouterHandler(JsonRequestHandler):
             self._json(200, {
                 "backends": {b.name: b.snapshot() for b in rt.backends},
                 "session_pins": rt.pin_count(),
+                "autoscale": rt.autoscale_advice,
                 "build": build_info(),
             })
         else:
@@ -239,10 +258,10 @@ class _RouterHandler(JsonRequestHandler):
 
     # ------------------------------------------------------------ POST side
 
-    def _drain(self, rt: "StereoRouter", raw: bytes) -> None:
-        """POST /debug/drain: take one backend out of rotation and
-        forward the drain; the backend finishes running batches and its
-        /healthz flips to drained (poll it through GET /healthz here)."""
+    def _named_backend(self, rt: "StereoRouter",
+                       raw: bytes) -> Optional["Backend"]:
+        """Resolve the ``?backend=`` / ``{"backend": ...}`` target of an
+        ops verb; replies 400 (and returns None) on an unknown name."""
         qs = parse_qs(urlparse(self.path).query)
         name = (qs.get("backend", [None])[0])
         if name is None and raw:
@@ -255,6 +274,14 @@ class _RouterHandler(JsonRequestHandler):
             self._json(400, {"error": f"unknown backend {name!r}; choose "
                                       f"from "
                                       f"{[b.name for b in rt.backends]}"})
+        return backend
+
+    def _drain(self, rt: "StereoRouter", raw: bytes) -> None:
+        """POST /debug/drain: take one backend out of rotation and
+        forward the drain; the backend finishes running batches and its
+        /healthz flips to drained (poll it through GET /healthz here)."""
+        backend = self._named_backend(rt, raw)
+        if backend is None:
             return
         backend.mark_draining()
         rt.refresh_gauges()
@@ -268,6 +295,39 @@ class _RouterHandler(JsonRequestHandler):
             return
         self._json(status, {"backend": backend.name, "drain": reply})
 
+    def _restart(self, rt: "StereoRouter", raw: bytes) -> None:
+        """POST /debug/restart: the zero-downtime rolling-restart verb —
+        drain the backend, wait (bounded) for its in-flight work to
+        finish, migrate every pinned session warm to the remaining
+        backends, then hand back to the operator.  The operator restarts
+        or upgrades the process (``warmup_async``) and the readiness
+        probe gates its rejoin; migrated sessions never see a cold
+        frame."""
+        backend = self._named_backend(rt, raw)
+        if backend is None:
+            return
+        backend.mark_draining()
+        rt.refresh_gauges()
+        try:
+            _, drain_reply = _http_json(
+                backend.host, backend.port, "POST", "/debug/drain",
+                timeout=rt.config.probe_timeout_s)
+        except (OSError, ValueError) as e:
+            self._json(502, {"error": f"drain forward failed: {e}",
+                             "backend": backend.name})
+            return
+        drained = rt.wait_drained(backend)
+        migrated = rt.migrate_all_from(backend)
+        rt.refresh_gauges()
+        self._json(200, {
+            "backend": backend.name,
+            "drain": drain_reply,
+            "drained": drained,
+            "migrated": migrated,
+            "next": "restart the backend process (warmup_async "
+                    "recommended); the readiness probe gates its rejoin",
+        })
+
     def do_POST(self):
         rt: "StereoRouter" = self.server
         path = urlparse(self.path).path
@@ -276,6 +336,9 @@ class _RouterHandler(JsonRequestHandler):
             return
         if path == "/debug/drain":
             self._drain(rt, raw)
+            return
+        if path == "/debug/restart":
+            self._restart(rt, raw)
             return
         if path != "/predict":
             self._json(404, {"error": f"no such path {self.path!r}"})
@@ -322,6 +385,14 @@ class StereoRouter(ThreadingHTTPServer):
         # evicted pin behaves exactly like a lost session, the next
         # frame re-pins and runs cold).
         self._pins = PinTable(config.session_pin_limit)
+        # Export-in-flight markers: at most one migration per session at
+        # a time (a per-frame re-pin handoff racing the restart sweep
+        # would pull the same state twice; the backend store's monotonic
+        # import guard makes the race safe, the marker makes it cheap).
+        self._migrate_lock = threading.Lock()
+        self._migrating = set()  # guarded_by: _migrate_lock
+        self._autoscaler = Autoscaler()
+        self._advice: Dict[str, object] = {}
         self._prober = _Prober(self)
         super().__init__((config.host, config.port), _RouterHandler)
 
@@ -366,8 +437,11 @@ class StereoRouter(ThreadingHTTPServer):
     def _pin_backend(self, session_id: str,
                      exclude=()) -> Optional[Backend]:
         """Sticky backend for a session, re-pinning when its backend is
-        gone (the new backend serves the frame cold)."""
-        bid, repinned = self._pins.pin(
+        gone or draining — with a warm handoff attempt from the old
+        backend first (a frame arriving inside the drain window takes
+        this path and still gets its state; a killed backend's handoff
+        fails over to the documented cold_lost fallback)."""
+        bid, repinned, old = self._pins.pin(
             session_id,
             still_ok=lambda b: self.backends[b].routable()
             and b not in exclude,
@@ -375,9 +449,111 @@ class StereoRouter(ThreadingHTTPServer):
                 self._ready_backends(exclude)))
         if bid is None:
             return None
+        backend = self.backends[bid]
         if repinned:
-            self.cluster_metrics.session_repins.inc()
-        return self.backends[bid]
+            self.cluster_metrics.session_repins.labels(
+                reason=self._repin_reason(old)).inc()
+            self._handoff(session_id,
+                          self.backends[old] if old is not None else None,
+                          backend)
+        return backend
+
+    def _repin_reason(self, old_bid: Optional[int]) -> str:
+        """Why the old pin was unusable (the repins metric label)."""
+        if old_bid is None:
+            return "evicted"
+        state = self.backends[old_bid].state()
+        if state == "unreachable":
+            return "failed"
+        if state in ("draining", "drained"):
+            return "draining"
+        return "evicted"
+
+    # ----------------------------------------------------------- migration
+
+    def _handoff(self, session_id: str, src: Optional[Backend],
+                 dst: Backend) -> Optional[str]:
+        """Move one session's state ``src -> dst`` over the wire; returns
+        the counted outcome, or None when another thread is already
+        migrating this session (its outcome is counted there)."""
+        with self._migrate_lock:
+            if session_id in self._migrating:
+                return None
+            self._migrating.add(session_id)
+        try:
+            return self._migrate_session(session_id, src, dst)
+        finally:
+            with self._migrate_lock:
+                self._migrating.discard(session_id)
+
+    def _migrate_session(self, session_id: str, src: Optional[Backend],
+                         dst: Backend) -> str:
+        """GET the snapshot off ``src``, POST it into ``dst`` (bodies
+        relayed verbatim — the router never decodes the disparity, so
+        the move stays bitwise).  Every failure mode is the documented
+        cold_lost fallback: a killed backend refuses the GET, a
+        never-warm session 404s, and the next frame simply runs cold."""
+        outcome = "cold_lost"
+        if src is not None and src.bid != dst.bid:
+            try:
+                status, wire = _http_json(
+                    src.host, src.port, "GET",
+                    "/debug/sessions/" + quote(session_id, safe=""),
+                    timeout=self.config.probe_timeout_s)
+                if status == 200 and wire:
+                    status2, reply = _http_json(
+                        dst.host, dst.port, "POST", "/debug/sessions",
+                        timeout=self.config.probe_timeout_s,
+                        body=json.dumps(wire).encode(),
+                        headers={"Content-Type": "application/json"})
+                    if status2 == 200:
+                        outcome = str(reply.get("outcome", "cold_lost"))
+            except (OSError, ValueError):
+                outcome = "cold_lost"
+        self.cluster_metrics.session_handoffs.labels(
+            outcome=outcome).inc()
+        return outcome
+
+    def migrate_all_from(self, backend: Backend) -> Dict[str, str]:
+        """Move every session pinned to ``backend`` to the next ready
+        backend (the drain/restart sweep): state first, then the pin —
+        a CAS, so a concurrent ``pin()`` decision wins over the sweep."""
+        outcomes: Dict[str, str] = {}
+        for sid in self._pins.pinned_to(backend.bid):
+            cands = self._ready_backends(exclude=(backend.bid,))
+            if not cands:
+                break
+            dst = cands[0]
+            outcome = self._handoff(sid, backend, dst)
+            if outcome is None:
+                continue  # raced a per-frame handoff; counted there
+            outcomes[sid] = outcome
+            cur = self._pins.peek(sid)
+            if cur in (backend.bid, None):
+                self._pins.reassign(sid, cur, dst.bid)
+        return outcomes
+
+    def wait_drained(self, backend: Backend,
+                     timeout_s: float = 10.0) -> bool:
+        """Poll the backend's /healthz until it reports drained
+        (bounded).  The session-lock serialization already makes exports
+        consistent; waiting for the drain keeps the restart sweep
+        deterministic — every last frame's state is in the store before
+        the sweep reads it."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                status, health = _http_json(
+                    backend.host, backend.port, "GET", "/healthz",
+                    timeout=self.config.probe_timeout_s)
+                if status == 200:
+                    backend.on_probe(health, self.config.fail_after)
+                    if health.get("drained"):
+                        return True
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+        return False
 
     def _record(self, backend: Backend, outcome: str) -> None:
         self.cluster_metrics.dispatch.labels(
@@ -396,6 +572,19 @@ class StereoRouter(ThreadingHTTPServer):
         cm.utilization.set(
             round(sum(1 for b in ready if b.outstanding() > 0)
                   / len(ready), 4) if ready else 0.0)
+        # Feed the recommendation loop (ops/autoscale.py): advice lands
+        # in /debug/vars and the cluster_autoscale_recommendation gauge.
+        shed = sum(child.value for labels, child in cm.dispatch.series()
+                   if labels[1] == "shed")
+        advice = self._autoscaler.observe(
+            ready=len(ready), utilization=cm.utilization.value,
+            shed_total=shed)
+        cm.autoscale_recommendation.set(advice["delta"])
+        self._advice = advice
+
+    @property
+    def autoscale_advice(self) -> Dict[str, object]:
+        return self._advice
 
     def _forward(self, backend: Backend, raw: bytes, rid: str
                  ) -> Tuple[str, int, bytes, Dict[str, str]]:

@@ -183,6 +183,18 @@ class BatchEngine:
         f = self.model.config.factor
         return hw[0] // f, hw[1] // f
 
+    def session_schema(self) -> Dict[str, object]:
+        """The engine-level state-schema fingerprint that gates warm
+        session migration (``SessionStore.export_state``/``import_state``):
+        two engines may exchange warm-start state only when the 1/f grid
+        (``factor``) and the executables that will consume it
+        (``input_mode``, ``gru_backend``) agree.  Pure metadata — no
+        device work, no compiles."""
+        cfg = getattr(self.model, "config", None)
+        return {"factor": getattr(cfg, "factor", None),
+                "input_mode": self.input_mode,
+                "gru_backend": self.gru_backend}
+
     # -------------------------------------------------------- precision modes
 
     def _mode(self, mode: Optional[str]) -> str:

@@ -349,8 +349,23 @@ class ClusterMetrics:
             "capacity — the primary scale-out signal")
         self.session_repins = r.counter(
             "cluster_session_repins_total",
-            "session frames re-pinned to a new replica because the "
-            "pinned one was lost or draining (the frame re-runs cold)")
+            "session frames re-pinned to a new replica, by why the old "
+            "pin was unusable (failed/draining/evicted); each re-pin "
+            "attempts a warm state handoff, counted separately in "
+            "cluster_session_handoffs_total",
+            labels=("reason",))
+        self.session_handoffs = r.counter(
+            "cluster_session_handoffs_total",
+            "warm-start state migrations between replicas/backends by "
+            "outcome: warm (state moved, next frame runs warm), "
+            "cold_schema (fingerprint/version mismatch — documented cold "
+            "fallback), cold_lost (no exportable state — the old home is "
+            "gone or never finished a frame)",
+            labels=("outcome",))
+        self.autoscale_recommendation = r.gauge(
+            "cluster_autoscale_recommendation",
+            "recommended change in replica count from ops/autoscale.py "
+            "(positive = scale out, negative = scale in, 0 = hold)")
         self.probe_failures = r.counter(
             "cluster_probe_failures_total",
             "health-probe failures per backend (router only)",

@@ -28,6 +28,13 @@ Endpoints:
 * ``GET /debug/threads`` — all-thread stack dump (the batcher/HTTP
   deadlock surface earns this).
 * ``GET /debug/vars`` — resolved ServeConfig + build info + engine state.
+* ``GET /debug/sessions/<id>`` / ``POST /debug/sessions`` — export /
+  import one streaming session's warm-start state (the wire half of
+  session migration, docs/serving.md "Session migration"): the router
+  moves state between backends through these on drain, restart, or
+  backend loss.  The disparity rides as raw base64 bytes
+  (``encode_array``), so a warm import is bitwise-identical to having
+  stayed.
 
 ``ThreadingHTTPServer`` gives one thread per connection; they all funnel
 into the single ``DynamicBatcher`` queue, which is where concurrency is
@@ -45,7 +52,7 @@ import threading
 import time
 from http.server import ThreadingHTTPServer
 from typing import Dict, Optional, Union
-from urllib.parse import urlparse
+from urllib.parse import unquote, urlparse
 
 import numpy as np
 
@@ -60,7 +67,8 @@ from .sched import IterationScheduler
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["StereoServer", "build_server", "decode_array", "encode_array"]
+__all__ = ["StereoServer", "build_server", "decode_array", "encode_array",
+           "snapshot_to_wire", "wire_to_snapshot"]
 
 
 def encode_array(a: np.ndarray) -> Dict:
@@ -77,6 +85,30 @@ def decode_array(obj: Union[Dict, list]) -> np.ndarray:
     a = np.frombuffer(base64.b64decode(obj["data_b64"]),
                       dtype=np.dtype(obj["dtype"]))
     return a.reshape(obj["shape"]).astype(np.float32, copy=False)
+
+
+def snapshot_to_wire(snapshot: Dict) -> Dict:
+    """JSON form of a ``SessionStore.export_state`` snapshot.  The
+    disparity is encoded as raw base64 bytes so the round trip is
+    bitwise (the warm-handoff parity assertion depends on it); the
+    router relays these bodies verbatim without decoding."""
+    wire = dict(snapshot)
+    wire["prev_disp_low"] = encode_array(snapshot["prev_disp_low"])
+    if snapshot.get("bucket_hw"):
+        wire["bucket_hw"] = list(snapshot["bucket_hw"])
+    return wire
+
+
+def wire_to_snapshot(obj: Dict) -> Dict:
+    """Inverse of ``snapshot_to_wire`` (tolerates nested-list arrays —
+    same contract as ``decode_array``)."""
+    snap = dict(obj)
+    prev = obj.get("prev_disp_low")
+    if isinstance(prev, (dict, list)):
+        snap["prev_disp_low"] = decode_array(prev)
+    if obj.get("bucket_hw"):
+        snap["bucket_hw"] = tuple(int(x) for x in obj["bucket_hw"])
+    return snap
 
 
 def _outcome(code: int, obj: Dict) -> str:
@@ -173,6 +205,17 @@ class _Handler(JsonRequestHandler):
             self._send(200, body, "application/json", extra)
         elif url.path == "/debug/threads":
             self._send(200, dump_threads().encode(), "text/plain")
+        elif url.path.startswith("/debug/sessions/"):
+            # Session-state export (migration, docs/serving.md): the
+            # snapshot serializes on the session lock, so an in-flight
+            # frame completes first and the state is always consistent.
+            sid = unquote(url.path[len("/debug/sessions/"):])
+            snapshot = srv.export_session(sid)
+            if snapshot is None:
+                self._json(404, {"error": "no exportable state for "
+                                          f"session {sid!r}"})
+            else:
+                self._json(200, snapshot_to_wire(snapshot))
         elif url.path == "/debug/vars":
             self._json(200, {
                 "config": dataclasses.asdict(srv.config),
@@ -246,6 +289,27 @@ class _Handler(JsonRequestHandler):
             self._json(200, {"draining": True, "drained": srv.drained,
                              "queue_depth": srv.queue_depth,
                              "inflight": srv.inflight})
+            return
+        if path == "/debug/sessions":
+            # Session-state import (migration): installs an exported
+            # snapshot so the session's next in-order frame runs warm.
+            # Cold fallbacks reply 200 with the outcome — losing warmth
+            # is a performance event, never an error (PR 3 contract).
+            raw = self._read_body(srv.config.max_body_mb)
+            if raw is None:
+                return
+            if srv.stream is None:
+                self._json(400, {"error": "streaming disabled on this "
+                                          "server"})
+                return
+            try:
+                snapshot = wire_to_snapshot(json.loads(raw))
+                sid = str(snapshot["session_id"])
+            except Exception as e:
+                self._json(400, {"error": f"bad snapshot: {e}"})
+                return
+            outcome = srv.import_session(snapshot)
+            self._json(200, {"session_id": sid, "outcome": outcome})
             return
         # A router in front forwards its request id so the hop's spans
         # and the backend's spans share one trace (docs/observability.md).
@@ -758,6 +822,27 @@ class StereoServer(ThreadingHTTPServer):
             if callable(active) and active():
                 return False
         return True
+
+    # -------------------------------------------------- session migration
+
+    def export_session(self, session_id: str) -> Optional[Dict]:
+        """Host-side snapshot of one streaming session's warm-start
+        state, or None when there is nothing warm to move.  In cluster
+        mode ``self.stream`` IS the dispatcher, which resolves the
+        owning replica; single-engine mode asks the StreamRunner
+        directly.  Pure host numpy either way — zero device work, zero
+        compiles (the retrace-guard contract for migration)."""
+        if self.stream is None:
+            return None
+        return self.stream.export_session(session_id)
+
+    def import_session(self, snapshot: Dict) -> str:
+        """Install an exported snapshot; returns the handoff outcome
+        (``warm`` / ``cold_schema`` / ``cold_lost`` — cold is a
+        documented fallback, never an error)."""
+        if self.stream is None:
+            return "cold_lost"
+        return self.stream.import_session(snapshot)
 
     def close(self) -> None:
         """Stop accepting, drain the queue, release the socket."""

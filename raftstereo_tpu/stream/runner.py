@@ -78,6 +78,27 @@ class StreamRunner:
         self.store = store or SessionStore(cfg.session_limit,
                                            cfg.session_ttl_s, metrics)
 
+    # ---------------------------------------------- migration (PR 13)
+    #
+    # The replica-to-replica handoff seam: the cluster dispatcher and the
+    # /debug/sessions HTTP endpoints move warm-start state between
+    # StreamRunners through these two calls.  Pure host-side numpy plus
+    # engine metadata — no device dispatch, no compiles, so migration is
+    # invisible to the retrace guard.
+
+    def export_session(self, session_id: str) -> Optional[Dict]:
+        """Versioned snapshot of one session's warm-start state stamped
+        with this engine's schema fingerprint, or None when there is
+        nothing warm to move."""
+        return self.store.export_state(
+            session_id, schema=self.engine.session_schema())
+
+    def import_session(self, snapshot: Dict) -> str:
+        """Install a snapshot exported elsewhere; returns ``"warm"`` or
+        the documented ``"cold_schema"`` fallback (never raises)."""
+        return self.store.import_state(
+            snapshot, schema=self.engine.session_schema())
+
     def step(self, session_id: str, seq_no: Optional[int],
              left: np.ndarray, right: np.ndarray,
              trace_id: Optional[str] = None,

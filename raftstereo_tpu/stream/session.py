@@ -22,11 +22,24 @@ import collections
 import dataclasses
 import threading
 import time
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["Session", "SessionStore"]
+__all__ = ["STATE_VERSION", "Session", "SessionStore"]
+
+# Versioned snapshot format for export_state/import_state.  Bump when the
+# Session fields carried across replicas change shape or meaning; an
+# importer seeing an unknown version falls back cold, never errors.
+STATE_VERSION = 1
+
+# The engine-level keys of the state-schema fingerprint.  Two stores may
+# exchange warm state only when these agree: ``factor`` fixes the 1/f
+# grid ``prev_disp_low`` lives on, ``input_mode``/``gru_backend`` fix
+# which executables the state feeds (a bucket served by one engine and
+# not the other simply re-buckets cold at the next frame, so the bucket
+# itself rides along informationally, not as a hard gate).
+_SCHEMA_KEYS = ("factor", "input_mode", "gru_backend")
 
 
 @dataclasses.dataclass
@@ -121,3 +134,111 @@ class SessionStore:
             if existed and self.metrics is not None:
                 self.metrics.stream_active.add(-1)
             return existed
+
+    # ------------------------------------------------- migration (PR 13)
+
+    def session_ids(self) -> List[str]:
+        """Live session ids, LRU order (drain-time handoff iterates this)."""
+        with self._lock:
+            return list(self._sessions)
+
+    def export_state(self, sid: str,
+                     schema: Optional[Dict] = None) -> Optional[Dict]:
+        """Versioned host-side snapshot of one session's warm-start state,
+        or ``None`` when there is nothing warm to move (unknown session,
+        or no completed frame yet — a session without ``prev_disp_low``
+        re-establishes itself cold anywhere, so there is no asset).
+
+        ``schema`` is the exporting engine's state-schema fingerprint
+        (``BatchEngine.session_schema()``); the importer refuses a
+        mismatched snapshot with a cold fallback, never an error.  The
+        export serializes on the session's own lock, so a frame in
+        flight completes first and the snapshot is always consistent
+        (and the disparity copy is bitwise — a warm import is
+        indistinguishable from having stayed)."""
+        with self._lock:
+            sess = self._sessions.get(sid)
+        if sess is None:
+            return None
+        with sess.lock:
+            if sess.prev_disp_low is None:
+                return None
+            return {
+                "version": STATE_VERSION,
+                "schema": dict(schema or {},
+                               bucket=(list(sess.bucket_hw)
+                                       if sess.bucket_hw else None)),
+                "session_id": sess.sid,
+                "next_seq": int(sess.next_seq),
+                "frame_idx": int(sess.frame_idx),
+                "prev_disp_low": np.ascontiguousarray(
+                    sess.prev_disp_low).copy(),
+                "bucket_hw": (tuple(sess.bucket_hw)
+                              if sess.bucket_hw else None),
+                "ema": float(sess.ema),
+                "level": int(sess.level),
+                "force_cold": bool(sess.force_cold),
+                "warm_frames": int(sess.warm_frames),
+                "cold_frames": int(sess.cold_frames),
+            }
+
+    def import_state(self, snapshot: Dict,
+                     schema: Optional[Dict] = None) -> str:
+        """Install an exported snapshot; returns the handoff outcome:
+
+        * ``"warm"`` — state installed (or already at least as fresh
+          here); the session's next in-order frame runs warm;
+        * ``"cold_schema"`` — version or schema-fingerprint mismatch
+          (documented cold fallback: nothing is installed, the next
+          frame re-establishes state cold).
+
+        Never raises at a caller: a malformed snapshot is a cold
+        fallback, exactly like a lost session."""
+        try:
+            if int(snapshot.get("version", -1)) != STATE_VERSION:
+                return "cold_schema"
+            theirs = snapshot.get("schema") or {}
+            ours = schema or {}
+            if any(theirs.get(k) != ours.get(k) for k in _SCHEMA_KEYS):
+                return "cold_schema"
+            sid = str(snapshot["session_id"])
+            prev = np.ascontiguousarray(snapshot["prev_disp_low"],
+                                        dtype=np.float32)
+            next_seq = int(snapshot["next_seq"])
+            bucket = snapshot.get("bucket_hw")
+            bucket = tuple(int(x) for x in bucket) if bucket else None
+        except Exception:
+            return "cold_schema"
+        with self._lock:
+            now = self._now()
+            sess = self._sessions.get(sid)
+            if sess is None:
+                sess = Session(sid, last_used=now)
+                self._sessions[sid] = sess
+                if self.metrics is not None:
+                    self.metrics.stream_active.add(1)
+                while len(self._sessions) > self.limit:
+                    self._sessions.popitem(last=False)
+                    if self.metrics is not None:
+                        self.metrics.stream_evicted.inc()
+                        self.metrics.stream_active.add(-1)
+            else:
+                sess.last_used = now
+                self._sessions.move_to_end(sid)
+        with sess.lock:
+            # Monotonic guard: a concurrent per-frame handoff (or a frame
+            # that already ran here) may have produced FRESHER state than
+            # this snapshot — a stale import would rewind next_seq and
+            # turn the client's next in-order frame cold (out_of_order).
+            if sess.prev_disp_low is not None and sess.next_seq >= next_seq:
+                return "warm"
+            sess.next_seq = next_seq
+            sess.frame_idx = int(snapshot["frame_idx"])
+            sess.prev_disp_low = prev
+            sess.bucket_hw = bucket
+            sess.ema = float(snapshot["ema"])
+            sess.level = int(snapshot["level"])
+            sess.force_cold = bool(snapshot["force_cold"])
+            sess.warm_frames = int(snapshot["warm_frames"])
+            sess.cold_frames = int(snapshot["cold_frames"])
+        return "warm"

@@ -25,6 +25,12 @@ Grammar (comma-separated entries)::
     hang@sample=I:10s     sleep before loading index I (once)
     hang@worker=W:10s     worker W sleeps before its first load (once)
     corrupt_ckpt@step=N   scribble over the checkpoint saved at step N
+    kill_backend@request=N  serving chaos trigger: ``on_request`` returns
+                          True at the N-th request (1-based) — the test
+                          harness kills its victim backend at exactly
+                          that point, making the router kill/upgrade
+                          chaos test deterministic instead of
+                          SIGKILL-timing-dependent (tests/test_cluster.py)
 
 All faults fire exactly once except ``corrupt@sample``, which models a
 persistently bad shard and fires on every access.  Injection is fully
@@ -53,6 +59,7 @@ _KINDS = {
     "corrupt": (("sample",), False, True),
     "hang": (("worker", "sample"), True, False),
     "corrupt_ckpt": (("step",), False, False),
+    "kill_backend": (("request",), False, False),
 }
 
 
@@ -195,6 +202,13 @@ class FaultPlan:
         f = self._take("hang", "worker", worker_id)
         if f is not None:
             time.sleep(f.seconds)
+
+    def on_request(self, n: int) -> bool:
+        """Serving hook, called with the 1-based count of each request a
+        chaos harness issues; True exactly when ``kill_backend@request=N``
+        fires — the harness then kills its victim backend, so the
+        kill-mid-stream point is deterministic across runs."""
+        return self._take("kill_backend", "request", n) is not None
 
     def on_checkpoint_saved(self, step: int, path: str) -> bool:
         """Checkpoint-manager hook: corrupt the just-saved step dir.

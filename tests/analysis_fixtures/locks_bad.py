@@ -29,3 +29,14 @@ class Box:
 
     def noop(self):
         pass                            # guarded_by: _lock (RSA303)
+
+
+class Migrator:
+    """Export-in-flight marker touched without its lock."""
+
+    def __init__(self):
+        self._migrate_lock = threading.Lock()
+        self._migrating = set()         # guarded_by: _migrate_lock
+
+    def begin(self, sid):
+        self._migrating.add(sid)        # line 42: RSA301 (no lock)

@@ -42,3 +42,32 @@ class Server:
     def __init__(self):
         self.inflight_lock = threading.Lock()
         self.inflight = 0               # guarded_by: inflight_lock
+
+
+class Migrator:
+    """Session-migration shapes (PR 13): export-in-flight markers and a
+    handoff sweep that touches the pin table only under its own lock."""
+
+    def __init__(self):
+        self._migrate_lock = threading.Lock()
+        self._migrating = set()         # guarded_by: _migrate_lock
+        self._pin_lock = threading.Lock()
+        self._pins = {}                 # guarded_by: _pin_lock
+
+    def begin(self, sid):
+        with self._migrate_lock:
+            if sid in self._migrating:
+                return False
+            self._migrating.add(sid)
+            return True
+
+    def finish(self, sid):
+        with self._migrate_lock:
+            self._migrating.discard(sid)
+
+    def reassign(self, sid, expect, dst):
+        with self._pin_lock:
+            if self._pins.get(sid) != expect:
+                return False
+            self._pins[sid] = dst
+            return True

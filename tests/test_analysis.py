@@ -76,13 +76,16 @@ class TestLockDiscipline:
         findings = _run("locks_bad.py")
         assert _codes_lines(findings) == [
             ("RSA302", 12), ("RSA301", 19), ("RSA301", 22),
-            ("RSA301", 27), ("RSA303", 31)]
+            ("RSA301", 27), ("RSA303", 31), ("RSA301", 42)]
         # The nested-def escape is attributed to the inner function.
         assert findings[3].context == "Box.deferred.later"
+        # The unlocked export-in-flight marker (migration shape, PR 13).
+        assert findings[5].context == "Migrator.begin"
 
     def test_good_fixture_is_clean(self):
         # Includes the caller-holds-lock def annotation, the inline
-        # lambda transparency, and the cross-object (srv.) base match.
+        # lambda transparency, the cross-object (srv.) base match, and
+        # the migration shapes (export-in-flight markers + pin CAS).
         assert _run("locks_good.py") == []
 
 

@@ -17,7 +17,15 @@ suite's virtual CPU devices (conftest forces 8):
   killing a backend mid-load loses ZERO accepted cold requests
   (failover) and session frames degrade to cold re-pins, exhausted
   backends give clean 503s (never hangs), and per-backend drain
-  completes with in-flight work finished.
+  completes with in-flight work finished;
+* ``test_zero_downtime_restart_and_kill`` — warm session migration
+  (PR 13): ``POST /debug/restart`` drains a backend and hands its
+  sessions over WARM (bitwise-identical to an unmigrated twin, zero
+  compiles), sequence-replay load through the router loses zero
+  accepted requests and zero mid-sequence warm frames, the restarted
+  process rejoins through the readiness probe at a zero-compile steady
+  state, and an unplanned kill costs at most the documented
+  ``cold_lost`` fallback.
 """
 
 import json
@@ -37,14 +45,21 @@ import jax
 from raftstereo_tpu.config import (ClusterConfig, RAFTStereoConfig,
                                    RouterConfig, SchedConfig, ServeConfig,
                                    StreamConfig)
+from raftstereo_tpu.ops.autoscale import (AutoscalePolicy, Autoscaler,
+                                          recommend)
 from raftstereo_tpu.serve import (BatchEngine, ClusterDispatcher,
                                   DynamicBatcher, IterationScheduler,
                                   Overloaded, RequestTimedOut, ServeClient,
                                   ServeError, ServeMetrics, ShuttingDown,
                                   build_router, build_server)
 from raftstereo_tpu.serve.batcher import Future, ServeResult
+from raftstereo_tpu.serve.client import run_load
+from raftstereo_tpu.serve.cluster.pins import PinTable
 from raftstereo_tpu.serve.cluster.replica import Replica
 from raftstereo_tpu.serve.cluster.router import Backend
+from raftstereo_tpu.serve.server import snapshot_to_wire, wire_to_snapshot
+from raftstereo_tpu.stream.session import STATE_VERSION, SessionStore
+from raftstereo_tpu.utils.faults import FaultPlan
 
 from test_bench import REPO
 
@@ -237,6 +252,23 @@ class TestDispatcherPolicy:
         res = d.step("cam0", 3, _img(), _img())
         assert res.replica == "r1" and r1.stepped == [("cam0", 3)]
         assert d.cluster_metrics.session_repins.value == 1
+        reasons = {lv: c.value
+                   for lv, c in d.cluster_metrics.session_repins.series()}
+        assert reasons == {("failed",): 1}
+        # The stub exposes no session store behind its stream seam, so
+        # the re-pin's handoff attempt lands on the documented fallback
+        # (counted, never raised — the frame above was still served).
+        outs = {lv: c.value
+                for lv, c in d.cluster_metrics.session_handoffs.series()}
+        assert outs == {("cold_lost",): 1}
+
+    def test_autoscale_advice_surfaces_in_stats_and_gauge(self):
+        d, _ = _dispatcher([StubReplica(0)])
+        d.step("s", 0, _img(), _img())  # any traffic refreshes gauges
+        advice = d.stats()["autoscale"]
+        assert advice["action"] in ("hold", "scale_up", "scale_down")
+        assert d.cluster_metrics.autoscale_recommendation.value \
+            == advice["delta"]
 
     def test_session_pin_table_is_bounded(self):
         d, _ = _dispatcher([StubReplica(0)], session_pin_limit=4)
@@ -244,6 +276,321 @@ class TestDispatcherPolicy:
             d.step(f"s{i}", 0, _img(), _img())
         with d._lock:
             assert len(d._pins) <= 4
+
+
+# ------------------------------------------- warm session migration (PR 13)
+
+# Engine-level state-schema fingerprint used by the store-level tests
+# (shape of BatchEngine.session_schema()).
+SCHEMA = {"factor": 4, "input_mode": "concat", "gru_backend": "pallas"}
+
+
+def _warm_store(sid="cam0", next_seq=3):
+    """A SessionStore holding one session with completed-frame state."""
+    store = SessionStore(limit=4, ttl_s=60.0)
+    sess, _ = store.get_or_create(sid)
+    with sess.lock:
+        sess.prev_disp_low = (np.arange(15, dtype=np.float32)
+                              .reshape(3, 5) / 7.0)
+        sess.bucket_hw = (60, 90)
+        sess.next_seq = next_seq
+        sess.frame_idx = next_seq
+        sess.ema = 0.25
+        sess.level = 2
+        sess.warm_frames = next_seq - 1
+        sess.cold_frames = 1
+    return store
+
+
+class StoreStubReplica(StubReplica):
+    """Stub replica with a REAL SessionStore behind the migration seam
+    (the scripted ``step`` never touches it — tests seed state directly),
+    and an injectable schema to model engine-fingerprint mismatches."""
+
+    def __init__(self, rid, schema=None, **kw):
+        super().__init__(rid, **kw)
+        self.store = SessionStore(limit=8, ttl_s=600.0)
+        self.schema = dict(schema if schema is not None else SCHEMA)
+
+    def export_session(self, session_id):
+        return self.store.export_state(session_id, schema=self.schema)
+
+    def import_session(self, snapshot):
+        return self.store.import_state(snapshot, schema=self.schema)
+
+
+def _seed_state(replica, sid, next_seq=1, salt=0.0):
+    """Install warm state for ``sid`` in a StoreStubReplica's store;
+    returns the disparity array (the bitwise reference)."""
+    sess, _ = replica.store.get_or_create(sid)
+    with sess.lock:
+        sess.prev_disp_low = (np.arange(15, dtype=np.float32)
+                              .reshape(3, 5) / 7.0) + salt
+        sess.bucket_hw = (60, 90)
+        sess.next_seq = next_seq
+        sess.frame_idx = next_seq
+        sess.ema = 0.5
+        sess.level = 2
+        return sess.prev_disp_low
+
+
+class TestPinTable:
+    def test_pin_triple_and_peek(self):
+        pt = PinTable(4)
+        assert pt.pin("s", still_ok=lambda t: True,
+                      choose=lambda: 0) == (0, False, None)
+        # Sticky: a live pin wins, choose() is not consulted.
+        assert pt.pin("s", still_ok=lambda t: True,
+                      choose=lambda: 1) == (0, False, 0)
+        # Stale pin replaced: repinned=True carries the old home so the
+        # caller can attempt the warm handoff from it.
+        assert pt.pin("s", still_ok=lambda t: False,
+                      choose=lambda: 1) == (1, True, 0)
+        assert pt.peek("s") == 1 and pt.peek("nope") is None
+
+    def test_no_candidate_leaves_pin_untouched(self):
+        pt = PinTable(4)
+        pt.pin("s", still_ok=lambda t: True, choose=lambda: 0)
+        assert pt.pin("s", still_ok=lambda t: False,
+                      choose=lambda: None) == (None, False, 0)
+        # The stale pin survives: the session's state is still at its
+        # old home, and the next pin() may find a ready target.
+        assert pt.peek("s") == 0
+
+    def test_pinned_to_and_reassign_cas(self):
+        pt = PinTable(8)
+        for i, sid in enumerate(("a", "b", "c")):
+            pt.pin(sid, still_ok=lambda t: True, choose=lambda i=i: i % 2)
+        assert pt.pinned_to(0) == ["a", "c"]
+        assert pt.pinned_to(7) == []
+        assert pt.reassign("a", 0, 1)  # expectation holds -> moved
+        assert pt.peek("a") == 1
+        assert not pt.reassign("c", 1, 0)  # stale expectation -> no-op
+        assert pt.peek("c") == 0
+        assert not pt.reassign("new", 0, 1)  # absent but 0 expected
+        assert pt.reassign("new", None, 1)  # absent CAS (import path)
+        assert pt.peek("new") == 1
+
+
+class TestSessionStateSnapshot:
+    """SessionStore.export_state / import_state — the host-side seam
+    every migration path (dispatcher, router, HTTP endpoints) rides."""
+
+    def test_nothing_warm_exports_none(self):
+        store = _warm_store()
+        assert store.export_state("nope", schema=SCHEMA) is None
+        store.get_or_create("stateless")  # session exists, no frame yet
+        assert store.export_state("stateless", schema=SCHEMA) is None
+
+    def test_roundtrip_is_bitwise_and_copies(self):
+        store = _warm_store("cam0", next_seq=3)
+        snap = store.export_state("cam0", schema=SCHEMA)
+        assert snap["version"] == STATE_VERSION
+        assert snap["schema"]["bucket"] == [60, 90]
+        dst = SessionStore(limit=4, ttl_s=60.0)
+        assert dst.import_state(snap, schema=SCHEMA) == "warm"
+        sess, created = dst.get_or_create("cam0")
+        assert not created
+        with sess.lock:
+            np.testing.assert_array_equal(sess.prev_disp_low,
+                                          snap["prev_disp_low"])
+            assert sess.prev_disp_low.dtype == np.float32
+            assert (sess.next_seq, sess.frame_idx) == (3, 3)
+            assert sess.bucket_hw == (60, 90)
+            assert (sess.ema, sess.level) == (0.25, 2)
+            assert (sess.warm_frames, sess.cold_frames) == (2, 1)
+
+    def test_mismatch_is_cold_schema_never_error(self):
+        store = _warm_store()
+        snap = store.export_state("cam0", schema=SCHEMA)
+        dst = SessionStore(limit=4, ttl_s=60.0)
+        mismatched = dict(SCHEMA, factor=8)
+        assert dst.import_state(snap, schema=mismatched) == "cold_schema"
+        assert len(dst) == 0  # nothing installed
+        assert dst.import_state(dict(snap, version=99),
+                                schema=SCHEMA) == "cold_schema"
+        assert dst.import_state({}, schema=SCHEMA) == "cold_schema"
+        assert dst.import_state(dict(snap, prev_disp_low="junk"),
+                                schema=SCHEMA) == "cold_schema"
+        # A differing BUCKET rides along informationally, not as a gate:
+        # the engine keys agree, so the import is warm (a bucket change
+        # re-buckets cold at the next frame anyway — runner policy).
+        rebucketed = dict(snap, schema=dict(snap["schema"],
+                                            bucket=[120, 180]))
+        assert dst.import_state(rebucketed, schema=SCHEMA) == "warm"
+
+    def test_monotonic_guard_keeps_fresher_state(self):
+        store = _warm_store("s", next_seq=5)
+        snap = store.export_state("s", schema=SCHEMA)
+        sess, _ = store.get_or_create("s")
+        with sess.lock:
+            sess.next_seq = 7  # frames kept landing after the export
+            sess.ema = 0.9
+        # Re-importing the stale snapshot (drain sweep racing a per-frame
+        # handoff) must not rewind: a rewound next_seq would turn the
+        # client's next in-order frame into an out_of_order cold frame.
+        assert store.import_state(snap, schema=SCHEMA) == "warm"
+        with sess.lock:
+            assert (sess.next_seq, sess.ema) == (7, 0.9)
+
+    def test_wire_form_roundtrip_is_bitwise(self):
+        store = _warm_store()
+        snap = store.export_state("cam0", schema=SCHEMA)
+        wire = json.loads(json.dumps(snapshot_to_wire(snap)))
+        back = wire_to_snapshot(wire)
+        np.testing.assert_array_equal(back["prev_disp_low"],
+                                      snap["prev_disp_low"])
+        assert back["prev_disp_low"].dtype == np.float32
+        assert back["bucket_hw"] == (60, 90)
+        dst = SessionStore(limit=4, ttl_s=60.0)
+        assert dst.import_state(back, schema=SCHEMA) == "warm"
+
+
+class TestDispatcherMigration:
+    def test_drain_window_race_repins_warm(self):
+        """Satellite fix: a frame arriving AFTER drain() but BEFORE the
+        proactive sweep re-pins with a warm handoff — the drain window
+        costs zero cold frames, not just the planned sweep."""
+        r0, r1 = StoreStubReplica(0), StoreStubReplica(1)
+        d, _ = _dispatcher([r0, r1])
+        assert d.step("cam0", 0, _img(), _img()).replica == "r0"
+        ref = _seed_state(r0, "cam0", next_seq=1)
+        r0.drain()  # drain marked; the sweep has NOT run yet
+        res = d.step("cam0", 1, _img(), _img())
+        assert res.replica == "r1"
+        reasons = {lv: c.value
+                   for lv, c in d.cluster_metrics.session_repins.series()}
+        assert reasons == {("draining",): 1}
+        outs = {lv: c.value
+                for lv, c in d.cluster_metrics.session_handoffs.series()}
+        assert outs == {("warm",): 1}
+        sess, created = r1.store.get_or_create("cam0")
+        assert not created
+        with sess.lock:
+            np.testing.assert_array_equal(sess.prev_disp_low, ref)
+            assert (sess.next_seq, sess.ema) == (1, 0.5)
+
+    def test_drain_replica_sweep_migrates_before_frames(self):
+        """drain_replica (the rolling-restart verb): every session on
+        the draining replica — pinned or state-only straggler — moves
+        warm, pins follow the state, and the next frames run on the new
+        home WITHOUT counting a repin."""
+        r0, r1 = StoreStubReplica(0), StoreStubReplica(1, outstanding=9)
+        d, _ = _dispatcher([r0, r1])
+        assert d.step("camA", 0, _img(), _img()).replica == "r0"
+        assert d.step("camB", 0, _img(), _img()).replica == "r0"
+        refs = {"camA": _seed_state(r0, "camA", salt=1.0),
+                "camB": _seed_state(r0, "camB", salt=2.0)}
+        _seed_state(r0, "ghost", salt=3.0)  # state survives, pin gone
+        report = d.drain_replica(0)
+        assert report["migrated"] == {"camA": "warm", "camB": "warm",
+                                      "ghost": "warm"}
+        outs = {lv: c.value
+                for lv, c in d.cluster_metrics.session_handoffs.series()}
+        assert outs == {("warm",): 3}
+        for sid, ref in refs.items():
+            assert d._pins.peek(sid) == 1
+            sess, created = r1.store.get_or_create(sid)
+            assert not created
+            with sess.lock:
+                np.testing.assert_array_equal(sess.prev_disp_low, ref)
+        assert d.step("camA", 1, _img(), _img()).replica == "r1"
+        assert d.cluster_metrics.session_repins.value == 0
+
+    def test_schema_mismatch_handoff_is_cold_schema(self):
+        r0 = StoreStubReplica(0)
+        r1 = StoreStubReplica(1, schema=dict(SCHEMA, gru_backend="xla"))
+        d, _ = _dispatcher([r0, r1])
+        assert d.step("cam0", 0, _img(), _img()).replica == "r0"
+        _seed_state(r0, "cam0")
+        r0._state = "failed"
+        assert d.step("cam0", 1, _img(), _img()).replica == "r1"
+        reasons = {lv: c.value
+                   for lv, c in d.cluster_metrics.session_repins.series()}
+        assert reasons == {("failed",): 1}
+        outs = {lv: c.value
+                for lv, c in d.cluster_metrics.session_handoffs.series()}
+        assert outs == {("cold_schema",): 1}
+        # Nothing installed on the new home: the next frame runs cold
+        # and re-establishes state there (documented fallback).
+        _, created = r1.store.get_or_create("cam0")
+        assert created
+
+    def test_export_import_seam_through_wire_form(self):
+        """The dispatcher half of the HTTP endpoints: export resolves
+        the pinned replica, import installs on a ready one and re-pins
+        so the next frame is sticky without counting a repin."""
+        r0, r1 = StoreStubReplica(0), StoreStubReplica(1, outstanding=9)
+        d, _ = _dispatcher([r0, r1])
+        assert d.step("cam0", 0, _img(), _img()).replica == "r0"
+        ref = _seed_state(r0, "cam0")
+        assert d.export_session("nope") is None
+        snap = d.export_session("cam0")
+        assert snap is not None and snap["session_id"] == "cam0"
+        wire = json.loads(json.dumps(snapshot_to_wire(snap)))
+        r0._state = "failed"
+        assert d.import_session(wire_to_snapshot(wire)) == "warm"
+        assert d._pins.peek("cam0") == 1  # re-pinned to the importer
+        sess, created = r1.store.get_or_create("cam0")
+        assert not created
+        with sess.lock:
+            np.testing.assert_array_equal(sess.prev_disp_low, ref)
+        assert d.step("cam0", 1, _img(), _img()).replica == "r1"
+        assert d.cluster_metrics.session_repins.value == 0
+
+
+class TestAutoscale:
+    def test_recommend_directions(self):
+        p = AutoscalePolicy()
+        assert recommend(p, ready=0, utilization=1.0)[0] == 0
+        assert recommend(p, ready=2, utilization=0.9)[0] == 1
+        assert recommend(p, ready=2, utilization=0.5)[0] == 0
+        assert recommend(p, ready=2, utilization=0.5, occupancy=0.9)[0] \
+            == 1
+        assert recommend(p, ready=2, utilization=0.1)[0] == -1
+        # min_replicas floor: never advise scaling in the last replica.
+        assert recommend(p, ready=1, utilization=0.0)[0] == 0
+        # Sheds dominate: refused traffic means scale out even when the
+        # utilization gauge looks idle.
+        assert recommend(p, ready=2, utilization=0.1, shed_delta=3)[0] \
+            == 1
+
+    def test_hysteresis_damps_and_sheds_fire_immediately(self):
+        a = Autoscaler()
+        assert a.observe(ready=2, utilization=0.9)["action"] == "hold"
+        second = a.observe(ready=2, utilization=0.9)
+        assert (second["action"], second["delta"]) == ("scale_up", 1)
+        b = Autoscaler()
+        adv = b.observe(ready=2, utilization=0.1, shed_total=5)
+        assert adv["action"] == "scale_up"  # no streak needed
+        assert adv["signals"]["shed_delta"] == 5.0
+        # The shed signal is a counter DELTA: an unchanged total is not
+        # a new shed.
+        adv = b.observe(ready=2, utilization=0.5, shed_total=5)
+        assert adv["action"] == "hold"
+        assert adv["signals"]["shed_delta"] == 0.0
+
+    def test_scale_down_clamped_at_min_replicas(self):
+        a = Autoscaler()
+        for _ in range(2):
+            adv = a.observe(ready=2, utilization=0.0)
+        assert (adv["action"], adv["delta"]) == ("scale_down", -1)
+        b = Autoscaler()
+        for _ in range(5):
+            adv = b.observe(ready=1, utilization=0.0)
+        assert (adv["action"], adv["delta"]) == ("hold", 0)
+
+
+class TestKillBackendFault:
+    def test_fires_exactly_once_at_n(self):
+        plan = FaultPlan.parse("kill_backend@request=3")
+        fired = [n for n in range(1, 6) if plan.on_request(n)]
+        assert fired == [3]
+        assert not plan.on_request(3)  # consumed: deterministic, once
+
+    def test_wrong_dim_rejected(self):
+        with pytest.raises(ValueError):
+            FaultPlan.parse("kill_backend@step=3")
 
 
 class TestReplicaLifecycle:
@@ -513,9 +860,9 @@ def _free_port() -> int:
 
 
 class TestRouter:
-    def _backend(self, cluster_model, warmup_async=False):
+    def _backend(self, cluster_model, warmup_async=False, port=0):
         model, variables = cluster_model
-        cfg = _cfg(warmup=True, iters=2, degraded_iters=2,
+        cfg = _cfg(warmup=True, iters=2, degraded_iters=2, port=port,
                    stream=StreamConfig(ladder=(2, 1)), stream_warmup=True,
                    cluster=None)
         srv = build_server(model, variables, cfg,
@@ -654,6 +1001,202 @@ class TestRouter:
             router.close()
             rt.join(10)
             for srv, th in ((b0, t0), (b1, t1)):
+                try:
+                    srv.close()
+                except Exception:
+                    pass
+                th.join(5)
+
+    def test_zero_downtime_restart_and_kill(self, cluster_model,
+                                            retrace_guard):
+        """THE acceptance gate (ISSUE 13): zero-downtime cluster ops
+        under sequence-replay load through the router over two real
+        backends.
+
+        (a) ``POST /debug/restart`` drains a backend, migrates its
+        pinned sessions WARM — bitwise-identical to a twin session that
+        never moved — loses zero accepted requests, and the whole
+        drain -> handoff -> serve-on-the-survivor path compiles NOTHING
+        (migration is pure host numpy).  The operator's half (rebuild at
+        the same address with ``warmup_async``) rejoins through the
+        readiness probe, and post-rejoin steady state also holds a
+        zero-compile budget.
+
+        (b) an unplanned kill (fault-hook-scheduled, so the kill point
+        is deterministic) costs at most the documented ``cold_lost``
+        fallback: the orphaned session's next frame runs cold on the
+        survivor — never an error, never a hang.
+        """
+        from raftstereo_tpu.obs import validate_prometheus
+
+        b0, t0 = self._backend(cluster_model)
+        b1, t1 = self._backend(cluster_model)
+        ports = {"b0": b0.port, "b1": b1.port}
+        servers = {"b0": (b0, t0), "b1": (b1, t1)}
+        router = build_router(RouterConfig(
+            port=0, backends=(("127.0.0.1", b0.port),
+                              ("127.0.0.1", b1.port)),
+            probe_interval_s=0.15, fail_after=1, retries=2,
+            retry_backoff_ms=20.0, request_timeout_s=60.0))
+        rt = threading.Thread(target=router.serve_forever, daemon=True)
+        rt.start()
+        client = ServeClient("127.0.0.1", router.port, timeout=120,
+                             retries=2)
+        frames = [_img(60, 90, 100 + i) for i in range(6)]
+        try:
+            deadline = time.perf_counter() + 60
+            while time.perf_counter() < deadline:
+                h = client.healthz()
+                if all(h["backends"][n]["state"] == "ready"
+                       for n in ("b0", "b1")):
+                    break
+                time.sleep(0.1)
+            assert h["backends"]["b0"]["state"] == "ready"
+            assert h["backends"]["b1"]["state"] == "ready"
+
+            # Pre-pay both backends' cold + warm stream paths OUTSIDE
+            # the guards (direct, bypassing the router) so the budgets
+            # below measure migration, not leftover warmup gaps.
+            for name, (srv, _th) in servers.items():
+                direct = ServeClient("127.0.0.1", srv.port, timeout=120)
+                direct.predict(frames[0], frames[0])
+                for seq in range(2):
+                    direct.predict(frames[seq], frames[seq],
+                                   session_id=f"prewarm-{name}",
+                                   seq_no=seq)
+                direct.close()
+
+            # The session that will migrate: 3 frames via the router.
+            mig_meta = []
+            for seq in range(3):
+                _, meta = client.predict(frames[seq], frames[seq],
+                                         session_id="mig", seq_no=seq)
+                mig_meta.append(meta)
+            assert [m["warm"] for m in mig_meta] == [False, True, True]
+            assert len({m["backend"] for m in mig_meta}) == 1
+            victim_name = mig_meta[0]["backend"]
+            survivor_name = "b1" if victim_name == "b0" else "b0"
+            victim, victim_thread = servers[victim_name]
+            survivor, _st = servers[survivor_name]
+
+            # The unmigrated TWIN: the same 6 frames as one
+            # uninterrupted session DIRECTLY on the survivor — the
+            # bitwise reference for "a warm handoff is indistinguishable
+            # from having stayed".
+            twin = ServeClient("127.0.0.1", survivor.port, timeout=120)
+            twin_disp = []
+            for seq in range(6):
+                dsp, meta = twin.predict(frames[seq], frames[seq],
+                                         session_id="twin", seq_no=seq)
+                twin_disp.append(dsp)
+            assert meta["warm"] is True
+            twin.close()
+
+            # ---- (a) drain-and-restart under sequence-replay load:
+            # zero compiles, zero lost accepted requests, zero cold
+            # frames beyond each sequence's head.
+            with retrace_guard(0, what="restart = drain + warm handoff "
+                                       "+ serve on the survivor; "
+                                       "migration is host-side numpy",
+                               min_duration_s=0.5):
+                load = {}
+
+                def _load():
+                    load.update(run_load(
+                        "127.0.0.1", router.port,
+                        lambda i: (frames[i % 4], frames[i % 4]),
+                        requests=32, concurrency=3, sequence_len=4,
+                        timeout=120, retries=2))
+
+                lt = threading.Thread(target=_load)
+                lt.start()
+                time.sleep(0.2)  # let sequences land on both backends
+                status, raw, _ = client._request(
+                    "POST", "/debug/restart",
+                    json.dumps({"backend": victim_name}).encode())
+                assert status == 200, raw
+                reply = json.loads(raw)
+                assert reply["drained"] is True
+                assert reply["migrated"].get("mig") == "warm", reply
+                lt.join(120)
+                # Zero lost accepted requests: every load frame answered
+                # 200 (client retries ride out the drain window); cold
+                # only at each sequence head, so migrated mid-sequence
+                # sessions stayed warm.
+                assert load["ok"] == 32, load
+                assert load["cold_frames"] == 32 // 4, load
+                assert load["warm_frames"] == 32 - 32 // 4, load
+
+                # The migrated session: warm on the survivor and
+                # bitwise-identical to the twin that never moved.
+                for seq in range(3, 6):
+                    dsp, meta = client.predict(frames[seq], frames[seq],
+                                               session_id="mig",
+                                               seq_no=seq)
+                    assert meta["backend"] == survivor_name, meta
+                    assert meta["warm"] is True, meta
+                    np.testing.assert_array_equal(dsp, twin_disp[seq])
+
+            text = client.metrics_text()
+            assert validate_prometheus(text) == []
+            assert 'cluster_session_handoffs_total{outcome="warm"}' \
+                in text
+
+            # ---- operator's half: rebuild the victim at the SAME
+            # address with warmup_async (compiles paid OUTSIDE the
+            # steady-state guard), readiness probe gates the rejoin.
+            victim.close()
+            victim_thread.join(10)
+            servers[victim_name] = self._backend(
+                cluster_model, warmup_async=True,
+                port=ports[victim_name])
+            deadline = time.perf_counter() + 120
+            while time.perf_counter() < deadline:
+                h = client.healthz()
+                if h["backends"][victim_name]["state"] == "ready":
+                    break
+                time.sleep(0.1)
+            assert h["backends"][victim_name]["state"] == "ready"
+
+            # Steady state after the rejoin: still zero compiles.
+            with retrace_guard(0, what="post-rejoin steady state reuses "
+                                       "warm executables on both "
+                                       "backends",
+                               min_duration_s=0.5):
+                for _ in range(4):
+                    _, meta = client.predict(frames[0], frames[0])
+                    assert meta["backend"] in ("b0", "b1")
+                _, meta = client.predict(frames[0], frames[0],
+                                         session_id="mig", seq_no=6)
+                assert meta["warm"] is True
+
+            # ---- (b) kill, no drain: the fault hook picks the moment;
+            # the orphaned session's next frame is the documented
+            # cold_lost fallback, served by the survivor.
+            plan = FaultPlan.parse("kill_backend@request=2")
+            warm_seen, chaos_home = [], None
+            for seq in range(5):
+                _, meta = client.predict(frames[seq % 4], frames[seq % 4],
+                                         session_id="chaos", seq_no=seq)
+                warm_seen.append(meta["warm"])
+                if seq == 0:
+                    chaos_home = meta["backend"]
+                if plan.on_request(seq + 1):
+                    srv, th = servers[chaos_home]
+                    srv.close()  # SIGKILL stand-in: no drain, no sweep
+                    th.join(10)
+            assert warm_seen == [False, True, False, True, True]
+            text = client.metrics_text()
+            assert validate_prometheus(text) == []
+            assert 'cluster_session_handoffs_total{outcome="cold_lost"}' \
+                in text
+            assert 'cluster_session_repins_total{reason="failed"}' \
+                in text
+        finally:
+            client.close()
+            router.close()
+            rt.join(10)
+            for srv, th in servers.values():
                 try:
                     srv.close()
                 except Exception:
