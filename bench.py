@@ -687,6 +687,73 @@ def bench_stream(height: int, width: int, frames: int, iters: int,
     return compare_warm_cold(engine, seq.frames, stream_cfg)["summary"]
 
 
+def bench_spatial(height: int, width: int, iters: int, shards: int,
+                  corr: str, reps: int, quick: bool):
+    """Spatial-sharding A/B smoke (mirrors --stream): ONE pair at the
+    given resolution through the (1, N) sharded forward
+    (parallel/spatial.py) and through the single-device jit — same
+    weights, same iteration count — reporting mean latency both ways and
+    the max |disparity| gap between them.  Runs at fp32 (the precision
+    the sharded program is certified at, v1): on the CPU mesh the gap is
+    0.0 by construction, so any nonzero value is a halo/replication bug,
+    not noise."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raftstereo_tpu.config import RAFTStereoConfig
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.parallel.spatial import (check_spatial_shape,
+                                                 jitted_spatial_infer_init,
+                                                 spatial_mesh,
+                                                 validate_spatial_config)
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        # CPU-feasible model, same shrink as the test suite's tiny configs.
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    cfg = RAFTStereoConfig(corr_implementation=corr, **model_kw)
+    validate_spatial_config(cfg)
+    check_spatial_shape(cfg, shards, height, width)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+    rng = np.random.default_rng(0)
+    i1 = jnp.asarray(rng.standard_normal((1, height, width, 3)) * 50 + 120,
+                     jnp.float32)
+    i2 = jnp.asarray(rng.standard_normal((1, height, width, 3)) * 50 + 120,
+                     jnp.float32)
+    zeros = jnp.zeros((1, height // cfg.factor, width // cfg.factor, 1),
+                      jnp.float32)
+
+    single = model.jitted_infer(iters=iters)
+    sharded = jitted_spatial_infer_init(model, spatial_mesh(shards),
+                                        iters=iters)
+
+    def timed(fn):
+        out = jax.block_until_ready(fn())  # compile outside the clock
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            out = jax.block_until_ready(fn())
+        return out, (_time.perf_counter() - t0) / reps * 1e3
+
+    (_, up_single), single_ms = timed(lambda: single(variables, i1, i2))
+    (_, up_sharded), sharded_ms = timed(
+        lambda: sharded(variables, i1, i2, zeros))
+    gap = float(jnp.max(jnp.abs(up_sharded - up_single)))
+    return {
+        "shards": shards,
+        "iters": iters,
+        "single_ms": round(single_ms, 2),
+        "sharded_ms": round(sharded_ms, 2),
+        "speedup": round(single_ms / sharded_ms, 3) if sharded_ms else 0.0,
+        "max_abs_gap": gap,
+    }
+
+
 def bench_sched(height: int, width: int, long_iters: int, max_batch: int,
                 corr: str, compute_dtype: str, quick: bool):
     """Iteration-level-scheduler smoke benchmark (mirrors --serve): a
@@ -1076,6 +1143,17 @@ def main() -> None:
     p.add_argument("--frames", type=int, default=None,
                    help="sequence length for --stream (default 16; 8 "
                         "under --quick unless given explicitly)")
+    p.add_argument("--spatial", action="store_true",
+                   help="benchmark spatial sharding: ONE pair through the "
+                        "(1, N) height-sharded forward vs the "
+                        "single-device jit (--shards = mesh width), "
+                        "reporting A/B latency and the max |disparity| "
+                        "gap (0.0 expected: the sharded program is "
+                        "bitwise-identical at fp32)")
+    p.add_argument("--shards", type=int, default=4,
+                   help="spatial mesh width for --spatial (default 4; on "
+                        "a CPU host the devices are virtualized via "
+                        "xla_force_host_platform_device_count)")
     p.add_argument("--data", action="store_true",
                    help="measure host data-pipeline throughput (KITTI-size "
                         "decode + sparse augmentation, multiprocess workers) "
@@ -1092,7 +1170,8 @@ def main() -> None:
     # refuse to run while the static-analysis baseline has entries
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
     if args.quick or args.serve or args.stream or args.sched \
-            or args.cluster or args.gru or args.quant or args.sl:
+            or args.cluster or args.gru or args.quant or args.sl \
+            or args.spatial:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -1157,16 +1236,17 @@ def main() -> None:
     # through jax.config so `JAX_PLATFORMS=cpu python bench.py` works.
     from raftstereo_tpu.utils import apply_env_platform
 
-    if args.cluster and "jax" not in sys.modules \
+    if (args.cluster or args.spatial) and "jax" not in sys.modules \
             and "xla_force_host_platform_device_count" \
             not in os.environ.get("XLA_FLAGS", ""):
         # A CPU host shows one device by default; fan it out so N
-        # replicas exist to place on (no-op under a real TPU runtime,
-        # where JAX_PLATFORMS selects the chips).  Must happen before
-        # the first jax import freezes XLA_FLAGS.
+        # replicas (or N spatial shards) exist to place on (no-op under
+        # a real TPU runtime, where JAX_PLATFORMS selects the chips).
+        # Must happen before the first jax import freezes XLA_FLAGS.
+        n_dev = args.shards if args.spatial else args.replicas
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.replicas}"
+            + f" --xla_force_host_platform_device_count={n_dev}"
         ).strip()
     apply_env_platform()
 
@@ -1366,6 +1446,37 @@ def main() -> None:
                       f"{summary['ladder']}, {frames} frames",
             "value": summary.get("warm_mean_latency_ms") or 0.0,
             "unit": "ms/frame",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
+
+    if args.spatial:
+        h, w = args.height, args.width
+        reps = args.reps
+        if args.quick:
+            # Tiny model + a shape that still splits into real slabs on
+            # every shard.  An explicitly given flag wins, as ever.
+            if not explicit_hw:
+                h, w = 64, 96
+            if not explicit_iters:
+                args.iters = 4
+            if not explicit_reps:
+                reps = 2
+        elif not explicit_hw:
+            # The plain default 540 is not slab-divisible; 512 splits
+            # into row-multiple slabs for 2/4/8 shards of the flagship
+            # config (row multiple 16).
+            h = 512
+        summary = bench_spatial(h, w, args.iters, args.shards, args.corr,
+                                reps, quick=args.quick)
+        record = {
+            "metric": f"spatial sharded-vs-single ms/pair @{w}x{h}, "
+                      f"{args.shards}-shard (1, N) mesh, {args.iters} "
+                      f"GRU iters",
+            "value": summary["sharded_ms"],
+            "unit": "ms",
             "vs_baseline": 0.0,
         }
         record.update(summary)

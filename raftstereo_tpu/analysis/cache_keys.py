@@ -17,13 +17,15 @@ which names flow into them (transitively through simple assignments and
 method reaches the key:
 
 * key-relevant = the parameter name contains ``iters``, ``mode``,
-  ``precision``, ``dtype``, ``backend``, ``accuracy``, ``tier`` or
-  ``quant`` — the inputs that select a distinct executable (shape inputs
-  are carried by the bucket, which every key already starts from;
-  ``backend`` covers kernel-backend selectors like the fused-GRU
-  ``gru_backend``, and ``accuracy``/``tier``/``quant`` the per-request
-  accuracy tiers whose precision mode joins every serving key,
-  serve/engine.py + ops/quant.py).
+  ``precision``, ``dtype``, ``backend``, ``accuracy``, ``tier``,
+  ``quant`` or ``shards`` — the inputs that select a distinct executable
+  (shape inputs are carried by the bucket, which every key already
+  starts from; ``backend`` covers kernel-backend selectors like the
+  fused-GRU ``gru_backend``, ``accuracy``/``tier``/``quant`` the
+  per-request accuracy tiers whose precision mode joins every serving
+  key, serve/engine.py + ops/quant.py, and ``shards`` the spatial mesh
+  width — a 2-shard and a 4-shard program at the same bucket are
+  different executables, parallel/spatial.py).
 
 Codes:
 
@@ -44,7 +46,7 @@ __all__ = ["check"]
 
 _METHOD_RE = re.compile(r"^(infer|warmup)_")
 _KEY_TOKENS = ("iters", "mode", "precision", "dtype", "backend",
-               "accuracy", "tier", "quant", "input_mode")
+               "accuracy", "tier", "quant", "input_mode", "shards")
 _CACHE_ATTR_RE = re.compile(r"compiled|cache", re.IGNORECASE)
 _DISPATCH_RE = re.compile(r"dispatch", re.IGNORECASE)
 

@@ -103,6 +103,18 @@ class RAFTStereoConfig:
     # (eval/certify.ARCH_FIELDS).
     input_mode: str = "passive"
 
+    # Spatial sharding (parallel/spatial.py, docs/serving.md "Spatial
+    # sharding"): shard one inference's image height across this many
+    # chips on the ``space`` axis of a (1, N) mesh under shard_map —
+    # single-request multi-chip inference for pairs whose corr pyramid +
+    # activations exceed one chip's HBM.  1 = the classic single-chip
+    # forward.  A model-level default: ``ServeConfig.spatial_shards``
+    # overrides it serverside, and the engine cache-keys every spatial
+    # executable by the resolved count.  v1 is XLA-GRU-only
+    # (parallel/spatial.validate_spatial_config rejects the fused
+    # megakernel, shared_backbone, group context norm and corr_quant).
+    spatial_shards: int = 1
+
     def __post_init__(self):
         if isinstance(self.hidden_dims, list):
             object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
@@ -114,6 +126,7 @@ class RAFTStereoConfig:
         assert self.input_mode in ("passive", "sl"), self.input_mode
         assert 1 <= self.n_gru_layers <= 3, self.n_gru_layers
         assert len(self.hidden_dims) >= self.n_gru_layers
+        assert self.spatial_shards >= 1, self.spatial_shards
 
     @property
     def factor(self) -> int:
@@ -461,6 +474,22 @@ class ServeConfig:
     # routing.  None keeps the single-engine path.
     cluster: Optional[ClusterConfig] = None
 
+    # Spatial sharding (parallel/spatial.py, serve/spatial/,
+    # docs/serving.md "Spatial sharding"): when > 1 the server can run
+    # ONE request with its image height sharded across that many chips
+    # on the ``space`` axis of a (1, N) mesh — the path for resolutions
+    # above the single-chip bucket ceiling (``max_image_dim``).  0
+    # inherits the model config's ``spatial_shards``.
+    # ``spatial_buckets`` are the (H, W) image shapes the spatial path
+    # serves (warmed at startup like ``buckets``); spatial requests to
+    # other shapes — or with an ``accuracy`` tier / ``session_id``, both
+    # unsupported under sharding in v1 — are 400s at admission, never a
+    # compile.  When spatial buckets are configured, ``max_body_mb`` is
+    # auto-raised to fit the largest one (see ``spatial_body_mb``), so a
+    # 4K pair is not 413'd before admission ever sees it.
+    spatial_shards: int = 0
+    spatial_buckets: Tuple[Tuple[int, int], ...] = ()
+
     # Per-request accuracy tiers (ops/quant.py, docs/serving.md "Accuracy
     # tiers"): tier names ("certified"/"fast"/"turbo") the server should
     # OFFER on /predict's ``accuracy`` field.  "fast"/"turbo" are only
@@ -482,8 +511,19 @@ class ServeConfig:
         if isinstance(self.buckets, list):
             object.__setattr__(
                 self, "buckets", tuple(tuple(b) for b in self.buckets))
-        if isinstance(self.tiers, list):
-            object.__setattr__(self, "tiers", tuple(self.tiers))
+        if isinstance(self.spatial_buckets, list):
+            object.__setattr__(
+                self, "spatial_buckets",
+                tuple(tuple(b) for b in self.spatial_buckets))
+        assert self.spatial_shards >= 0, self.spatial_shards
+        if self.spatial_shards > 1 and self.spatial_buckets:
+            # The whole point of the spatial path is payloads above the
+            # single-chip cap — refusing them at the body cap would make
+            # the capability unreachable (serve/httpbase.py 413s before
+            # admission ever sees the request).
+            need = spatial_body_mb(self.spatial_buckets)
+            if need > self.max_body_mb:
+                object.__setattr__(self, "max_body_mb", need)
         _known_tiers = ("certified", "fast", "turbo")  # ops/quant.TIERS
         bad_tiers = [t for t in self.tiers if t not in _known_tiers]
         assert not bad_tiers, (
@@ -521,6 +561,20 @@ class ServeConfig:
                     f"stream ladder levels {bad} unreachable under sched "
                     f"(iters_per_step {self.sched.iters_per_step}, "
                     f"max_iters {self.sched.max_iters})")
+
+
+def spatial_body_mb(buckets: Tuple[Tuple[int, int], ...],
+                    channels: int = 3) -> float:
+    """Request-body cap (MB) the largest spatial bucket needs: two fp32
+    images base64-encoded (4/3 expansion) plus 25% JSON/meta headroom.
+    ``ServeConfig`` raises ``max_body_mb`` to this when spatial buckets
+    are configured — a 4K pair is ~265 MB on the wire, well above the
+    single-chip default cap."""
+    if not buckets:
+        return 0.0
+    h, w = max(buckets, key=lambda b: b[0] * b[1])
+    raw = 2 * h * w * channels * 4  # two fp32 images
+    return round(raw * (4 / 3) * 1.25 / 2 ** 20, 1)
 
 
 def _parse_bucket(text: str) -> Tuple[int, int]:
@@ -576,6 +630,17 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--trace_buffer", type=int, default=d.trace_buffer,
                    help="span ring-buffer capacity behind /debug/trace "
                         "(docs/observability.md)")
+    g.add_argument("--spatial_shards", type=int, default=d.spatial_shards,
+                   help="shard one request's image height across this many "
+                        "chips (space axis, parallel/spatial.py) for "
+                        "resolutions above --max_image_dim; 0 inherits the "
+                        "model config, 1 disables "
+                        "(docs/serving.md \"Spatial sharding\")")
+    g.add_argument("--spatial_buckets", nargs="+", type=_parse_bucket,
+                   default=list(d.spatial_buckets), metavar="HxW",
+                   help="image shapes the spatial path serves (warmed at "
+                        "startup; other spatial shapes are a 400). "
+                        "Raises --max_body_mb to fit the largest one.")
     g.add_argument("--tiers", nargs="+", default=list(d.tiers),
                    choices=["certified", "fast", "turbo"], metavar="TIER",
                    help="accuracy tiers offered on /predict's 'accuracy' "
@@ -776,6 +841,8 @@ def serve_config_from_args(args: argparse.Namespace,
         max_body_mb=args.max_body_mb,
         max_image_dim=args.max_image_dim,
         cold_buckets=not args.no_cold_buckets,
+        spatial_shards=args.spatial_shards,
+        spatial_buckets=tuple(tuple(b) for b in args.spatial_buckets),
         trace_buffer=args.trace_buffer,
         tiers=tuple(args.tiers),
         cert_manifest=args.cert_manifest,

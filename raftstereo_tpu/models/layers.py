@@ -41,6 +41,62 @@ class Identity(nn.Module):
         return x
 
 
+def instance_norm_group_width(c: int, w: int) -> int:
+    """The lane-group factor k of the instance-norm view below: (H, W, C)
+    is read as (H, W/k, C*k).  Depends only on (C, W), so any H-slab of an
+    image shares the full image's view geometry — the property the spatial
+    sharding driver (parallel/spatial.py) relies on to apply full-image
+    statistics to a local slab."""
+    k = 1
+    while c * k % 128 and k < 8 and w % (2 * k) == 0:
+        k *= 2
+    return k
+
+
+def instance_norm_stats(x):
+    """Normalization constants of ``InstanceNorm`` for ``x``: the lane-group
+    factor k plus the tiled mean/scale broadcasts (b, 1, 1, c*k), in x.dtype.
+    Split out of ``InstanceNorm.__call__`` (pure code motion — the op
+    sequence is unchanged) so the spatial-sharding driver can compute stats
+    on the gathered full-height activation and normalize each H slab
+    locally, bitwise-identical to the single-device norm."""
+    b, h, w, c = x.shape
+    k = instance_norm_group_width(c, w)
+    xr = x.reshape(b, h, w // k, c * k)
+    # Variance via CENTERED squares, not E[x^2]-m^2: squaring in bf16
+    # rounds x^2 at ~0.4% absolute-of-x^2, which destroys small
+    # variances when |mean| >> std (catastrophic cancellation in the
+    # subtraction). Centering first keeps the squared values O(var), so
+    # bf16 rounding is harmless; the group means themselves round at
+    # ~3e-4 relative, contributing only (m_err)^2 to the variance.
+    # Reduces stay in x.dtype (TPU accumulates internally in high
+    # precision; an explicit dtype=float32 reduce makes XLA materialize
+    # an fp32 copy of x, measured 2x slower). Exact in fp32 mode.
+    m = jnp.mean(xr, axis=(1, 2))                              # (b, c*k)
+    ctr = xr - m[:, None, None, :]
+    v = jnp.mean(jnp.square(ctr), axis=(1, 2)).astype(jnp.float32)
+    # Per-channel stats across the k interleaved groups (equal sizes):
+    # mean = avg_g m_g; var = avg_g var_g + avg_g (m_g - mean)^2.
+    m32 = m.astype(jnp.float32).reshape(b, k, c)
+    mbar = m32.mean(axis=1)                                    # (b, c)
+    var = (v.reshape(b, k, c).mean(axis=1)
+           + jnp.square(m32 - mbar[:, None, :]).mean(axis=1))
+    scale = jax.lax.rsqrt(jnp.maximum(var, 0.0) + 1e-5)
+    mw = jnp.tile(mbar, (1, k)).astype(x.dtype)[:, None, None, :]
+    sw = jnp.tile(scale, (1, k)).astype(x.dtype)[:, None, None, :]
+    return k, mw, sw
+
+
+def instance_norm_apply(x, k, mw, sw):
+    """Elementwise normalize sweep of ``InstanceNorm`` with precomputed
+    constants from ``instance_norm_stats``.  Row-local: applying full-image
+    constants to an H slab equals the matching rows of the full-image
+    norm."""
+    b, h, w, c = x.shape
+    xr = x.reshape(b, h, w // k, c * k)
+    return ((xr - mw) * sw).reshape(b, h, w, c)
+
+
 class InstanceNorm(nn.Module):
     """Per-image, per-channel normalization over (H, W); no affine params,
     eps 1e-5 (torch InstanceNorm2d defaults; reference: core/extractor.py:29).
@@ -52,54 +108,27 @@ class InstanceNorm(nn.Module):
     In fp32 mode the statistics are exact. In bf16 mode the reduces stay in
     bf16 (an fp32 upcast of x makes XLA materialize a full-size fp32 copy),
     rounding the group means at ~3e-4 relative; the centered-squares
-    formulation below keeps that harmless even when |mean| >> std.
+    formulation in ``instance_norm_stats`` keeps that harmless even when
+    |mean| >> std.
+
+    TPU-shaped formulation, measured on v5e at 544x960x64 (the feature
+    encoder's hot shape): (H, W, C) is viewed as (H, W/k, C*k) with the
+    smallest k making C*k a lane-width (128) multiple — a pure view in
+    row-major NHWC, no data movement — so the stats reduces and the
+    normalize sweep run with full lanes. With C=64 the naive form leaves
+    half the VPU idle and every extra pass over the tensor crawls at ~5%
+    of HBM bandwidth (~3 ms per pass vs ~0.3 ms); this view recovers it
+    (norm cost 1.9 ms vs 9-12 ms, and 4x vs the GroupNorm form).
+    Everything elementwise stays in x.dtype so it fuses with the
+    surrounding convs; only the statistics are fp32 (an fp32 upcast of x
+    itself makes XLA materialize a ~270 MB fp32 copy of the full-res
+    tensor).
     """
 
     @nn.compact
     def __call__(self, x):
-        # TPU-shaped formulation, measured on v5e at 544x960x64 (the
-        # feature encoder's hot shape):
-        #
-        # * (H, W, C) is viewed as (H, W/k, C*k) with the smallest k making
-        #   C*k a lane-width (128) multiple — a pure view in row-major NHWC,
-        #   no data movement — so the stats reduces and the normalize sweep
-        #   run with full lanes. With C=64 the naive form leaves half the
-        #   VPU idle and every extra pass over the tensor crawls at ~5% of
-        #   HBM bandwidth (~3 ms per pass vs ~0.3 ms); this view recovers it
-        #   (norm cost 1.9 ms vs 9-12 ms, and 4x vs the GroupNorm form).
-        # * Everything elementwise stays in x.dtype so it fuses with the
-        #   surrounding convs; only the statistics are fp32. An fp32 upcast
-        #   of x itself makes XLA materialize a ~270 MB fp32 copy of the
-        #   full-res tensor. In fp32 mode this path is exact; the k
-        #   interleaved groups have equal size, so mean-of-group-means is
-        #   exact.
-        b, h, w, c = x.shape
-        k = 1
-        while c * k % 128 and k < 8 and w % (2 * k) == 0:
-            k *= 2
-        xr = x.reshape(b, h, w // k, c * k)
-        # Variance via CENTERED squares, not E[x^2]-m^2: squaring in bf16
-        # rounds x^2 at ~0.4% absolute-of-x^2, which destroys small
-        # variances when |mean| >> std (catastrophic cancellation in the
-        # subtraction). Centering first keeps the squared values O(var), so
-        # bf16 rounding is harmless; the group means themselves round at
-        # ~3e-4 relative, contributing only (m_err)^2 to the variance.
-        # Reduces stay in x.dtype (TPU accumulates internally in high
-        # precision; an explicit dtype=float32 reduce makes XLA materialize
-        # an fp32 copy of x, measured 2x slower). Exact in fp32 mode.
-        m = jnp.mean(xr, axis=(1, 2))                              # (b, c*k)
-        ctr = xr - m[:, None, None, :]
-        v = jnp.mean(jnp.square(ctr), axis=(1, 2)).astype(jnp.float32)
-        # Per-channel stats across the k interleaved groups (equal sizes):
-        # mean = avg_g m_g; var = avg_g var_g + avg_g (m_g - mean)^2.
-        m32 = m.astype(jnp.float32).reshape(b, k, c)
-        mbar = m32.mean(axis=1)                                    # (b, c)
-        var = (v.reshape(b, k, c).mean(axis=1)
-               + jnp.square(m32 - mbar[:, None, :]).mean(axis=1))
-        scale = jax.lax.rsqrt(jnp.maximum(var, 0.0) + 1e-5)
-        mw = jnp.tile(mbar, (1, k)).astype(x.dtype)[:, None, None, :]
-        sw = jnp.tile(scale, (1, k)).astype(x.dtype)[:, None, None, :]
-        return ((xr - mw) * sw).reshape(b, h, w, c)
+        k, mw, sw = instance_norm_stats(x)
+        return instance_norm_apply(x, k, mw, sw)
 
 
 def make_norm(norm_fn: str, channels: int, dtype: Any = jnp.float32,

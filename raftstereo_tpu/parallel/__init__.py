@@ -12,10 +12,17 @@ from .distributed import (global_batch_from_local, initialize,
 from .mesh import (DATA_AXIS, SPACE_AXIS, batch_sharded, make_mesh,
                    replica_devices, replicated, shard_batch,
                    spatial_sharded)
+from .spatial import (SpatialShardingUnsupported, check_spatial_shape,
+                      halo_exchange, jitted_spatial_infer,
+                      jitted_spatial_infer_init, spatial_mesh,
+                      spatial_row_multiple, validate_spatial_config)
 
 __all__ = [
     "DATA_AXIS", "SPACE_AXIS", "make_mesh", "replicated", "batch_sharded",
     "spatial_sharded", "shard_batch", "replica_devices",
     "initialize", "is_multiprocess", "process_local_batch",
     "global_batch_from_local",
+    "SpatialShardingUnsupported", "check_spatial_shape", "halo_exchange",
+    "jitted_spatial_infer", "jitted_spatial_infer_init", "spatial_mesh",
+    "spatial_row_multiple", "validate_spatial_config",
 ]

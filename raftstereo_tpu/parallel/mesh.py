@@ -8,10 +8,16 @@ Two mesh axes cover this framework's scaling dimensions:
 * ``space`` — image-height sharding for high-resolution inference.  The
   reference's answer to big images is an O(H*W) correlation backend and a
   bigger downsample factor (reference: README.md:111,121); sharding H over
-  chips is the TPU answer — XLA's SPMD partitioner inserts halo exchanges for
-  the convolutions automatically, and the 1-D correlation is along W (each H
-  shard's epipolar lines are self-contained), so no manual collectives are
-  needed.
+  chips is the TPU answer.  The canonical implementation is
+  ``parallel/spatial.py``: the whole forward runs under ``shard_map`` on a
+  ``(1, N)`` mesh with EXPLICIT ``ppermute`` halo exchange at every conv's
+  slab boundary — the 1-D correlation is along W (each H shard's epipolar
+  lines are self-contained), so the halos are the only collectives until
+  the final gather.  (An earlier revision of this docstring claimed XLA's
+  SPMD partitioner inserts the halos automatically under plain ``jit`` —
+  true, but that path neither guarantees bitwise parity with the
+  single-device program nor keeps the corr volume row-local by
+  construction, which is why the subsystem owns its collectives.)
 
 Everything here is plain ``jax.sharding``; no wrappers around jit.
 """
@@ -90,7 +96,12 @@ def batch_sharded(mesh: Mesh) -> NamedSharding:
 
 
 def spatial_sharded(mesh: Mesh) -> NamedSharding:
-    """Shard axis 1 (image height, NHWC) across the ``space`` axis."""
+    """Shard axis 1 (image height H, NHWC layout) across the ``space``
+    axis — the in/out sharding of the spatial-inference subsystem
+    (``parallel/spatial.py``; its ``shard_map`` specs are the
+    ``PartitionSpec`` twin of this ``NamedSharding``).  Batch stays
+    unsharded: the spatial path is single-request by design, the whole
+    mesh belongs to one pair."""
     return NamedSharding(mesh, P(None, SPACE_AXIS))
 
 

@@ -52,7 +52,14 @@ class ServeError(RuntimeError):
 
     def __init__(self, status: int, payload: Dict,
                  request_id: Optional[str] = None):
-        super().__init__(f"HTTP {status}: {payload.get('error', payload)}")
+        msg = f"HTTP {status}: {payload.get('error', payload)}"
+        if status == 413 and "limit_mb" in payload:
+            # Actionable refusal, not a mystery drop: the cap auto-sizes
+            # to --spatial_buckets (config.spatial_body_mb), so the fix
+            # is a server configured for the resolution, not a retry.
+            msg += (f" (server body cap {payload['limit_mb']} MB; an "
+                    f"oversized pair needs --spatial_buckets covering it)")
+        super().__init__(msg)
         self.status = status
         self.payload = payload
         self.request_id = request_id
@@ -182,7 +189,8 @@ class ServeClient:
                 seq_no: Optional[int] = None,
                 deadline_ms: Optional[float] = None,
                 priority: Optional[str] = None,
-                accuracy: Optional[str] = None
+                accuracy: Optional[str] = None,
+                spatial: Optional[bool] = None
                 ) -> Tuple[np.ndarray, Dict]:
         """One stereo pair -> ((H, W) disparity, meta dict).
 
@@ -194,9 +202,17 @@ class ServeClient:
         iteration-level scheduler (``--sched``, docs/serving.md).
         ``accuracy`` picks an advertised accuracy tier
         (certified/fast/turbo, docs/serving.md "Accuracy tiers"); an
-        unadvertised tier is a 400.  Raises ``ServeError`` on any
-        non-200 status (503 = shed / 504 = timeout are expected under
-        overload; callers count them).
+        unadvertised tier is a 400.  ``spatial=True`` demands the
+        multi-chip spatially-sharded path (docs/serving.md "Spatial
+        sharding"; the server advertises it under ``/healthz``
+        ``spatial``), ``False`` forbids it, ``None`` lets the server
+        auto-route pairs above its single-chip ceiling.  Raises
+        ``ServeError`` on any non-200 status (503 = shed / 504 =
+        timeout are expected under overload; callers count them).  A
+        413 carries the server's body cap as ``limit_mb`` in the error
+        payload — an oversized pair needs a server whose
+        ``--spatial_buckets`` cover it (the cap auto-sizes to those
+        buckets), not a retry.
         """
         payload = {"left": encode_array(np.asarray(left, np.float32)),
                    "right": encode_array(np.asarray(right, np.float32))}
@@ -204,6 +220,8 @@ class ServeClient:
             payload["iters"] = int(iters)
         if accuracy is not None:
             payload["accuracy"] = str(accuracy)
+        if spatial is not None:
+            payload["spatial"] = bool(spatial)
         if deadline_ms is not None:
             payload["deadline_ms"] = float(deadline_ms)
         if priority is not None:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import math
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -106,6 +107,25 @@ class BatchEngine:
         # with no tiers never constructs the extra models.
         self._models = {self.default_mode: model}  # guarded_by: _lock
         self._fns: Dict[object, object] = {}  # guarded_by: _lock
+        # Spatial sharding (parallel/spatial.py): the resolved space-axis
+        # shard count — ServeConfig overrides the model config's default;
+        # <= 1 disables the spatial entry points.  Validated eagerly so a
+        # misconfigured server fails at build time, not at the first 4K
+        # request.  The (1, N) mesh itself is built lazily on first use
+        # (guarded_by: _lock) — constructing it pulls device topology,
+        # which replica-lifecycle test stubs (model=None) never have.
+        self.spatial_shards = int(
+            getattr(config, "spatial_shards", 0)
+            or (1 if model is None
+                else getattr(model.config, "spatial_shards", 1)))
+        self._spatial_mesh = None  # guarded_by: _lock
+        if self.spatial_shards > 1:
+            from ..parallel.spatial import validate_spatial_config
+            assert model is not None, "spatial sharding needs a model"
+            assert device is None, (
+                "spatial sharding splits one request across devices and "
+                "cannot run on a device-pinned (cluster replica) engine")
+            validate_spatial_config(model.config)
         # (keyed (iters, mode) | ("stream", iters, mode) | sched phases)
         self._lock = threading.RLock()
         # Fine-grained lock for _compiled only: stat readers (/healthz)
@@ -115,6 +135,11 @@ class BatchEngine:
         # Compiled keys: (h, w, iters, gru_backend, input_mode, mode) for
         # the plain forward and (h, w, iters, "stream", gru_backend,
         # input_mode, mode) for the warm-start (flow_init) forward.
+        # Spatial keys are arity 8: (h, w, iters, "spatial", "sN",
+        # gru_backend, input_mode, mode) — the shard count rides as the
+        # STRING "sN" at position 4 so the mixed-arity key set stays
+        # sortable (ints at 0-2, strings from 3 on; /healthz sorts the
+        # whole set for a stable compiled_buckets listing).
         self._compiled: Set[Tuple] = set()  # guarded_by: _stats_lock
         self.last_batch_runtime: float = float("nan")  # guarded_by: _lock
         self.last_included_compile: bool = True  # guarded_by: _lock
@@ -177,6 +202,47 @@ class BatchEngine:
             return (hw[0], hw[1], iters, "stream", self.gru_backend,
                     self.input_mode, self._mode(mode)) in self._compiled
 
+    # ------------------------------------------------------ spatial sharding
+
+    def _spatial_shard_count(self, shards: Optional[int]) -> int:
+        """Resolve an optional per-call shard count against the engine's
+        fixed mesh.  The count is a CACHE-KEY component (a 2-shard and a
+        4-shard program differ), but one engine owns one mesh — a
+        mismatching request is a caller bug, not a new mesh."""
+        n = self.spatial_shards if shards is None else int(shards)
+        assert n == self.spatial_shards, (
+            f"engine mesh has {self.spatial_shards} spatial shards, "
+            f"request asked for {n}")
+        assert n > 1, "spatial sharding is disabled (spatial_shards <= 1)"
+        return n
+
+    def _spatial_padder(self, shape: Sequence[int]) -> BucketPadder:
+        """Spatial shape policy: same BucketPadder family as the plain
+        path, with the alignment raised so the padded H splits into
+        ``spatial_shards`` equal slabs of whole row-multiples
+        (parallel/spatial.check_spatial_shape)."""
+        from ..parallel.spatial import spatial_row_multiple
+        rows = spatial_row_multiple(self.model.config) * self.spatial_shards
+        divis = math.lcm(self.cfg.divis_by, rows)
+        return BucketPadder(shape, divis_by=divis,
+                            bucket_multiple=math.lcm(
+                                self.cfg.bucket_multiple, divis))
+
+    def spatial_bucket_of(self, shape: Sequence[int]) -> Tuple[int, int]:
+        """The padded (H, W) an image executes at on the spatial path."""
+        return self._spatial_padder(shape).bucket_hw
+
+    def is_spatial_warm(self, hw: Tuple[int, int], iters: int,
+                        mode: Optional[str] = None,
+                        shards: Optional[int] = None) -> bool:
+        """Whether (bucket, iters, mode) has a compiled SPATIAL
+        executable at the engine's shard count."""
+        n = self._spatial_shard_count(shards)
+        with self._stats_lock:
+            return (hw[0], hw[1], iters, "spatial", f"s{n}",
+                    self.gru_backend, self.input_mode,
+                    self._mode(mode)) in self._compiled
+
     def low_hw(self, hw: Tuple[int, int]) -> Tuple[int, int]:
         """The 1/factor grid a padded bucket's disparity field lives on —
         the shape of session state and of every ``flow_init``."""
@@ -238,6 +304,22 @@ class BatchEngine:
         key = ("stream", iters, mode)
         if key not in self._fns:
             self._fns[key] = self._model_for(mode).jitted_infer_init(iters)
+        return self._fns[key]
+
+    def _spatial_fn(self, iters: int, mode: str):  # guarded_by: _lock
+        """Sharded warm-start forward over the (1, N) spatial mesh
+        (parallel/spatial.jitted_spatial_infer_init).  ONE executable per
+        (bucket, iters, mode, shards) serves cold requests AND session
+        warm-start frames: zeros ``flow_init`` is bitwise-identical to
+        the cold forward, the same property the stream path rests on."""
+        key = ("spatial", iters, mode, self.spatial_shards)
+        if key not in self._fns:
+            from ..parallel.spatial import (jitted_spatial_infer_init,
+                                            spatial_mesh)
+            if self._spatial_mesh is None:
+                self._spatial_mesh = spatial_mesh(self.spatial_shards)
+            self._fns[key] = jitted_spatial_infer_init(
+                self._model_for(mode), self._spatial_mesh, iters)
         return self._fns[key]
 
     def _sched_prologue_fn(self, mode: str):  # guarded_by: _lock
@@ -408,7 +490,8 @@ class BatchEngine:
         ``(host_outputs, included_compile)`` — the flag is per-call, not
         read back from shared engine state, so concurrent callers cannot
         race each other's compile accounting."""
-        kind = "stream" if "stream" in key else "batch"
+        kind = ("stream" if "stream" in key
+                else "spatial" if "spatial" in key else "batch")
         # tier = the key's precision-mode component (always last): a
         # compile under traffic must be attributable to the tier whose
         # warmup missed it.
@@ -509,6 +592,79 @@ class BatchEngine:
         return [(padder.unpad(up[i:i + 1])[0, ..., 0],
                  low[i, :, :, 0].copy(), miss)
                 for i, padder in enumerate(padders)]
+
+    def infer_spatial(self, left: np.ndarray, right: np.ndarray,
+                      iters: int, flow_init: Optional[np.ndarray] = None,
+                      mode: Optional[str] = None,
+                      shards: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray, bool]:
+        """ONE pair with image height sharded across the spatial mesh
+        (parallel/spatial.py) — no batch axis: the request owns every
+        chip of the (1, N) mesh for the duration of the dispatch.
+
+        ``flow_init`` follows ``infer_stream_batch``: an optional
+        (H/f, W/f) warm-start at the padded spatial bucket shape, None =
+        cold (zeros — same executable).  Returns ``(disparity, disp_low,
+        included_compile)``: the unpadded (H, W) disparity, the PADDED
+        1/factor field (next-frame warm-start state), and whether this
+        call paid the XLA compile.  The cache key carries the shard
+        count: a 2-shard and a 4-shard program at the same bucket are
+        different executables."""
+        n = self._spatial_shard_count(shards)
+        t_pad0 = time.perf_counter()
+        padder = self._spatial_padder(left.shape)
+        hw = padder.bucket_hw
+        lh, lw = self.low_hw(hw)
+        i1, i2 = padder.pad(jnp.asarray(left, jnp.float32)[None],
+                            jnp.asarray(right, jnp.float32)[None])
+        if flow_init is None:
+            fi = jnp.zeros((1, lh, lw, 1), jnp.float32)
+        else:
+            flow_init = np.asarray(flow_init, np.float32)
+            assert flow_init.shape == (lh, lw), (
+                f"flow_init {flow_init.shape} != low-res spatial bucket "
+                f"shape {(lh, lw)} (bucket {hw})")
+            fi = jnp.asarray(flow_init)[None, :, :, None]
+        self._seg.pad = (t_pad0, time.perf_counter())
+        m = self._mode(mode)
+        key = (hw[0], hw[1], iters, "spatial", f"s{n}", self.gru_backend,
+               self.input_mode, m)
+        (low, up), miss = self._dispatch(
+            key, lambda: self._spatial_fn(iters, m)(self.variables, i1, i2,
+                                                    fi))
+        # .copy() for the same session-state-lifetime reason as
+        # infer_stream_batch (here it only drops the channel axis' view).
+        return (padder.unpad(up)[0, ..., 0], low[0, :, :, 0].copy(), miss)
+
+    def warmup_spatial(self, buckets=None, iters_list=None,
+                       modes: Optional[Sequence[str]] = None) -> List[Tuple]:
+        """Compile the spatial executables for every configured spatial
+        bucket before serving, so a 4K request never pays the (largest
+        possible) XLA compile under traffic.  Returns the (h, w, iters,
+        "spatial", "sN", gru_backend, input_mode, mode) keys warmed."""
+        n = self._spatial_shard_count(None)
+        buckets = list(buckets if buckets is not None
+                       else getattr(self.cfg, "spatial_buckets", ()) or ())
+        iters_list = sorted(iters_list or {self.cfg.iters})
+        modes = list(modes or [self.default_mode])
+        warmed = []
+        for h, w in buckets:
+            bh, bw = self.spatial_bucket_of((h, w, self.input_channels))
+            for iters in iters_list:
+                for mode in modes:
+                    key = (bh, bw, iters, "spatial", f"s{n}",
+                           self.gru_backend, self.input_mode, mode)
+                    if self.is_spatial_warm((bh, bw), iters, mode):
+                        continue
+                    zero = np.zeros((h, w, self.input_channels), np.float32)
+                    t0 = time.perf_counter()
+                    self.infer_spatial(zero, zero, iters, mode=mode)
+                    logger.info("spatial warmup: bucket %dx%d iters=%d "
+                                "mode=%s shards=%d compiled in %.1fs", bh,
+                                bw, iters, mode, n,
+                                time.perf_counter() - t0)
+                    warmed.append(key)
+        return warmed
 
     # ------------------------------------------- iteration-level scheduling
     #

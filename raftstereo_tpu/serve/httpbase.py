@@ -7,6 +7,14 @@ Content-Length check before any body is buffered.  One base class keeps
 the two handlers byte-identical on that dialect — a fix to the body-cap
 or header logic lands in both.
 
+The body cap is a POLICY ARGUMENT, not a constant: every call takes
+``limit_mb`` from the caller's ``ServeConfig.max_body_mb``, which
+auto-raises to fit the largest configured spatial bucket
+(``config.spatial_body_mb`` — a 4K fp32 pair is ~95 MB of base64, far
+over the default cap).  Over-limit requests get an explicit 413 naming
+the limit, never a silent drop: a client sending a bucket-scale pair to
+a server not configured for it must learn which knob to turn.
+
 This module must stay importable without the engine/model stack: the
 router is model-free (see serve/__init__.py's lazy exports).
 """

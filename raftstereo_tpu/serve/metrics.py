@@ -307,6 +307,23 @@ class ServeMetrics:
             "sched_step_latency_seconds",
             "engine wall-clock per scheduler step (every occupied slot "
             "advances iters_per_step iterations), compile-free steps only")
+        # Spatial sharding (parallel/spatial.py, serve/spatial/,
+        # docs/serving.md "Spatial sharding").
+        self.spatial_shards = r.gauge(
+            "spatial_shards",
+            "spatial mesh width the engine was built with (0 = spatial "
+            "sharding disabled)")
+        self.spatial_requests = r.counter(
+            "spatial_requests_total",
+            "requests dispatched on the spatial path by outcome "
+            "(ok/error/shed) — admission 400s never reach the mesh and "
+            "are counted only in serve_requests_total",
+            labels=("outcome",))
+        self.spatial_latency = r.histogram(
+            "spatial_request_latency_seconds",
+            "engine wall-clock per spatial dispatch (pad + sharded "
+            "forward + host fetch); the mesh is exclusive, so this is "
+            "also the mesh-busy time per request")
 
     def render(self) -> str:
         return self.registry.render()

@@ -17,7 +17,11 @@ Endpoints:
   ``deadline_ms`` (deadline-aware early exit: the reply carries the
   anytime result with ``meta.degraded`` true) and ``priority``
   (``high``/``normal``/``low``), and ``iters`` may be any multiple of
-  ``iters_per_step`` up to ``max_iters``.
+  ``iters_per_step`` up to ``max_iters``.  On a spatially-sharded
+  server (``--spatial_shards``, docs/serving.md "Spatial sharding")
+  the body also accepts ``"spatial": true/false`` — pairs above the
+  single-chip ``max_image_dim`` ceiling auto-route spatial when the
+  capability is advertised on ``/healthz``.
 * ``GET /metrics`` — Prometheus text exposition (serve/metrics.py).
 * ``GET /healthz`` — JSON liveness: queue depth, compiled buckets, config.
 * ``GET /debug/trace?last=N`` — recent spans as downloadable Chrome
@@ -64,6 +68,8 @@ from .engine import BatchEngine
 from .httpbase import JsonRequestHandler
 from .metrics import ServeMetrics
 from .sched import IterationScheduler
+from .spatial import (SPATIAL_ENDPOINT, admit_spatial, route_spatial,
+                      capability as spatial_capability)
 
 logger = logging.getLogger(__name__)
 
@@ -192,6 +198,12 @@ class _Handler(JsonRequestHandler):
                     "sessions_active": len(srv.stream.store),
                     "session_limit": srv.config.stream.session_limit,
                 }
+            if getattr(srv.engine, "spatial_shards", 1) > 1:
+                # Capability negotiation (serve/spatial/): a client
+                # reads this block to learn whether — and at which
+                # padded buckets — oversized pairs are served.
+                health["spatial"] = spatial_capability(srv.config,
+                                                      srv.engine)
             self._json(200, health)
         elif url.path == "/metrics":
             self._send(200, srv.metrics.render().encode(),
@@ -364,6 +376,7 @@ class _Handler(JsonRequestHandler):
                 deadline_ms = payload.get("deadline_ms")
                 priority = payload.get("priority")
                 accuracy = payload.get("accuracy")
+                spatial = payload.get("spatial")
             except Exception as e:
                 srv.end_predict()
                 self._finish(400, {"error": f"bad request: {e}"},
@@ -373,16 +386,18 @@ class _Handler(JsonRequestHandler):
         try:
             self._predict_admitted(srv, endpoint, rid, t_req0, left, right,
                                    iters, session_id, seq_no, deadline_ms,
-                                   priority, accuracy)
+                                   priority, accuracy, spatial)
         finally:
             srv.end_predict()
 
     def _predict_admitted(self, srv: "StereoServer", endpoint, rid, t_req0,
                           left, right, iters, session_id, seq_no,
-                          deadline_ms, priority, accuracy=None) -> None:
+                          deadline_ms, priority, accuracy=None,
+                          spatial=None) -> None:
         """Validation + dispatch of one admitted (gate-passed, decoded,
         in-flight-counted) /predict request."""
         mode = None
+        use_spatial = False
         try:
             # Channel count follows the model's input mode (sl/,
             # docs/structured_light.md): 3 for passive RGB, 12 for SL
@@ -396,7 +411,20 @@ class _Handler(JsonRequestHandler):
                     f"expected matching (H, W, {want_c}) pairs for "
                     f"input_mode={srv.engine.input_mode!r}, got "
                     f"{left.shape} / {right.shape}")
-            if max(left.shape[:2]) > srv.config.max_image_dim:
+            # Spatial routing decides BEFORE the single-chip ceiling:
+            # pairs above max_image_dim are exactly what the spatial
+            # path exists for (serve/spatial/admission.py).  admit_
+            # spatial rejects every v1 limitation (tiers, sessions,
+            # scheduler fields, unwarmed buckets) as a clean 400, so
+            # the remaining checks below are inert on this path.
+            use_spatial = route_spatial(spatial, left.shape,
+                                        srv.config, srv.engine)
+            if use_spatial:
+                endpoint = SPATIAL_ENDPOINT
+                _, iters = admit_spatial(
+                    srv.config, srv.engine, iters, accuracy, session_id,
+                    deadline_ms, priority, left.shape)
+            elif max(left.shape[:2]) > srv.config.max_image_dim:
                 raise ValueError(
                     f"image side {max(left.shape[:2])} exceeds "
                     f"max_image_dim {srv.config.max_image_dim}")
@@ -470,7 +498,7 @@ class _Handler(JsonRequestHandler):
                                 f"shape {tuple(left.shape[:2])} -> bucket "
                                 f"{hw} stream levels {missing} not warmed; "
                                 f"configure --buckets and --stream_warmup")
-            if iters is not None:
+            if iters is not None and not use_spatial:
                 iters = int(iters)
                 if srv.scheduler is not None:
                     # Iteration-level scheduling serves ANY target from
@@ -494,11 +522,13 @@ class _Handler(JsonRequestHandler):
                         raise ValueError(
                             f"iters {iters} not served; choose from "
                             f"{sorted(allowed)}")
-            if session_id is None and not srv.config.cold_buckets:
-                # Production setting (plain requests; session frames have
-                # their own executable check above): shapes outside the
-                # warmed buckets are rejected up front — an on-demand
-                # compile would stall every queued request behind it.
+            if session_id is None and not use_spatial \
+                    and not srv.config.cold_buckets:
+                # Production setting (plain requests; session frames and
+                # spatial requests have their own executable checks
+                # above): shapes outside the warmed buckets are rejected
+                # up front — an on-demand compile would stall every
+                # queued request behind it.
                 hw = srv.engine.bucket_of(left.shape)
                 if srv.scheduler is not None:
                     if not srv.engine.is_sched_warm(
@@ -524,6 +554,10 @@ class _Handler(JsonRequestHandler):
         srv.tracer.record("admission", t_req0, time.perf_counter(), rid,
                           attrs={"endpoint": endpoint,
                                  "shape": list(left.shape)})
+        if use_spatial:
+            self._spatial_dispatch(srv, endpoint, rid, t_req0,
+                                   left, right, iters)
+            return
         if session_id is not None:
             # Session frames bypass the micro-batcher: ordering within a
             # session is the point (frame N warm-starts from N-1), so they
@@ -666,6 +700,59 @@ class _Handler(JsonRequestHandler):
             "meta": meta,
         }, endpoint, rid, t_req0)
 
+    def _spatial_dispatch(self, srv: "StereoServer", endpoint, rid, t_req0,
+                          left, right, iters) -> None:
+        """Dispatch one admitted spatial request: straight to
+        ``engine.infer_spatial``, bypassing the batcher AND the
+        iteration scheduler (v1) — the pair owns the whole (1, N) mesh
+        for its dispatch, so there is nothing to batch with and no
+        iteration boundary to join at.  Admission control still
+        applies: handler threads blocked on the engine lock are bounded
+        by queue_limit, the same backpressure contract as the session
+        path (decoded 4K pairs held in unboundedly many blocked threads
+        would grow host RSS exactly like an unbounded queue)."""
+        with srv.spatial_inflight_lock:
+            if srv.spatial_inflight >= srv.config.queue_limit:
+                srv.metrics.shed.inc()
+                srv.metrics.spatial_requests.labels(outcome="shed").inc()
+                self._finish(503, {"error": "overloaded",
+                                   "detail": f"spatial requests in flight "
+                                             f">= queue_limit "
+                                             f"{srv.config.queue_limit}"},
+                             endpoint, rid, t_req0, {"Retry-After": "1"})
+                return
+            srv.spatial_inflight += 1
+        t0 = time.perf_counter()
+        try:
+            disp, _low, compiled = srv.engine.infer_spatial(
+                left, right, iters)
+        except Exception as e:
+            srv.metrics.spatial_requests.labels(outcome="error").inc()
+            self._finish(500, {"error": f"inference failed: {e}"},
+                         endpoint, rid, t_req0)
+            return
+        finally:
+            with srv.spatial_inflight_lock:
+                srv.spatial_inflight -= 1
+        t1 = time.perf_counter()
+        srv.tracer.record("spatial_dispatch", t0, t1, rid,
+                          attrs={"shards": srv.engine.spatial_shards,
+                                 "iters": iters, "compile": compiled})
+        srv.metrics.spatial_requests.labels(outcome="ok").inc()
+        if not compiled:
+            # Compile-free dispatches only, like the stream/sched
+            # latency histograms — a cold_buckets compile would put a
+            # minutes-long sample in a seconds-scale histogram.
+            srv.metrics.spatial_latency.observe(t1 - t0)
+        meta = {"iters": iters, "spatial": srv.engine.spatial_shards,
+                "warm": not compiled,
+                "latency_ms": round((t1 - t0) * 1e3, 3)}
+        # Spatial serves only the base precision (admission rejects
+        # tiers), so the adoption signal lands on the default tier.
+        srv.metrics.tier_requests.labels(tier="default").inc()
+        self._finish(200, {"disparity": encode_array(disp), "meta": meta},
+                     endpoint, rid, t_req0)
+
 
 class StereoServer(ThreadingHTTPServer):
     """HTTP server owning the engine + batcher + metrics + tracer.
@@ -727,6 +814,11 @@ class StereoServer(ThreadingHTTPServer):
         # session/engine locks, shed with 503 beyond queue_limit.
         self.stream_inflight_lock = threading.Lock()
         self.stream_inflight = 0  # guarded_by: stream_inflight_lock
+        # Same contract for the spatial path (which also bypasses the
+        # batcher queue): requests concurrently holding decoded pairs
+        # while waiting on the engine lock, shed beyond queue_limit.
+        self.spatial_inflight_lock = threading.Lock()
+        self.spatial_inflight = 0  # guarded_by: spatial_inflight_lock
         # Caps the number of request bodies being buffered/decoded at
         # once (each transiently costs ~3x its size); excess connections
         # queue on the semaphore instead of multiplying host RSS.
@@ -876,6 +968,11 @@ def build_server(model, variables, config: ServeConfig,
     """
     metrics = metrics or ServeMetrics()
     tracer = tracer or Tracer(capacity=config.trace_buffer)
+    if config.spatial_shards > 1 and config.cluster is not None:
+        raise ValueError(
+            "spatial sharding and cluster replicas are mutually exclusive "
+            "(v1): both partition the device set — run the spatial server "
+            "as its own process behind the router instead")
     # Accuracy tiers: validated against the certification manifest BEFORE
     # anything is advertised or warmed (eval/certify.py) — an uncertified
     # tier is refused with a recorded reason, and its executables are
@@ -949,7 +1046,15 @@ def build_server(model, variables, config: ServeConfig,
                 if config.stream is not None and config.stream_warmup:
                     engine.warmup_stream(ladder=config.stream.ladder,
                                          modes=warm_modes)
+            if engine.spatial_shards > 1 and config.warmup:
+                # Base precision only — admission refuses tiers on the
+                # spatial path, so tier executables would be dead weight
+                # (and the sharded compile is the longest in the system).
+                engine.warmup_spatial()
 
+    metrics.spatial_shards.set(
+        engine.spatial_shards
+        if getattr(engine, "spatial_shards", 1) > 1 else 0)
     server = StereoServer(config, engine, batcher, metrics, stream=stream,
                           tracer=tracer, scheduler=scheduler,
                           cluster=cluster, start_ready=False,

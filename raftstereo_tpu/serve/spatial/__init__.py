@@ -1,0 +1,19 @@
+"""Serving-side surface of spatial sharding (docs/serving.md "Spatial
+sharding").
+
+The numerics live in ``parallel/spatial.py`` (the shard_map forward with
+explicit halo exchange) and the executables in ``serve/engine.py``
+(``infer_spatial`` / ``warmup_spatial``).  This package owns everything
+the HTTP layer needs on top of that: the ``/healthz`` capability block a
+client discovers the path through, and the admission policy that turns
+every unsupported combination into a clean 400 *before* anything could
+compile.  The one rule both halves enforce: a spatial request either
+runs on an already-warmed sharded executable or it is refused — the
+single largest compile in the system never happens under traffic.
+"""
+
+from .admission import (SPATIAL_ENDPOINT, admit_spatial, capability,
+                        route_spatial, spatial_iters_allowed)
+
+__all__ = ["SPATIAL_ENDPOINT", "admit_spatial", "capability",
+           "route_spatial", "spatial_iters_allowed"]

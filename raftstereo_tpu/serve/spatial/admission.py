@@ -1,0 +1,124 @@
+"""Admission + capability negotiation for the spatial path.
+
+A spatial request is one pair that owns the whole (1, N) mesh for its
+dispatch, so the policy here is deliberately narrow (v1):
+
+* **Routing** is explicit-first: ``"spatial": true`` in the body forces
+  the path, ``false`` forbids it, absent means *auto* — a pair whose
+  longest side exceeds ``max_image_dim`` (the single-chip bucket
+  ceiling) routes spatial when the server offers it, and 400s exactly
+  as before when it does not.
+* **No silent combinations.**  Accuracy tiers, streaming sessions and
+  the iteration scheduler's ``deadline_ms``/``priority`` fields are all
+  refused with a 400 naming the v1 limitation — never quietly ignored,
+  never served by an uncertified or uncompiled program.
+* **Never a compile.**  Unless the operator opted into
+  ``cold_buckets``, a spatial request must land on a bucket
+  ``warmup_spatial`` already compiled; anything else is a 400 pointing
+  at ``--spatial_buckets``.  The sharded 4K executable is the most
+  expensive compile in the system — admission exists so it only ever
+  happens at warmup.
+
+Everything raises plain ``ValueError`` (the server's 400 currency);
+``parallel.spatial.SpatialShardingUnsupported`` is a ``ValueError``
+subclass, so config-level refusals surface through the same funnel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+# The /predict endpoint label spatial requests are counted and traced
+# under (serve_requests_total{endpoint=}, the admission/dispatch spans).
+SPATIAL_ENDPOINT = "spatial"
+
+
+def spatial_iters_allowed(config) -> Tuple[int, ...]:
+    """The iteration levels served on the spatial path — exactly the
+    levels ``warmup_spatial`` compiles (v1: the primary level only; the
+    degraded level is a load-shedding device for the batcher, and the
+    spatial path has no queue to shed from)."""
+    return (config.iters,)
+
+
+def route_spatial(explicit, shape: Sequence[int], config, engine) -> bool:
+    """Decide whether an admitted request runs spatially.
+
+    ``explicit`` is the raw ``"spatial"`` body field: ``True`` demands
+    the path (ValueError when the server does not offer it), ``False``
+    forbids it (the plain path's ``max_image_dim`` check then applies
+    unchanged), ``None``/absent auto-routes oversized pairs.
+    """
+    if explicit is not None and not isinstance(explicit, bool):
+        raise ValueError(
+            f"spatial must be a JSON boolean, got {explicit!r}")
+    offered = getattr(engine, "spatial_shards", 1) > 1
+    if explicit is True:
+        if not offered:
+            raise ValueError(
+                "spatial sharding not offered by this server (start with "
+                "--spatial_shards N and --spatial_buckets)")
+        return True
+    if explicit is False:
+        return False
+    return offered and max(shape[0], shape[1]) > config.max_image_dim
+
+
+def admit_spatial(config, engine, iters: Optional[int],
+                  accuracy, session_id, deadline_ms, priority,
+                  shape: Sequence[int]) -> Tuple[Tuple[int, int], int]:
+    """Validate one spatial-routed request; returns the padded
+    ``(bucket_hw, iters)`` it will execute at.  Raises ``ValueError``
+    (-> HTTP 400) on every v1 limitation — see the module docstring."""
+    if accuracy is not None:
+        raise ValueError(
+            "accuracy tiers are not served on the spatial path (v1): the "
+            "sharded program is certified only at the base precision — "
+            "drop the accuracy field or the spatial flag")
+    if session_id is not None:
+        raise ValueError(
+            "streaming sessions are not served on the spatial path (v1): "
+            "session warm-start state lives on the single-chip bucket "
+            "grid — send the frame without session_id")
+    if deadline_ms is not None or priority is not None:
+        raise ValueError(
+            "deadline_ms/priority are scheduler features; the spatial "
+            "path bypasses the iteration scheduler (v1) and runs the "
+            "full iteration count")
+    allowed = spatial_iters_allowed(config)
+    if iters is None:
+        iters = allowed[0]
+    else:
+        iters = int(iters)
+        if iters not in allowed:
+            raise ValueError(
+                f"iters {iters} not served spatially; choose from "
+                f"{sorted(allowed)} (only warmed levels run on the mesh)")
+    hw = engine.spatial_bucket_of(shape)
+    if not config.cold_buckets and not engine.is_spatial_warm(hw, iters):
+        raise ValueError(
+            f"shape {tuple(shape[:2])} -> spatial bucket {hw} not warmed; "
+            f"configure it in --spatial_buckets (the sharded executable "
+            f"is never compiled under traffic)")
+    return hw, iters
+
+
+def capability(config, engine) -> Dict[str, object]:
+    """The ``/healthz`` ``spatial`` block — everything a client needs to
+    decide whether (and at what shapes) this server can take an
+    oversized pair: the shard count, the warmed buckets as PADDED
+    execution shapes, the slab row alignment, the served iteration
+    levels, and the body cap the buckets were sized against."""
+    from ...parallel.spatial import spatial_row_multiple
+
+    rows = (spatial_row_multiple(engine.model.config)
+            if engine.model is not None else 0)
+    return {
+        "shards": engine.spatial_shards,
+        "buckets": sorted(
+            list(engine.spatial_bucket_of((h, w, engine.input_channels)))
+            for h, w in getattr(config, "spatial_buckets", ()) or ()),
+        "row_multiple": rows * engine.spatial_shards,
+        "iters": sorted(spatial_iters_allowed(config)),
+        "max_body_mb": config.max_body_mb,
+    }

@@ -92,3 +92,18 @@ class Engine:
                 if key in self._compiled:   # input_mode missing: RSA401
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_spatial(self, pairs, iters, shards):
+        # Spatial mesh width (parallel/spatial.py): a 2-shard and a
+        # 4-shard program at the same bucket are different executables.
+        h, w = 64, 96
+        key = (h, w, iters, "spatial", "xla", "fp32")
+        return self._dispatch(key, lambda: (pairs, shards))  # RSA401
+
+    def warmup_spatial_buckets(self, buckets, iters_list, shards):
+        for h, w in buckets:
+            for iters in iters_list:
+                key = (h, w, iters, "spatial", "xla", "fp32")
+                if key in self._compiled:   # shards missing: RSA401
+                    continue
+                self._dispatch(key, lambda: None)

@@ -103,3 +103,22 @@ class Engine:
                 if key in self._compiled:
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_spatial(self, pairs, iters, shards):
+        # Spatial mesh width (parallel/spatial.py, serve/engine.py):
+        # the shard count joins the key as a sortable "sN" string
+        # token, transitively through the resolver assignment AND an
+        # f-string — the checker must follow names into FormattedValue.
+        h, w = 64, 96
+        n = shards
+        key = (h, w, iters, "spatial", f"s{n}", "xla", "fp32")
+        return self._dispatch(key, lambda: pairs)
+
+    def warmup_spatial_buckets(self, buckets, iters_list, shards):
+        for h, w in buckets:
+            for iters in iters_list:
+                key = (h, w, iters, "spatial", f"s{shards}", "xla",
+                       "fp32")
+                if key in self._compiled:
+                    continue
+                self._dispatch(key, lambda: None)

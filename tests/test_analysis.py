@@ -97,13 +97,17 @@ class TestCacheKeys:
             ("RSA401", 30), ("RSA401", 35), ("RSA401", 44),
             ("RSA401", 50), ("RSA401", 57), ("RSA401", 62),
             ("RSA401", 71), ("RSA401", 77), ("RSA401", 86),
-            ("RSA401", 92)]
+            ("RSA401", 92), ("RSA401", 101), ("RSA401", 107)]
         assert "precision" in findings[0].message
         assert "mode" in findings[2].message
         # Kernel-backend selectors are key-relevant too: an infer call
         # and a warmup membership test whose keys omit gru_backend.
         assert "gru_backend" in findings[7].message
         assert "gru_backend" in findings[8].message
+        # Spatial mesh width (parallel/spatial.py): an infer call and a
+        # warmup membership test whose keys omit the shard count.
+        assert "shards" in findings[13].message
+        assert "shards" in findings[14].message
         # Accuracy-tier executables (serve/engine.py + ops/quant.py): an
         # infer call dropping the tier and a warmup ladder dropping it.
         assert "accuracy" in findings[9].message

@@ -74,3 +74,16 @@ def test_quant_mode_contract():
     assert math.isfinite(r["int8_max_abs_diff_vs_fp32"])
     # The tiers genuinely diverge numerically from fp32 (quant engaged).
     assert r["int8_max_abs_diff_vs_fp32"] > 0
+
+
+@pytest.mark.slow
+def test_spatial_mode_contract():
+    r = _run(["--spatial", "--quick"])
+    assert r["unit"] == "ms" and r["value"] > 0
+    assert {"shards", "iters", "single_ms", "sharded_ms", "speedup",
+            "max_abs_gap"} <= set(r)
+    assert r["shards"] == 4
+    # The A/B is the subsystem's numeric contract in miniature: the
+    # sharded program is BITWISE-identical to the single-device jit at
+    # fp32, so the gap is exactly zero — not merely small.
+    assert r["max_abs_gap"] == 0.0

@@ -355,3 +355,178 @@ class TestSpatialEvaluatorTrained:
         ref3 = Evaluator(model, variables, iters=3)(i1[0], i2[0])
         got3 = Evaluator(model, variables, iters=3, mesh=mesh)(i1[0], i2[0])
         np.testing.assert_allclose(got3, ref3, atol=5e-3)
+
+
+class TestHaloExchange:
+    """parallel/spatial.halo_exchange (ISSUE 14): the ppermute halo must
+    reproduce the reference conv's zero padding bit-for-bit at every slab
+    boundary.  Slabs are deliberately TINY (h_loc = 2) so a 3x3 conv's
+    receptive field (pad 1) crosses EVERY boundary, and pad 2 pulls the
+    neighbor's entire slab — the hardest geometry the exchange serves."""
+
+    @pytest.mark.parametrize("pad", [1, 2])
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_matches_zero_padded_reference_rows(self, rng, pad, shards):
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from raftstereo_tpu.parallel.spatial import (halo_exchange,
+                                                     spatial_mesh)
+
+        h_loc = 2
+        x = jnp.asarray(rng.standard_normal((1, shards * h_loc, 5, 3)),
+                        jnp.float32)
+        spec = P(None, SPACE_AXIS)
+        f = shard_map(lambda a: halo_exchange(a, pad, shards),
+                      spatial_mesh(shards), in_specs=(spec,),
+                      out_specs=spec, check_rep=False)
+        # Sharded out axis 1 concatenates the extended slabs in order.
+        out = np.asarray(jax.jit(f)(x)).reshape(
+            1, shards, h_loc + 2 * pad, 5, 3)
+        ref = np.pad(np.asarray(x),
+                     ((0, 0), (pad, pad), (0, 0), (0, 0)))
+        for i in range(shards):
+            np.testing.assert_array_equal(
+                out[0, i], ref[0, i * h_loc: i * h_loc + h_loc + 2 * pad],
+                err_msg=f"shard {i} extended slab != global window")
+
+    def test_single_shard_degenerates_to_zero_pad(self, rng):
+        import jax.numpy as jnp
+
+        from raftstereo_tpu.parallel.spatial import halo_exchange
+
+        x = jnp.asarray(rng.standard_normal((1, 6, 4, 2)), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(halo_exchange(x, 2, 1)),
+            np.pad(np.asarray(x), ((0, 0), (2, 2), (0, 0), (0, 0))))
+        assert halo_exchange(x, 0, 1) is x  # pad 0: no-op, no copy
+
+    def test_data_axis_rides_along_on_2x2_mesh(self, rng):
+        """(2, 2) mesh: the exchange addresses only the space axis, so
+        each data-row's halo is exchanged within its own mesh row —
+        batch entries never mix."""
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from raftstereo_tpu.parallel.spatial import halo_exchange
+
+        shards, h_loc, pad = 2, 2, 1
+        x = jnp.asarray(rng.standard_normal((2, shards * h_loc, 5, 3)),
+                        jnp.float32)
+        mesh = make_mesh(data=2, space=2)
+        spec = P(DATA_AXIS, SPACE_AXIS)
+        f = shard_map(lambda a: halo_exchange(a, pad, shards), mesh,
+                      in_specs=(spec,), out_specs=spec, check_rep=False)
+        out = np.asarray(jax.jit(f)(x)).reshape(
+            2, shards, h_loc + 2 * pad, 5, 3)
+        ref = np.pad(np.asarray(x),
+                     ((0, 0), (pad, pad), (0, 0), (0, 0)))
+        for b in range(2):
+            for i in range(shards):
+                np.testing.assert_array_equal(
+                    out[b, i],
+                    ref[b, i * h_loc: i * h_loc + h_loc + 2 * pad])
+
+    def test_conv_over_halo_matches_full_conv_bitwise(self, rng):
+        """The production slab conv (spatial._conv: halo + VALID-in-H,
+        with the small-output replicate fallback) equals the zero-padded
+        full-image conv bit-for-bit on a (1, 4) mesh."""
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from raftstereo_tpu.parallel import spatial as sp
+
+        shards, h, w, cin, cout = 4, 16, 12, 8, 8
+        k = jnp.asarray(rng.standard_normal((3, 3, cin, cout)) * 0.1,
+                        jnp.float32)
+        b = jnp.asarray(rng.standard_normal((cout,)) * 0.1, jnp.float32)
+        x = jnp.asarray(rng.standard_normal((1, h, w, cin)), jnp.float32)
+        p = {"kernel": k, "bias": b}
+
+        ref = jax.jit(lambda a: lax.conv_general_dilated(
+            a, k, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + b)(x)
+        spec = P(None, SPACE_AXIS)
+        f = shard_map(lambda a: sp._conv(p, a, 1, 1, shards),
+                      sp.spatial_mesh(shards), in_specs=(spec,),
+                      out_specs=spec, check_rep=False)
+        np.testing.assert_array_equal(np.asarray(jax.jit(f)(x)),
+                                      np.asarray(ref))
+
+
+class TestSpatialSubprocessDeviceCounts:
+    """Satellite (ISSUE 14): the spatial mesh + halo exchange must hold
+    at a device count other than the suite's fixed 8 — a fresh
+    interpreter at ``--xla_force_host_platform_device_count=4`` builds
+    the real (1, 4) / (2, 2) spatial meshes and checks the halo rows
+    against the zero-padded reference."""
+
+    SCRIPT = textwrap.dedent("""
+        import json
+        import numpy as np
+        from raftstereo_tpu.utils.platform import apply_env_platform
+        assert apply_env_platform("cpu") == "cpu"
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from raftstereo_tpu.parallel import DATA_AXIS, SPACE_AXIS, make_mesh
+        from raftstereo_tpu.parallel.spatial import (halo_exchange,
+                                                     spatial_mesh)
+
+        out = {"device_count": jax.device_count()}
+        m14 = spatial_mesh(4)
+        out["m14"] = [m14.shape[DATA_AXIS], m14.shape[SPACE_AXIS]]
+        m12 = spatial_mesh(2)
+        out["m12"] = [m12.shape[DATA_AXIS], m12.shape[SPACE_AXIS]]
+
+        rng = np.random.default_rng(7)
+
+        def halo_ok(mesh, spec, batch, shards, h_loc, pad):
+            x = jnp.asarray(rng.standard_normal(
+                (batch, shards * h_loc, 5, 3)), jnp.float32)
+            f = shard_map(lambda a: halo_exchange(a, pad, shards), mesh,
+                          in_specs=(spec,), out_specs=spec,
+                          check_rep=False)
+            got = np.asarray(jax.jit(f)(x)).reshape(
+                batch, shards, h_loc + 2 * pad, 5, 3)
+            ref = np.pad(np.asarray(x),
+                         ((0, 0), (pad, pad), (0, 0), (0, 0)))
+            return all(
+                np.array_equal(got[b, i],
+                               ref[b, i * h_loc:
+                                   i * h_loc + h_loc + 2 * pad])
+                for b in range(batch) for i in range(shards))
+
+        out["halo_14_p1"] = halo_ok(m14, P(None, SPACE_AXIS), 1, 4, 2, 1)
+        out["halo_14_p2"] = halo_ok(m14, P(None, SPACE_AXIS), 1, 4, 2, 2)
+        m22 = make_mesh(data=2, space=2)
+        out["m22"] = [m22.shape[DATA_AXIS], m22.shape[SPACE_AXIS]]
+        out["halo_22_p1"] = halo_ok(m22, P(DATA_AXIS, SPACE_AXIS),
+                                    2, 2, 2, 1)
+        print("RESULT " + json.dumps(out))
+    """)
+
+    def test_spatial_meshes_at_four_devices(self):
+        env = os.environ.copy()
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-c", self.SCRIPT], capture_output=True,
+            text=True, env=env, cwd=REPO, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("RESULT ")][-1]
+        out = json.loads(line[len("RESULT "):])
+        assert out["device_count"] == 4
+        assert out["m14"] == [1, 4]
+        assert out["m12"] == [1, 2]
+        assert out["m22"] == [2, 2]
+        assert out["halo_14_p1"] is True
+        assert out["halo_14_p2"] is True
+        assert out["halo_22_p1"] is True
