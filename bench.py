@@ -645,6 +645,120 @@ def bench_cluster(height: int, width: int, iters: int, replicas: int,
     }
 
 
+def bench_slo(height: int, width: int, iters: int, replicas: int,
+              max_batch: int, requests: int, concurrency: int,
+              corr: str, compute_dtype: str, quick: bool):
+    """Trace-driven SLO harness smoke (loadgen/, docs/slo_harness.md):
+    the full gen -> replay -> evaluate -> fit chain in one process.  A
+    seeded bursty trace with session churn, a default+certified tier
+    mix, priorities and deadlines is open-loop replayed over HTTP
+    against a 2-replica scheduler-mode cluster server; the SLO verdict
+    (deadline-hit / shed / error bounds + a validator-clean /metrics
+    scrape) and the fitted capacity model's "N chips serve M users"
+    answer come back in one record.  Refuses a dirty analysis baseline
+    like every other smoke mode."""
+    import threading
+    import time as _time
+
+    from raftstereo_tpu.config import (ClusterConfig, RAFTStereoConfig,
+                                       SchedConfig, ServeConfig,
+                                       StreamConfig)
+    from raftstereo_tpu.loadgen import capacity as lg_capacity
+    from raftstereo_tpu.loadgen import replay as lg_replay
+    from raftstereo_tpu.loadgen import slo as lg_slo
+    from raftstereo_tpu.loadgen import trace as lg_trace
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.serve import build_server
+    from raftstereo_tpu.serve.client import ServeClient
+
+    import jax
+
+    if len(jax.devices()) < replicas:
+        sys.exit(f"bench: --slo needs {replicas} devices, have "
+                 f"{len(jax.devices())} (on CPU set XLA_FLAGS="
+                 f"--xla_force_host_platform_device_count={replicas})")
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        # CPU-feasible model, same shrink as the test suite's tiny configs.
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype, **model_kw)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+    iters = max(iters, 2)
+    serve_cfg = ServeConfig(
+        port=0, buckets=((height, width),), max_batch_size=max_batch,
+        max_wait_ms=5.0, queue_limit=max(4 * max_batch, 32),
+        iters=iters, degraded_iters=iters,
+        degrade_queue_depth=max(4 * max_batch, 32),
+        # Scheduler mode: deadlines + priorities are first-class on
+        # /predict (the trace carries both); session frames ride the
+        # scheduler as high-priority short jobs.
+        sched=SchedConfig(iters_per_step=1, max_iters=max(8, iters)),
+        stream=StreamConfig(ladder=(iters, max(1, iters // 2)),
+                            demote_threshold=0.0, promote_threshold=1e6,
+                            cold_reset_threshold=2e6),
+        # certified = fp32: advertised without a manifest, so the trace
+        # can mix explicit-tier traffic into the smoke.
+        tiers=("certified",),
+        cluster=ClusterConfig(replicas=replicas))
+    server = build_server(model, variables, serve_cfg)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        spec = lg_trace.TraceSpec(
+            seed=0, requests=requests,
+            duration_s=max(2.0, requests / 8.0), shape="burst",
+            resolutions=((height, width),),
+            session_fraction=0.25, sequence_len=3,
+            tier_mix=(("default", 3.0), ("certified", 1.0)),
+            priority_mix=(("normal", 3.0), ("high", 1.0)),
+            # Generous on CPU; the smoke proves the chain, not the bound.
+            deadlines=(("high", 60000.0),),
+            iters_choices=(iters,), iters_fraction=0.3)
+        events = lg_trace.generate(spec)
+        rcfg = lg_replay.ReplayConfig(host=serve_cfg.host, port=server.port,
+                                      concurrency=concurrency)
+        scraper = ServeClient(serve_cfg.host, server.port, timeout=120.0)
+        try:
+            before = scraper.metrics_text()
+            t0 = _time.perf_counter()
+            recorder = lg_replay.replay(events, rcfg)
+            wall_s = _time.perf_counter() - t0
+            after = scraper.metrics_text()
+        finally:
+            scraper.close()
+        rows = recorder.rows()
+        slo_spec = lg_slo.SLOSpec(classes=(
+            lg_slo.SLOClass(max_error_rate=0.0, max_shed_rate=0.0),
+            lg_slo.SLOClass(priority="high", min_deadline_hit_rate=1.0)))
+        verdict = lg_slo.evaluate(slo_spec, rows, wall_s=wall_s,
+                                  metrics_before=before,
+                                  metrics_after=after)
+        capacity = lg_capacity.fit(rows, chips=replicas, wall_s=wall_s)
+        answer = lg_capacity.whatif(capacity, chips=replicas,
+                                    rps_per_user=1.0)
+    finally:
+        server.close()
+        thread.join(10)
+    ok = sum(1 for r in rows if r.outcome == "ok")
+    return {
+        "replicas": replicas,
+        "trace_events": len(events),
+        "slo_pass": verdict["pass"],
+        "checks": verdict["checks"],
+        "groups": verdict["groups"],
+        "metric_deltas": verdict["metrics"]["deltas"],
+        "per_chip_rps": capacity["per_chip_rps"],
+        "utilization": capacity["utilization"],
+        "whatif": answer,
+        "pairs_per_sec": round(ok / max(wall_s, 1e-9), 4),
+        "wall_s": round(wall_s, 3),
+    }
+
+
 def bench_stream(height: int, width: int, frames: int, iters: int,
                  corr: str, compute_dtype: str, quick: bool):
     """Streaming smoke benchmark (mirrors --serve): replay an N-frame
@@ -1130,9 +1244,16 @@ def main() -> None:
                         "reporting pairs/sec and the per-replica "
                         "dispatch split (docs/serving.md \"Cluster\")")
     p.add_argument("--replicas", type=int, default=2,
-                   help="engine replicas for --cluster (needs that many "
-                        "devices; on CPU set XLA_FLAGS="
+                   help="engine replicas for --cluster/--slo (needs that "
+                        "many devices; on CPU set XLA_FLAGS="
                         "--xla_force_host_platform_device_count)")
+    p.add_argument("--slo", action="store_true",
+                   help="run the trace-driven SLO harness end to end "
+                        "(loadgen/, docs/slo_harness.md): seeded burst "
+                        "trace with sessions + tiers + deadlines, "
+                        "open-loop replay against a --replicas cluster "
+                        "server in scheduler mode, SLO verdict + fitted "
+                        "capacity model (--reps = request count)")
     p.add_argument("--stream", action="store_true",
                    help="benchmark the temporal warm-start streaming "
                         "subsystem: N-frame synthetic video sequence, "
@@ -1171,7 +1292,7 @@ def main() -> None:
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
     if args.quick or args.serve or args.stream or args.sched \
             or args.cluster or args.gru or args.quant or args.sl \
-            or args.spatial:
+            or args.spatial or args.slo:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -1193,7 +1314,7 @@ def main() -> None:
     if args.reps is None:
         args.reps = 20
     if args.batch is None and not args.serve and not args.sched \
-            and not args.cluster:
+            and not args.cluster and not args.slo:
         args.batch = 1  # --serve/--sched/--cluster resolve their own
         # default (8; 4 or 2 in --quick)
     # Defaults keyed on the mode, resolved only when the flag was NOT
@@ -1236,7 +1357,8 @@ def main() -> None:
     # through jax.config so `JAX_PLATFORMS=cpu python bench.py` works.
     from raftstereo_tpu.utils import apply_env_platform
 
-    if (args.cluster or args.spatial) and "jax" not in sys.modules \
+    if (args.cluster or args.spatial or args.slo) \
+            and "jax" not in sys.modules \
             and "xla_force_host_platform_device_count" \
             not in os.environ.get("XLA_FLAGS", ""):
         # A CPU host shows one device by default; fan it out so N
@@ -1249,6 +1371,37 @@ def main() -> None:
             + f" --xla_force_host_platform_device_count={n_dev}"
         ).strip()
     apply_env_platform()
+
+    if args.slo:
+        h, w = args.height, args.width
+        batch = args.batch if args.batch is not None else 8
+        requests = args.reps
+        if args.quick:
+            # Tiny model + shape; still crosses trace gen -> open-loop
+            # HTTP replay -> verdict -> capacity fit on 2 warmed
+            # replicas.  An explicitly given flag wins, as ever.  24
+            # requests give every (tier, priority) group members and the
+            # session slots 2 full streams.
+            if not explicit_hw:
+                h, w = 64, 96
+            batch = args.batch if args.batch is not None else 2
+            requests = max(args.reps, 24)
+            if not explicit_iters:
+                args.iters = min(args.iters, 2)
+        summary = bench_slo(h, w, args.iters, args.replicas, batch,
+                            requests, args.serve_concurrency, args.corr,
+                            args.compute_dtype, quick=args.quick)
+        record = {
+            "metric": f"SLO harness pairs/sec @{w}x{h}, {args.replicas} "
+                      f"replicas, burst trace (sessions+tiers+deadlines) "
+                      f"over HTTP",
+            "value": summary["pairs_per_sec"],
+            "unit": "pairs/sec",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
 
     if args.cluster:
         h, w = args.height, args.width

@@ -30,10 +30,12 @@ __all__ = ["run_metrics_lint"]
 # declared there, so that is where a violation is fixed.
 _SERVE_PATH = "raftstereo_tpu/serve/metrics.py"
 _TRAIN_PATH = "raftstereo_tpu/train/telemetry.py"
+_LOADGEN_PATH = "raftstereo_tpu/loadgen/metrics.py"
 
 
 def run_metrics_lint() -> List[Finding]:
     """Instantiate + lint + render-validate the repo's metric bundles."""
+    from ..loadgen.metrics import LoadgenMetrics
     from ..obs import lint_registry, validate_prometheus
     from ..serve.metrics import (ClusterMetrics, MetricsRegistry,
                                  ServeMetrics)
@@ -48,11 +50,16 @@ def run_metrics_lint() -> List[Finding]:
         # so collisions between the two must fail here.
         cluster = ClusterMetrics(registry)
         TrainMetrics(registry)
+        # Harness-side families (loadgen_*/slo_*): a soak rig may mount
+        # them next to a scrape of any other bundle.
+        loadgen = LoadgenMetrics(registry)
     except ValueError as e:  # duplicate registration across bundles
         return [Finding("RSA503", _TRAIN_PATH, 1,
                         f"bundle collision: {e}", "metrics")]
     for msg in lint_registry(registry.entries()):
-        path = _TRAIN_PATH if msg.split(":")[0].startswith("train") \
+        name = msg.split(":")[0]
+        path = _TRAIN_PATH if name.startswith("train") \
+            else _LOADGEN_PATH if name.startswith(("loadgen", "slo")) \
             else _SERVE_PATH
         findings.append(Finding("RSA501", path, 1, msg, "metrics"))
 
@@ -74,6 +81,12 @@ def run_metrics_lint() -> List[Finding]:
     cluster.autoscale_recommendation.set(0)
     cluster.probe_failures.labels(replica="r0").inc()
     cluster.router_latency.observe(0.001)
+    cluster.capacity_headroom.set(0.5)
+    loadgen.requests.labels(outcome="ok", tier="default").inc()
+    loadgen.send_lag.observe(0.001)
+    loadgen.latency.observe(0.01)
+    loadgen.slo_checks.labels(status="pass").inc()
+    loadgen.slo_pass.set(1)
     for msg in validate_prometheus(registry.render()):
         findings.append(Finding("RSA502", _SERVE_PATH, 1, msg, "metrics"))
     return findings

@@ -12,4 +12,10 @@
 * ``python -m raftstereo_tpu.cli.sl``        — structured-light workload:
   dataset stats + offline masked-EPE run (docs/structured_light.md)
 * ``python -m raftstereo_tpu.cli.sl_smoke``  — structured-light data check
+* ``python -m raftstereo_tpu.cli.router``    — model-free cluster front-end
+  over N backend servers (docs/serving.md "Cluster")
+* ``python -m raftstereo_tpu.cli.certify``   — accuracy-tier certification
+  manifest (docs/serving.md "Accuracy tiers")
+* ``python -m raftstereo_tpu.cli.loadgen``   — trace-driven SLO harness:
+  gen / replay / fit / whatif (docs/slo_harness.md)
 """

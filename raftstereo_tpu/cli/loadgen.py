@@ -1,0 +1,236 @@
+"""Trace-driven SLO harness: generate, replay, fit, what-if.
+
+The four verbs of docs/slo_harness.md, end to end against a live
+server or ``cli.router`` cluster:
+
+    # 1. a seeded burst trace with sessions + tiers + deadlines
+    python -m raftstereo_tpu.cli.loadgen gen --out trace.jsonl \
+        --requests 256 --shape burst --session_fraction 0.3 \
+        --tiers default:3 fast:1 --priorities high:1 normal:3 \
+        --deadline high:2000
+
+    # 2. open-loop replay on the trace's schedule; SLO verdict + rows
+    python -m raftstereo_tpu.cli.loadgen replay --trace trace.jsonl \
+        --port 8000 --report slo_report.json --p99_ms 5000
+
+    # 3. fit requests/s/chip from the replay's rows
+    python -m raftstereo_tpu.cli.loadgen fit --report slo_report.json \
+        --chips 2 --out capacity.json
+
+    # 4. "N chips serve M users at SLO"
+    python -m raftstereo_tpu.cli.loadgen whatif --model capacity.json \
+        --chips 8 --rps_per_user 0.2
+
+The fitted model feeds serving directly: ``cli.serve
+--capacity_model capacity.json --target_rps 50`` (or the same flags on
+``cli.router``) turns autoscale advice into a recommended replica
+count and the ``cluster_capacity_headroom`` gauge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import math
+import sys
+
+from .common import setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def _parse_hw(text: str):
+    try:
+        h, w = text.lower().split("x")
+        return int(h), int(w)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"{text!r} is not HxW (e.g. 540x960)")
+
+
+def _parse_weight(text: str):
+    name, _, weight = text.partition(":")
+    try:
+        return name, float(weight or 1.0)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"{text!r} is not NAME[:WEIGHT] (e.g. fast:2)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m raftstereo_tpu.cli.loadgen", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("gen", help="generate a seeded synthetic trace")
+    g.add_argument("--out", required=True, help="trace JSONL path")
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--requests", type=int, default=64)
+    g.add_argument("--duration_s", type=float, default=4.0)
+    g.add_argument("--shape", choices=("poisson", "burst", "diurnal"),
+                   default="burst")
+    g.add_argument("--burst_factor", type=float, default=4.0)
+    g.add_argument("--burst_fraction", type=float, default=0.25)
+    g.add_argument("--resolutions", nargs="+", type=_parse_hw,
+                   default=[(540, 960)], metavar="HxW")
+    g.add_argument("--session_fraction", type=float, default=0.0,
+                   help="fraction of events that are stream frames")
+    g.add_argument("--sequence_len", type=int, default=4,
+                   help="frames per synthetic session")
+    g.add_argument("--tiers", nargs="+", type=_parse_weight,
+                   default=[("default", 1.0)], metavar="TIER[:W]",
+                   help="accuracy-tier mix (default/certified/fast/turbo)")
+    g.add_argument("--priorities", nargs="+", type=_parse_weight,
+                   default=[("normal", 1.0)], metavar="PRIO[:W]")
+    g.add_argument("--deadline", nargs="+", type=_parse_weight,
+                   default=[], metavar="PRIO:MS",
+                   help="deadline_ms attached to events of a priority")
+    g.add_argument("--iters", nargs="+", type=int, default=[],
+                   help="explicit iteration targets to mix in")
+    g.add_argument("--iters_fraction", type=float, default=0.5)
+
+    r = sub.add_parser("replay", help="open-loop replay against a live "
+                                      "server/router; writes the SLO "
+                                      "report")
+    r.add_argument("--trace", required=True)
+    r.add_argument("--host", default="127.0.0.1")
+    r.add_argument("--port", type=int, required=True)
+    r.add_argument("--concurrency", type=int, default=4)
+    r.add_argument("--timeout_s", type=float, default=120.0)
+    r.add_argument("--retries", type=int, default=0)
+    r.add_argument("--pair_seed", type=int, default=0)
+    r.add_argument("--speed", type=float, default=1.0,
+                   help=">1 replays the trace faster than recorded")
+    r.add_argument("--report", default=None,
+                   help="write verdict + per-request rows JSON here")
+    r.add_argument("--p50_ms", type=float, default=math.inf,
+                   help="SLO: p50 latency bound over all requests")
+    r.add_argument("--p99_ms", type=float, default=math.inf)
+    r.add_argument("--max_shed_rate", type=float, default=1.0)
+    r.add_argument("--min_deadline_hit_rate", type=float, default=0.0)
+
+    f = sub.add_parser("fit", help="fit the capacity model from a "
+                                   "replay report")
+    f.add_argument("--report", required=True,
+                   help="replay report JSON (needs its rows)")
+    f.add_argument("--chips", type=int, required=True,
+                   help="chips/replicas the replayed endpoint ran on")
+    f.add_argument("--out", required=True, help="capacity model JSON path")
+
+    w = sub.add_parser("whatif", help="answer 'N chips serve M users' "
+                                      "from a fitted model")
+    w.add_argument("--model", required=True)
+    w.add_argument("--chips", type=int, default=None)
+    w.add_argument("--target_rps", type=float, default=None)
+    w.add_argument("--rps_per_user", type=float, default=1.0)
+    w.add_argument("--headroom", type=float, default=0.1)
+    return p
+
+
+def _cmd_gen(args) -> int:
+    from ..loadgen import trace as T
+
+    spec = T.TraceSpec(
+        seed=args.seed, requests=args.requests,
+        duration_s=args.duration_s, shape=args.shape,
+        burst_factor=args.burst_factor,
+        burst_fraction=args.burst_fraction,
+        resolutions=tuple(tuple(r) for r in args.resolutions),
+        session_fraction=args.session_fraction,
+        sequence_len=args.sequence_len,
+        tier_mix=tuple(args.tiers),
+        priority_mix=tuple(args.priorities),
+        deadlines=tuple(args.deadline),
+        iters_choices=tuple(args.iters),
+        iters_fraction=args.iters_fraction)
+    events = T.generate(spec)
+    T.write_trace(args.out, events, header=spec.header())
+    print(json.dumps({"trace": args.out, "events": len(events),
+                      "seed": spec.seed, "shape": spec.shape,
+                      "duration_s": spec.duration_s}), flush=True)
+    return 0
+
+
+def _cmd_replay(args) -> int:
+    import time
+
+    from ..loadgen import replay as R
+    from ..loadgen import slo as S
+    from ..loadgen import trace as T
+    from ..serve.client import ServeClient
+
+    header, events = T.read_trace(args.trace)
+    cfg = R.ReplayConfig(host=args.host, port=args.port,
+                         concurrency=args.concurrency,
+                         timeout_s=args.timeout_s, retries=args.retries,
+                         pair_seed=args.pair_seed, speed=args.speed)
+    scraper = ServeClient(args.host, args.port, timeout=args.timeout_s)
+    try:
+        before = scraper.metrics_text()
+        t0 = time.perf_counter()
+        recorder = R.replay(events, cfg)
+        wall_s = time.perf_counter() - t0
+        after = scraper.metrics_text()
+    finally:
+        scraper.close()
+    spec = S.SLOSpec(classes=(S.SLOClass(
+        p50_ms=args.p50_ms, p99_ms=args.p99_ms,
+        max_shed_rate=args.max_shed_rate,
+        min_deadline_hit_rate=args.min_deadline_hit_rate),))
+    rows = recorder.rows()
+    verdict = S.evaluate(spec, rows, wall_s=wall_s,
+                         metrics_before=before, metrics_after=after)
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump({"trace": header, "verdict": verdict,
+                       "rows": [dataclasses.asdict(r) for r in rows]},
+                      f, indent=2, sort_keys=True)
+            f.write("\n")
+    out = {k: verdict[k] for k in
+           ("pass", "requests", "wall_s", "groups")}
+    out["report"] = args.report
+    print(json.dumps(out), flush=True)
+    return 0 if verdict["pass"] else 1
+
+
+def _cmd_fit(args) -> int:
+    from ..loadgen import capacity as C
+    from ..loadgen.records import RequestRow
+
+    with open(args.report) as f:
+        report = json.load(f)
+    rows = [RequestRow(**d) for d in report["rows"]]
+    model = C.fit(rows, chips=args.chips,
+                  wall_s=report["verdict"]["wall_s"])
+    C.save_model(model, args.out)
+    print(json.dumps({"model": args.out,
+                      "per_chip_rps": model["per_chip_rps"],
+                      "utilization": model["utilization"],
+                      "buckets": len(model["buckets"])}), flush=True)
+    return 0
+
+
+def _cmd_whatif(args) -> int:
+    from ..loadgen import capacity as C
+
+    model = C.load_model(args.model)
+    answer = C.whatif(model, chips=args.chips,
+                      target_rps=args.target_rps,
+                      rps_per_user=args.rps_per_user,
+                      headroom=args.headroom)
+    print(json.dumps(answer), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    return {"gen": _cmd_gen, "replay": _cmd_replay,
+            "fit": _cmd_fit, "whatif": _cmd_whatif}[args.verb](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

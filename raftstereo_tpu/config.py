@@ -338,11 +338,19 @@ class ClusterConfig:
     # Warm replicas concurrently (one thread each; every engine owns its
     # compile cache and lock, so warmups never contend).
     warmup_parallel: bool = True
+    # Optional fitted capacity model (JSON from ``cli.loadgen fit``,
+    # docs/slo_harness.md) + the planned aggregate request rate: with
+    # both, the dispatcher's autoscaler advice carries a model-based
+    # recommended replica count and the ``cluster_capacity_headroom``
+    # gauge reports headroom against target_rps.
+    capacity_model: Optional[str] = None
+    target_rps: float = 0.0
 
     def __post_init__(self):
         assert self.replicas is None or self.replicas >= 1, self.replicas
         assert self.session_pin_limit >= 1, self.session_pin_limit
         assert self.fail_threshold >= 1, self.fail_threshold
+        assert self.target_rps >= 0, self.target_rps
 
 
 @dataclasses.dataclass(frozen=True)
@@ -386,6 +394,12 @@ class RouterConfig:
     # contract as ClusterConfig.session_pin_limit: an evicted session's
     # next frame re-pins and runs cold).
     session_pin_limit: int = 4096
+    # Optional fitted capacity model + planned aggregate rate (same
+    # contract as ClusterConfig.capacity_model/target_rps; the router
+    # loads the JSON via the stdlib ops/autoscale.load_capacity_model,
+    # staying model-free).
+    capacity_model: Optional[str] = None
+    target_rps: float = 0.0
 
     def __post_init__(self):
         if isinstance(self.backends, list):
@@ -400,6 +414,7 @@ class RouterConfig:
         assert self.max_body_mb > 0, self.max_body_mb
         assert self.trace_buffer >= 1, self.trace_buffer
         assert self.session_pin_limit >= 1, self.session_pin_limit
+        assert self.target_rps >= 0, self.target_rps
 
 
 @dataclasses.dataclass(frozen=True)
@@ -695,6 +710,13 @@ def add_cluster_args(parser: argparse.ArgumentParser) -> None:
                    default=d.fail_threshold,
                    help="consecutive engine failures after which a "
                         "replica stops receiving new work")
+    g.add_argument("--capacity_model", default=None,
+                   help="fitted capacity-model JSON (cli.loadgen fit, "
+                        "docs/slo_harness.md) for model-based autoscale "
+                        "advice + the cluster_capacity_headroom gauge")
+    g.add_argument("--target_rps", type=float, default=d.target_rps,
+                   help="planned aggregate request rate the capacity "
+                        "model sizes the fleet for")
 
 
 def cluster_config_from_args(args: argparse.Namespace
@@ -705,6 +727,8 @@ def cluster_config_from_args(args: argparse.Namespace
         replicas=None if args.replicas < 0 else args.replicas,
         session_pin_limit=args.session_pin_limit,
         fail_threshold=args.replica_fail_threshold,
+        capacity_model=args.capacity_model,
+        target_rps=args.target_rps,
     )
 
 
@@ -752,6 +776,13 @@ def add_router_args(parser: argparse.ArgumentParser) -> None:
                    help="bound on the session -> backend pin table (LRU "
                         "beyond it; an evicted session's next frame "
                         "re-pins and runs cold)")
+    g.add_argument("--capacity_model", default=None,
+                   help="fitted capacity-model JSON (cli.loadgen fit, "
+                        "docs/slo_harness.md) for model-based autoscale "
+                        "advice + the cluster_capacity_headroom gauge")
+    g.add_argument("--target_rps", type=float, default=d.target_rps,
+                   help="planned aggregate request rate the capacity "
+                        "model sizes the backend fleet for")
 
 
 def router_config_from_args(args: argparse.Namespace) -> RouterConfig:
@@ -768,6 +799,8 @@ def router_config_from_args(args: argparse.Namespace) -> RouterConfig:
         max_body_mb=args.max_body_mb,
         trace_buffer=args.trace_buffer,
         session_pin_limit=args.session_pin_limit,
+        capacity_model=args.capacity_model,
+        target_rps=args.target_rps,
     )
 
 

@@ -1,0 +1,181 @@
+"""Capacity model: requests/s/chip as f(tier, iters, resolution).
+
+Fit from replayed rows, saved as versioned JSON, consumed by
+``ops/autoscale.Autoscaler`` and the ``cli.loadgen whatif`` verb — the
+bridge from "pairs/s on this box" to "N chips serve M users at SLO".
+
+The fit is THROUGHPUT ACCOUNTING, not queueing theory: client-observed
+latency mass allocates the measured busy chip-seconds across
+(tier, iters, resolution) buckets, giving a per-bucket service-time
+estimate ``service_s`` (chip-seconds per request) and its reciprocal
+``rps_per_chip``.  Utilisation is estimated from the same rows
+(Little's law: mean concurrency-in-service over the wall), so a fit
+taken at saturation — the only regime where "sustainable rate" is even
+observable — predicts the observed rate by construction, and what-ifs
+interpolate between buckets by traffic mix:
+
+    sustainable_rps(model, chips=N, mix={bucket: weight})
+        = N / Σ mix_b · service_s_b
+
+Deliberately stdlib-only: the saved JSON feeds the model-free router's
+autoscaler, and the maths is a few sums.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional, Sequence
+
+from .records import RequestRow
+
+__all__ = ["chips_for", "fit", "load_model", "save_model",
+           "sustainable_rps", "users_served", "whatif"]
+
+CAPACITY_FORMAT = "raftstereo_tpu.loadgen.capacity"
+CAPACITY_VERSION = 1
+
+
+def fit(rows: Sequence[RequestRow], *, chips: int, wall_s: float) -> Dict:
+    """Fit the model from one replay's rows against ``chips`` chips.
+
+    Only ok rows carry service information; shed/timeout/error rows are
+    counted but allocate no chip time.  ``utilization`` is
+    Σ latency / (wall × chips) clamped to 1 — at saturation the clamp
+    makes the accounting exact; below saturation latency ≈ service time
+    and the estimate is simply conservative (queue wait inflates it).
+    """
+    assert chips >= 1, chips
+    assert wall_s > 0, wall_s
+    ok = [r for r in rows if r.outcome == "ok"
+          and not math.isnan(r.latency_ms)]
+    n_ok = len(ok)
+    lat_mass_s = sum(r.latency_ms for r in ok) / 1e3
+    utilization = min(1.0, lat_mass_s / (wall_s * chips)) if n_ok else 0.0
+    busy_chip_s = wall_s * chips * utilization
+    per_chip_rps = (n_ok / busy_chip_s) if busy_chip_s > 0 else 0.0
+
+    buckets: Dict[str, Dict] = {}
+    for r in ok:
+        b = buckets.setdefault(r.bucket(), {"count": 0, "lat_s": 0.0})
+        b["count"] += 1
+        b["lat_s"] += r.latency_ms / 1e3
+    out_buckets: Dict[str, Dict] = {}
+    for key, b in sorted(buckets.items()):
+        # Allocate busy chip-seconds proportional to latency mass: a
+        # bucket whose requests spend 2x longer in the system gets 2x
+        # the service-time estimate, independent of queue-wait skew
+        # between buckets at similar depth.
+        share = (b["lat_s"] / lat_mass_s) if lat_mass_s > 0 else 0.0
+        service_s = (share * busy_chip_s / b["count"]) if b["count"] \
+            else math.inf
+        out_buckets[key] = {
+            "count": b["count"],
+            "mean_latency_ms": round(b["lat_s"] / b["count"] * 1e3, 3),
+            "service_s": round(service_s, 6),
+            "rps_per_chip": (round(1.0 / service_s, 4)
+                             if service_s > 0 else 0.0),
+        }
+    return {
+        "capacity_model": CAPACITY_FORMAT,
+        "version": CAPACITY_VERSION,
+        "chips": chips,
+        "wall_s": round(wall_s, 3),
+        "requests": len(rows),
+        "ok": n_ok,
+        "utilization": round(utilization, 4),
+        "per_chip_rps": round(per_chip_rps, 4),
+        "buckets": out_buckets,
+    }
+
+
+def _mix(model: Dict, mix: Optional[Dict[str, float]]) -> Dict[str, float]:
+    """Normalised traffic mix; default = the fit's observed mix."""
+    buckets = model["buckets"]
+    if mix is None:
+        mix = {k: float(b["count"]) for k, b in buckets.items()}
+    unknown = sorted(set(mix) - set(buckets))
+    if unknown:
+        raise ValueError(f"mix buckets not in model: {unknown} "
+                         f"(known: {sorted(buckets)})")
+    total = sum(mix.values())
+    if total <= 0:
+        raise ValueError("traffic mix has no mass")
+    return {k: v / total for k, v in mix.items() if v > 0}
+
+
+def sustainable_rps(model: Dict, *, chips: Optional[int] = None,
+                    mix: Optional[Dict[str, float]] = None) -> float:
+    """Aggregate requests/s ``chips`` can sustain for a traffic mix."""
+    chips = model["chips"] if chips is None else chips
+    weights = _mix(model, mix)
+    mean_service = sum(w * model["buckets"][k]["service_s"]
+                       for k, w in weights.items())
+    return chips / mean_service if mean_service > 0 else 0.0
+
+
+def chips_for(model: Dict, target_rps: float, *,
+              mix: Optional[Dict[str, float]] = None,
+              headroom: float = 0.0) -> int:
+    """Minimum chips for ``target_rps`` with ``headroom`` (0.2 = plan
+    at 80% of fitted capacity)."""
+    assert 0.0 <= headroom < 1.0, headroom
+    if target_rps <= 0:
+        return 0
+    per_chip = sustainable_rps(model, chips=1, mix=mix) * (1.0 - headroom)
+    if per_chip <= 0:
+        raise ValueError("model has zero per-chip capacity")
+    return max(1, math.ceil(target_rps / per_chip))
+
+
+def users_served(model: Dict, *, chips: Optional[int] = None,
+                 rps_per_user: float = 1.0,
+                 mix: Optional[Dict[str, float]] = None,
+                 headroom: float = 0.0) -> int:
+    """The headline number: M users at ``rps_per_user`` each."""
+    assert rps_per_user > 0, rps_per_user
+    rate = sustainable_rps(model, chips=chips, mix=mix) * (1.0 - headroom)
+    return int(rate / rps_per_user)
+
+
+def whatif(model: Dict, *, chips: Optional[int] = None,
+           target_rps: Optional[float] = None,
+           rps_per_user: float = 1.0, headroom: float = 0.1,
+           mix: Optional[Dict[str, float]] = None) -> Dict:
+    """One JSON answer for the cli verb: capacity at N chips and/or
+    chips needed for a target rate."""
+    out: Dict = {"model_chips": model["chips"],
+                 "per_chip_rps": model["per_chip_rps"],
+                 "headroom": headroom}
+    n = model["chips"] if chips is None else chips
+    rate = sustainable_rps(model, chips=n, mix=mix)
+    out["chips"] = n
+    out["sustainable_rps"] = round(rate, 4)
+    out["planned_rps"] = round(rate * (1.0 - headroom), 4)
+    out["users_served"] = users_served(model, chips=n,
+                                       rps_per_user=rps_per_user,
+                                       mix=mix, headroom=headroom)
+    out["rps_per_user"] = rps_per_user
+    if target_rps is not None:
+        out["target_rps"] = target_rps
+        out["chips_for_target"] = chips_for(model, target_rps, mix=mix,
+                                            headroom=headroom)
+    return out
+
+
+def save_model(model: Dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(model, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_model(path: str) -> Dict:
+    with open(path) as f:
+        model = json.load(f)
+    if model.get("capacity_model") != CAPACITY_FORMAT:
+        raise ValueError(f"{path}: not a {CAPACITY_FORMAT} file")
+    if model.get("version") != CAPACITY_VERSION:
+        raise ValueError(f"{path}: capacity model version "
+                         f"{model.get('version')} != supported "
+                         f"{CAPACITY_VERSION}")
+    return model

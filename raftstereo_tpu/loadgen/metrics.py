@@ -1,0 +1,72 @@
+"""Harness-side metrics: what the LOAD GENERATOR itself observed.
+
+The server has its own story under ``/metrics``; this bundle is the
+client-side counterpart so a long-running replay (cli.loadgen, soak
+rigs) can expose its offered load and verdict history through the same
+registry/exposition machinery — and so the ``loadgen_*``/``slo_*``
+naming is enforced by the RSA50x metric lint like every other family
+(analysis/metrics_lint.py instantiates + renders this bundle).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ..serve.metrics import MetricsRegistry
+from .records import RequestRow
+
+__all__ = ["LoadgenMetrics"]
+
+
+class LoadgenMetrics:
+    """Every instrument the replay harness records, in one bundle."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self.requests = r.counter(
+            "loadgen_requests_total",
+            "replayed requests by client-observed outcome "
+            "(ok/shed/timeout/error) and accuracy tier "
+            "('default' = no tier requested)",
+            labels=("outcome", "tier"))
+        self.late_sends = r.counter(
+            "loadgen_late_sends_total",
+            "sends that left after their trace-scheduled time (the lag "
+            "is recorded, the send is never rescheduled — "
+            "docs/slo_harness.md)")
+        self.send_lag = r.histogram(
+            "loadgen_send_lag_seconds",
+            "scheduled-vs-actual send lag for late sends (0 "
+            "observations = the replay held the trace's schedule)")
+        self.latency = r.histogram(
+            "loadgen_request_latency_seconds",
+            "client-observed send-to-reply latency per ok request "
+            "(includes network + router hop, unlike the server's own "
+            "serve_request_latency_seconds)")
+        self.slo_checks = r.counter(
+            "slo_checks_total",
+            "individual SLO checks evaluated, by status (pass/fail)",
+            labels=("status",))
+        self.slo_pass = r.gauge(
+            "slo_pass",
+            "1 when the most recent SLO verdict passed every check, "
+            "else 0")
+
+    def observe_rows(self, rows: Sequence[RequestRow]) -> None:
+        for row in rows:
+            self.requests.labels(outcome=row.outcome, tier=row.tier).inc()
+            if row.send_lag_ms > 0.0:
+                self.late_sends.inc()
+                self.send_lag.observe(row.send_lag_ms / 1e3)
+            if row.outcome == "ok":
+                self.latency.observe(row.latency_ms / 1e3)
+
+    def observe_verdict(self, verdict: Dict) -> None:
+        for c in verdict.get("checks", ()):
+            status = "pass" if c.get("pass") else "fail"
+            self.slo_checks.labels(status=status).inc()
+        self.slo_pass.set(1.0 if verdict.get("pass") else 0.0)
+
+    def render(self) -> str:
+        return self.registry.render()

@@ -1,0 +1,229 @@
+"""Open-loop trace replay against a live server or router.
+
+Drives a real ``/predict`` endpoint (single server or the model-free
+``cli.router`` front-end — the client is the same) through
+``ServeClient`` on the TRACE'S schedule, not the server's: each event
+fires at its ``t_ms`` offset from replay start regardless of earlier
+completions.  When the workers fall behind, the send still happens
+immediately and the lag is RECORDED (``send_lag_ms`` on the row, late
+count in the summary) — never silently rescheduled, because a harness
+that quietly reshapes its offered load can't certify an SLO.
+
+Session frames are the one ordering constraint: a stream's frames must
+reach the server in seq_no order (out-of-order = documented cold
+frame), so a worker holding frame k of a session blocks until frame
+k-1's worker has finished sending.  Claims are handed out in event
+order, so the wait chain always bottoms out at a frame that is actively
+being sent — no deadlock (see ``_SessionGate``).
+
+The pair content for event i is deterministic in (pair_seed, height,
+width, index): replaying the same trace twice offers bitwise-identical
+request bodies, which is what makes the double-replay determinism
+assertion in tests/test_loadgen.py meaningful.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..serve.client import ServeClient, ServeError
+from .records import Recorder, RequestRow
+from .trace import TraceEvent
+
+__all__ = ["ReplayConfig", "pair_provider", "replay"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplayConfig:
+    """How to drive the endpoint (the WHAT lives in the trace)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8080
+    concurrency: int = 4
+    timeout_s: float = 120.0
+    retries: int = 0
+    pair_seed: int = 0
+    pool_size: int = 4      # distinct pairs per resolution
+    speed: float = 1.0      # >1 replays the trace faster than recorded
+    # Upper bound on waiting for a session predecessor before the frame
+    # is recorded as an error (a crashed predecessor worker must not
+    # hang the replay).
+    gate_timeout_s: float = 300.0
+
+
+def pair_provider(seed: int, pool_size: int = 4
+                  ) -> Callable[[TraceEvent], Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic ``make_pair(event)``: a lazily-built pool of
+    ``pool_size`` pairs per resolution, seeded by (seed, h, w) only —
+    event i draws pool entry ``i % pool_size``, so the i-th request's
+    bytes are a pure function of the trace and the seed."""
+    pools: Dict[Tuple[int, int], List] = {}
+    lock = threading.Lock()
+
+    def make_pair(ev: TraceEvent) -> Tuple[np.ndarray, np.ndarray]:
+        key = (ev.height, ev.width)
+        with lock:
+            pool = pools.get(key)
+            if pool is None:
+                rng = np.random.default_rng((seed, ev.height, ev.width))
+                pool = pools[key] = [
+                    (rng.integers(0, 255, (*key, 3)).astype(np.float32),
+                     rng.integers(0, 255, (*key, 3)).astype(np.float32))
+                    for _ in range(max(1, pool_size))]
+        return pool[ev.index % len(pool)]
+
+    return make_pair
+
+
+class _SessionGate:
+    """Per-session frame ordering: ``wait(session, k)`` blocks until
+    k frames of that session have been RELEASED (sent or failed).
+
+    Safety: claims are issued in event-index order and a session's
+    frames are index-ordered in the trace, so frame k-1 is always
+    claimed before frame k — the blocked worker's predecessor is either
+    mid-send (progress) or waiting on ITS predecessor, and the chain
+    terminates at seq 0, which never waits.  A failed send still
+    releases (the successor then becomes a genuine out_of_order cold
+    frame at the server — the harness observes it, it doesn't hide it).
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._done: Dict[str, int] = {}  # guarded_by: _cond
+
+    def wait(self, session: str, k: int, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._done.get(session, 0) < k:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    def release(self, session: str) -> None:
+        with self._cond:
+            self._done[session] = self._done.get(session, 0) + 1
+            self._cond.notify_all()
+
+
+def replay(events: Sequence[TraceEvent], cfg: ReplayConfig,
+           make_pair: Optional[Callable] = None,
+           on_result: Optional[Callable] = None) -> Recorder:
+    """Replay ``events`` against ``cfg.host:cfg.port``; returns the
+    recorder holding one ``RequestRow`` per event.
+
+    ``on_result(event, disparity, meta)`` runs (serialised under a
+    lock) for every 200 reply — the hook the determinism test uses to
+    capture disparities without the replay path knowing about it.
+    """
+    events = sorted(events, key=lambda e: (e.t_ms, e.index))
+    make_pair = make_pair or pair_provider(cfg.pair_seed, cfg.pool_size)
+    recorder = Recorder()
+    gate = _SessionGate()
+    result_lock = threading.Lock()
+    claim_lock = threading.Lock()
+    next_slot = [0]
+    # Per-session ordinal of each frame (position within the session's
+    # frame list, which seq_no need not equal if a trace hand-skips).
+    ordinal: Dict[int, int] = {}
+    seen: Dict[str, int] = {}
+    for ev in events:
+        if ev.session is not None:
+            ordinal[ev.index] = seen.get(ev.session, 0)
+            seen[ev.session] = ordinal[ev.index] + 1
+
+    t_start = time.perf_counter()
+
+    def claim() -> Optional[TraceEvent]:
+        with claim_lock:
+            slot = next_slot[0]
+            if slot >= len(events):
+                return None
+            next_slot[0] += 1
+            return events[slot]
+
+    def run_one(client: ServeClient, ev: TraceEvent) -> None:
+        sched_ms = ev.t_ms / cfg.speed
+        gated = True
+        if ev.session is not None and ordinal[ev.index] > 0:
+            gated = gate.wait(ev.session, ordinal[ev.index],
+                              cfg.gate_timeout_s)
+        delay = t_start + sched_ms / 1e3 - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        send_ms = (time.perf_counter() - t_start) * 1e3
+        lag_ms = max(0.0, send_ms - sched_ms)
+        row = dict(index=ev.index, t_sched_ms=sched_ms, t_send_ms=send_ms,
+                   send_lag_ms=lag_ms,
+                   tier=ev.tier or "default", priority=ev.priority or "",
+                   deadline_ms=ev.deadline_ms, iters=ev.iters,
+                   height=ev.height, width=ev.width,
+                   session=ev.session or "", seq_no=ev.seq_no)
+        if not gated:
+            recorder.add(RequestRow(outcome="error", latency_ms=math.nan,
+                                    **row))
+            return
+        left, right = make_pair(ev)
+        t0 = time.perf_counter()
+        try:
+            disparity, meta = client.predict(
+                left, right, iters=ev.iters, session_id=ev.session,
+                seq_no=ev.seq_no, deadline_ms=ev.deadline_ms,
+                priority=ev.priority, accuracy=ev.tier,
+                spatial=ev.spatial)
+        except ServeError as e:
+            outcome = {503: "shed", 504: "timeout"}.get(e.status, "error")
+            recorder.add(RequestRow(
+                outcome=outcome, latency_ms=(time.perf_counter() - t0) * 1e3,
+                status=e.status, request_id=e.request_id or "", **row))
+        except Exception:
+            recorder.add(RequestRow(outcome="error", latency_ms=math.nan,
+                                    **row))
+        else:
+            latency_ms = (time.perf_counter() - t0) * 1e3
+            hit = None
+            if ev.deadline_ms is not None:
+                hit = latency_ms <= ev.deadline_ms
+            recorder.add(RequestRow(
+                outcome="ok", latency_ms=latency_ms, status=200,
+                deadline_hit=hit, iters_done=meta.get("iters"),
+                warm=meta.get("warm"),
+                degraded=bool(meta.get("degraded", False)),
+                backend=meta.get("backend", ""),
+                request_id=meta.get("request_id") or "", **row))
+            if on_result is not None:
+                with result_lock:
+                    on_result(ev, disparity, meta)
+
+    def worker():
+        client = ServeClient(cfg.host, cfg.port, timeout=cfg.timeout_s,
+                             retries=cfg.retries)
+        try:
+            while True:
+                ev = claim()
+                if ev is None:
+                    return
+                try:
+                    run_one(client, ev)
+                finally:
+                    if ev.session is not None:
+                        gate.release(ev.session)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"replay-{i}")
+               for i in range(cfg.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return recorder

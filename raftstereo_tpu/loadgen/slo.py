@@ -1,0 +1,230 @@
+"""SLO spec + machine-readable assertion report over replayed rows.
+
+An ``SLOSpec`` is a list of ``SLOClass`` bounds, each scoped to a
+(tier, priority) selector (``"*"`` matches anything).  ``evaluate``
+partitions the recorder's rows into the spec's classes and emits one
+JSON-able verdict:
+
+    {"slo_report": "raftstereo_tpu.loadgen", "version": 1,
+     "pass": true,
+     "checks": [{"cls": "tier=*,priority=high", "metric": "p99_ms",
+                 "value": 812.4, "bound": 2000.0, "pass": true}, ...],
+     "groups": {"default|high": {"count": 9, "ok": 9, "p50_ms": ...}},
+     "metrics": {"validator_errors": [], "deltas": {...}},
+     "retraces": 0}
+
+Every check is (value, bound, pass) — the verdict is self-auditing, no
+re-running needed to see WHY it failed.  ``/metrics`` scrapes taken
+around the replay feed two further gates: the after-scrape must pass
+the exposition validator (a harness certifying SLOs off a malformed
+scrape would certify garbage) and selected counter deltas are reported
+so shed/cold-frame rates cross-check the client-observed rows.
+Zero-compile steady state is asserted OUTSIDE this module by running
+the replay under ``analysis.retrace_guard`` and passing the observed
+count in as ``retraces``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..obs.prom import parse_text
+from .records import RequestRow, percentile
+
+__all__ = ["SLOClass", "SLOSpec", "evaluate"]
+
+SLO_FORMAT = "raftstereo_tpu.loadgen"
+SLO_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """Bounds for one (tier, priority) slice; ``inf``/0-rate defaults
+    make every bound opt-in."""
+
+    tier: str = "*"
+    priority: str = "*"
+    p50_ms: float = math.inf
+    p99_ms: float = math.inf
+    max_shed_rate: float = 1.0
+    max_error_rate: float = 1.0
+    min_deadline_hit_rate: float = 0.0
+    max_cold_frame_rate: float = 1.0   # over frames past each stream's first
+
+    def selector(self) -> str:
+        return f"tier={self.tier},priority={self.priority}"
+
+    def matches(self, row: RequestRow) -> bool:
+        if self.tier != "*" and row.tier != self.tier:
+            return False
+        if self.priority != "*" and (row.priority or "normal") \
+                != self.priority:
+            return False
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """The whole contract: per-class bounds + global gates."""
+
+    classes: Tuple[SLOClass, ...] = (SLOClass(),)
+    max_retraces: int = 0              # warm steady state compiles nothing
+    require_clean_metrics: bool = True
+    max_late_send_rate: float = 1.0    # harness health, not server SLO
+
+
+def _group_stats(rows: Sequence[RequestRow]) -> Dict:
+    ok = [r for r in rows if r.outcome == "ok"]
+    lats = [r.latency_ms for r in ok if not math.isnan(r.latency_ms)]
+    deadlined = [r for r in rows if r.deadline_ms is not None]
+    frames = [r for r in rows if r.session and (r.seq_no or 0) > 0]
+    stats = {
+        "count": len(rows),
+        "ok": len(ok),
+        "shed": sum(1 for r in rows if r.outcome == "shed"),
+        "timeout": sum(1 for r in rows if r.outcome == "timeout"),
+        "error": sum(1 for r in rows if r.outcome == "error"),
+        "degraded": sum(1 for r in ok if r.degraded),
+    }
+    if lats:
+        stats["p50_ms"] = round(percentile(lats, 50), 2)
+        stats["p99_ms"] = round(percentile(lats, 99), 2)
+    if deadlined:
+        hits = sum(1 for r in deadlined if r.deadline_hit)
+        stats["deadline_hit_rate"] = round(hits / len(deadlined), 4)
+    if frames:
+        # First frame of a stream is cold by definition; the SLO is
+        # about warmth HOLDING, so rate is over non-initial frames.
+        cold = sum(1 for r in frames if r.outcome == "ok" and not r.warm)
+        stats["cold_frame_rate"] = round(cold / len(frames), 4)
+    return stats
+
+
+# Counter families whose scrape deltas the verdict carries — the
+# server-side cross-check of the client-observed outcome counts.
+_DELTA_FAMILIES = (
+    "serve_requests_total", "serve_shed_total", "serve_timeout_total",
+    "serve_errors_total", "serve_tier_requests_total",
+    "stream_warm_frames_total", "stream_cold_frames_total",
+    "sched_early_exits_total", "cluster_dispatch_total",
+    "loadgen_requests_total",
+)
+
+
+def _metric_deltas(before_text: Optional[str],
+                   after_text: Optional[str]) -> Tuple[Dict, List[str]]:
+    if not after_text:
+        return {}, []
+    errors = []
+    try:
+        after = parse_text(after_text)
+    except ValueError as e:
+        return {}, [str(e)]
+    try:
+        before = parse_text(before_text) if before_text else None
+    except ValueError as e:
+        before, errors = None, [f"before-scrape: {e}"]
+    deltas: Dict[str, float] = {}
+    for fam in _DELTA_FAMILIES:
+        if fam not in after:
+            continue
+        now = after.total(fam)
+        prev = before.total(fam) if before else 0.0
+        deltas[fam] = now - prev
+    return deltas, errors
+
+
+def evaluate(spec: SLOSpec, rows: Sequence[RequestRow], *,
+             wall_s: float,
+             metrics_before: Optional[str] = None,
+             metrics_after: Optional[str] = None,
+             retraces: Optional[int] = None) -> Dict:
+    """Assert ``spec`` over ``rows``; returns the JSON-able verdict."""
+    checks: List[Dict] = []
+
+    def check(cls: str, metric: str, value: float, bound: float,
+              ok: bool) -> None:
+        checks.append({"cls": cls, "metric": metric,
+                       "value": (None if value is None or
+                                 (isinstance(value, float) and
+                                  math.isnan(value)) else round(value, 4)),
+                       "bound": (None if bound in (math.inf, -math.inf)
+                                 else bound),
+                       "pass": bool(ok)})
+
+    groups: Dict[str, Dict] = {}
+    for r in rows:
+        key = f"{r.tier}|{r.priority or 'normal'}"
+        groups.setdefault(key, [])
+        groups[key].append(r)
+    group_stats = {k: _group_stats(v) for k, v in sorted(groups.items())}
+
+    for cls in spec.classes:
+        sel = [r for r in rows if cls.matches(r)]
+        name = cls.selector()
+        if not sel:
+            check(name, "count", 0, 1, False)
+            continue
+        g = _group_stats(sel)
+        n = g["count"]
+        if cls.p50_ms < math.inf:
+            v = g.get("p50_ms", math.nan)
+            check(name, "p50_ms", v, cls.p50_ms,
+                  not math.isnan(v) and v <= cls.p50_ms)
+        if cls.p99_ms < math.inf:
+            v = g.get("p99_ms", math.nan)
+            check(name, "p99_ms", v, cls.p99_ms,
+                  not math.isnan(v) and v <= cls.p99_ms)
+        if cls.max_shed_rate < 1.0:
+            v = g["shed"] / n
+            check(name, "shed_rate", v, cls.max_shed_rate,
+                  v <= cls.max_shed_rate)
+        if cls.max_error_rate < 1.0:
+            v = (g["error"] + g["timeout"]) / n
+            check(name, "error_rate", v, cls.max_error_rate,
+                  v <= cls.max_error_rate)
+        if cls.min_deadline_hit_rate > 0.0:
+            v = g.get("deadline_hit_rate")
+            check(name, "deadline_hit_rate",
+                  math.nan if v is None else v,
+                  cls.min_deadline_hit_rate,
+                  v is not None and v >= cls.min_deadline_hit_rate)
+        if cls.max_cold_frame_rate < 1.0:
+            v = g.get("cold_frame_rate")
+            check(name, "cold_frame_rate",
+                  math.nan if v is None else v,
+                  cls.max_cold_frame_rate,
+                  v is not None and v <= cls.max_cold_frame_rate)
+
+    if spec.max_late_send_rate < 1.0 and rows:
+        late = sum(1 for r in rows if r.send_lag_ms > 0.0)
+        v = late / len(rows)
+        check("harness", "late_send_rate", v, spec.max_late_send_rate,
+              v <= spec.max_late_send_rate)
+
+    deltas, scrape_errors = _metric_deltas(metrics_before, metrics_after)
+    validator_errors: List[str] = list(scrape_errors)
+    if spec.require_clean_metrics and metrics_after is not None:
+        check("global", "metrics_validator_errors",
+              len(validator_errors), 0, not validator_errors)
+
+    if retraces is not None:
+        check("global", "retraces", retraces, spec.max_retraces,
+              retraces <= spec.max_retraces)
+
+    verdict = {
+        "slo_report": SLO_FORMAT,
+        "version": SLO_VERSION,
+        "pass": all(c["pass"] for c in checks),
+        "wall_s": round(wall_s, 3),
+        "requests": len(rows),
+        "checks": checks,
+        "groups": group_stats,
+        "metrics": {"validator_errors": validator_errors,
+                    "deltas": deltas},
+    }
+    if retraces is not None:
+        verdict["retraces"] = retraces
+    return verdict

@@ -1,0 +1,292 @@
+"""Versioned JSONL trace grammar + seeded synthetic-trace generators.
+
+A trace is the unit of reproducible load: one header line followed by
+one JSON object per request event, arrival-ordered.  The grammar covers
+everything the serving stack can be asked to do — bursty arrival
+timestamps, session create/churn/close, accuracy tier, priority,
+deadline_ms, explicit iteration targets, a resolution mix, and
+oversized pairs for the spatial path:
+
+    {"trace": "raftstereo_tpu.loadgen", "version": 1, "seed": 7, ...}
+    {"i": 0, "t_ms": 12.4, "h": 60, "w": 90, "tier": "fast",
+     "priority": "high", "deadline_ms": 2000.0}
+    {"i": 1, "t_ms": 31.0, "h": 60, "w": 90, "session": "s0",
+     "seq_no": 0}
+    ...
+
+Omitted fields mean "server default" (no tier, no priority, no
+deadline, controller-owned iterations).  Session frames never carry
+priority/deadline/iters — the server rejects that combination (400,
+docs/serving.md "Scheduling") and the generator respects the contract.
+
+Generators are DETERMINISTIC: same ``TraceSpec`` (seed included) ⇒
+byte-identical JSONL.  That is what makes "replay the same trace twice,
+demand identical request streams" an assertable property
+(tests/test_loadgen.py) rather than a hope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["TraceEvent", "TraceSpec", "generate", "read_trace",
+           "write_trace"]
+
+TRACE_FORMAT = "raftstereo_tpu.loadgen"
+TRACE_VERSION = 1
+
+_PRIORITIES = ("high", "normal", "low")
+_SHAPES = ("poisson", "burst", "diurnal")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEvent:
+    """One request in a trace (see module docstring for the JSON form)."""
+
+    index: int
+    t_ms: float                        # arrival offset from trace start
+    height: int
+    width: int
+    tier: Optional[str] = None         # None = server default precision
+    priority: Optional[str] = None     # high | normal | low (unary only)
+    deadline_ms: Optional[float] = None
+    iters: Optional[int] = None        # explicit target (unary only)
+    session: Optional[str] = None      # set ⇒ this is a stream frame
+    seq_no: Optional[int] = None
+    close: bool = False                # last frame of its session
+    spatial: Optional[bool] = None     # True demands the sharded path
+
+    def to_json(self) -> Dict:
+        d: Dict = {"i": self.index, "t_ms": round(self.t_ms, 3),
+                   "h": self.height, "w": self.width}
+        for key, val in (("tier", self.tier), ("priority", self.priority),
+                         ("deadline_ms", self.deadline_ms),
+                         ("iters", self.iters), ("session", self.session),
+                         ("seq_no", self.seq_no),
+                         ("spatial", self.spatial)):
+            if val is not None:
+                d[key] = val
+        if self.close:
+            d["close"] = True
+        return d
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "TraceEvent":
+        return cls(index=int(d["i"]), t_ms=float(d["t_ms"]),
+                   height=int(d["h"]), width=int(d["w"]),
+                   tier=d.get("tier"), priority=d.get("priority"),
+                   deadline_ms=d.get("deadline_ms"), iters=d.get("iters"),
+                   session=d.get("session"), seq_no=d.get("seq_no"),
+                   close=bool(d.get("close", False)),
+                   spatial=d.get("spatial"))
+
+    def validate(self) -> None:
+        if self.priority is not None and self.priority not in _PRIORITIES:
+            raise ValueError(f"event {self.index}: bad priority "
+                             f"{self.priority!r}")
+        if self.session is not None:
+            if self.priority is not None or self.deadline_ms is not None \
+                    or self.iters is not None:
+                # Mirrors the server's 400: session frames ride the
+                # scheduler as high-priority short jobs; per-frame
+                # deadline/priority/iters are not part of the contract.
+                raise ValueError(
+                    f"event {self.index}: session frames cannot carry "
+                    f"priority/deadline_ms/iters")
+            if self.seq_no is None:
+                raise ValueError(f"event {self.index}: session frame "
+                                 f"without seq_no")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """Parameters of a synthetic trace; the header line is this, dumped.
+
+    ``shape`` picks the arrival process over ``duration_s``:
+
+    * ``poisson`` — homogeneous Poisson (exponential gaps, normalised);
+    * ``burst``   — Poisson baseline with a ``burst_factor``× intensity
+      window covering ``burst_fraction`` of the duration (starts at 40%
+      in — mid-run, after any warmup traffic);
+    * ``diurnal`` — sinusoidal intensity (one full period), the
+      classic day/night load curve compressed into the trace.
+
+    ``session_fraction`` of events become stream frames, grouped into
+    interleaved sessions of ``sequence_len`` frames each (created,
+    churned against each other, closed).  ``tier_mix``/``priority_mix``
+    are (value, weight) tables sampled per unary event; ``deadlines``
+    maps a priority to its deadline_ms.  ``iters_choices`` (when
+    non-empty) gives ``iters_fraction`` of unary events an explicit
+    iteration target.  ``spatial_fraction`` of unary events demand the
+    multi-chip path at ``spatial_resolution``.
+    """
+
+    seed: int = 0
+    requests: int = 64
+    duration_s: float = 4.0
+    shape: str = "burst"
+    burst_factor: float = 4.0
+    burst_fraction: float = 0.25
+    resolutions: Tuple[Tuple[int, int], ...] = ((60, 90),)
+    session_fraction: float = 0.0
+    sequence_len: int = 4
+    tier_mix: Tuple[Tuple[str, float], ...] = (("default", 1.0),)
+    priority_mix: Tuple[Tuple[str, float], ...] = (("normal", 1.0),)
+    deadlines: Tuple[Tuple[str, float], ...] = ()
+    iters_choices: Tuple[int, ...] = ()
+    iters_fraction: float = 0.5
+    spatial_fraction: float = 0.0
+    spatial_resolution: Optional[Tuple[int, int]] = None
+
+    def __post_init__(self):
+        assert self.shape in _SHAPES, self.shape
+        assert self.requests >= 1, self.requests
+        assert self.duration_s > 0, self.duration_s
+        assert 0.0 <= self.session_fraction <= 1.0, self.session_fraction
+        assert self.sequence_len >= 2, self.sequence_len
+        for p, _ in self.priority_mix:
+            assert p in _PRIORITIES, p
+
+    def header(self) -> Dict:
+        d = dataclasses.asdict(self)
+        # Tuples JSON-ify as lists; normalise for byte-stable round trips.
+        return {"trace": TRACE_FORMAT, "version": TRACE_VERSION,
+                **json.loads(json.dumps(d))}
+
+
+def _pick(rng: np.random.Generator,
+          mix: Sequence[Tuple[str, float]]) -> str:
+    values = [v for v, _ in mix]
+    weights = np.asarray([w for _, w in mix], dtype=np.float64)
+    total = float(weights.sum())
+    assert total > 0, mix
+    return values[int(rng.choice(len(values), p=weights / total))]
+
+
+def _arrival_times_ms(rng: np.random.Generator,
+                      spec: TraceSpec) -> List[float]:
+    """``requests`` arrival offsets in ms, normalised to ``duration_s``.
+
+    Inverse-CDF over the shape's intensity profile: gap noise comes from
+    a homogeneous exponential draw, which is then warped through the
+    cumulative intensity so bursts compress arrivals without changing
+    their count — the trace always offers exactly ``requests`` events.
+    """
+    n = spec.requests
+    gaps = rng.exponential(1.0, size=n)
+    uniform = np.cumsum(gaps)
+    uniform /= uniform[-1]             # sorted points in (0, 1]
+    if spec.shape == "poisson":
+        warped = uniform
+    else:
+        grid = np.linspace(0.0, 1.0, 2049)
+        if spec.shape == "burst":
+            b0 = 0.4
+            b1 = min(1.0, b0 + spec.burst_fraction)
+            intensity = np.where((grid >= b0) & (grid < b1),
+                                 spec.burst_factor, 1.0)
+        else:                          # diurnal: one sinusoidal period
+            intensity = 1.0 + 0.8 * np.sin(2.0 * math.pi * grid)
+            intensity = np.maximum(intensity, 0.05)
+        cdf = np.cumsum(intensity)
+        cdf /= cdf[-1]
+        warped = np.interp(uniform, cdf, grid)
+    return [float(t) for t in warped * spec.duration_s * 1e3]
+
+
+def generate(spec: TraceSpec) -> List[TraceEvent]:
+    """Deterministic synthetic trace from ``spec`` (seeded rng only)."""
+    rng = np.random.default_rng(spec.seed)
+    times = _arrival_times_ms(rng, spec)
+    n = spec.requests
+
+    # Which arrival slots are stream frames: sessions of sequence_len
+    # frames, interleaved round-robin so they overlap (create/churn/
+    # close) instead of running back to back.
+    n_sessions = int(round(n * spec.session_fraction / spec.sequence_len))
+    n_frames = min(n, n_sessions * spec.sequence_len)
+    n_sessions = n_frames // spec.sequence_len
+    n_frames = n_sessions * spec.sequence_len
+    frame_slots = (sorted(int(i) for i in
+                          rng.choice(n, size=n_frames, replace=False))
+                   if n_frames else [])
+    frame_of = {}                      # slot -> (session, seq_no, close)
+    for rank, slot in enumerate(frame_slots):
+        s = rank % n_sessions
+        k = rank // n_sessions
+        frame_of[slot] = (f"s{s}", k, k == spec.sequence_len - 1)
+
+    deadlines = dict(spec.deadlines)
+    events: List[TraceEvent] = []
+    for i in range(n):
+        h, w = spec.resolutions[
+            int(rng.integers(0, len(spec.resolutions)))]
+        if i in frame_of:
+            session, seq, close = frame_of[i]
+            ev = TraceEvent(index=i, t_ms=times[i], height=h, width=w,
+                            session=session, seq_no=seq, close=close,
+                            tier=None)
+        else:
+            tier = _pick(rng, spec.tier_mix)
+            priority = _pick(rng, spec.priority_mix)
+            iters = None
+            if spec.iters_choices and \
+                    rng.random() < spec.iters_fraction:
+                iters = int(spec.iters_choices[
+                    int(rng.integers(0, len(spec.iters_choices)))])
+            spatial = None
+            if spec.spatial_fraction and \
+                    rng.random() < spec.spatial_fraction:
+                spatial = True
+                if spec.spatial_resolution is not None:
+                    h, w = spec.spatial_resolution
+            ev = TraceEvent(
+                index=i, t_ms=times[i], height=h, width=w,
+                tier=None if tier == "default" else tier,
+                priority=None if priority == "normal" else priority,
+                deadline_ms=deadlines.get(priority), iters=iters,
+                spatial=spatial)
+        ev.validate()
+        events.append(ev)
+    return events
+
+
+def write_trace(path: str, events: Sequence[TraceEvent],
+                header: Optional[Dict] = None) -> None:
+    """JSONL: one header line, then one event per line (byte-stable —
+    ``sort_keys`` + fixed float rounding in ``to_json``)."""
+    head = dict(header or {})
+    head.setdefault("trace", TRACE_FORMAT)
+    head.setdefault("version", TRACE_VERSION)
+    head["events"] = len(events)
+    with open(path, "w") as f:
+        f.write(json.dumps(head, sort_keys=True) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev.to_json(), sort_keys=True) + "\n")
+
+
+def read_trace(path: str) -> Tuple[Dict, List[TraceEvent]]:
+    """Parse + validate a JSONL trace; returns (header, events)."""
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path}: empty trace")
+    header = json.loads(lines[0])
+    if header.get("trace") != TRACE_FORMAT:
+        raise ValueError(f"{path}: not a {TRACE_FORMAT} trace")
+    if header.get("version") != TRACE_VERSION:
+        raise ValueError(f"{path}: trace version {header.get('version')} "
+                         f"!= supported {TRACE_VERSION}")
+    events = [TraceEvent.from_json(json.loads(ln)) for ln in lines[1:]]
+    for ev in events:
+        ev.validate()
+    if [e.index for e in events] != list(range(len(events))):
+        raise ValueError(f"{path}: event indices not dense/ordered")
+    if any(b.t_ms < a.t_ms for a, b in zip(events, events[1:])):
+        raise ValueError(f"{path}: arrival times not monotone")
+    return header, events

@@ -25,5 +25,11 @@ from .exporter import (  # noqa: F401
     dump_threads,
     trace_response,
 )
-from .prom import lint_registry, parse_sample, validate_prometheus  # noqa: F401
+from .prom import (  # noqa: F401
+    Scrape,
+    lint_registry,
+    parse_sample,
+    parse_text,
+    validate_prometheus,
+)
 from .trace import Span, Tracer, to_chrome_trace  # noqa: F401

@@ -17,7 +17,8 @@ import math
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
-__all__ = ["validate_prometheus", "parse_sample", "lint_registry"]
+__all__ = ["validate_prometheus", "parse_sample", "parse_text",
+           "lint_registry"]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*)(?: (.*))?$")
@@ -206,6 +207,121 @@ def validate_prometheus(text: str) -> List[str]:
                 f"histogram {base} +Inf bucket {cums[-1]} != _count "
                 f"{h['count']}")
     return errors
+
+
+class Scrape:
+    """A parsed exposition: ``{name: {"type", "help", "samples"}}`` plus
+    point lookups and scrape-to-scrape deltas.
+
+    ``samples`` maps the label set (a tuple of ``(label, raw_value)``
+    pairs, as ``parse_sample`` returns them — ``()`` for unlabeled) to
+    the sample value.  Histogram ``_bucket``/``_sum``/``_count`` series
+    stay under their own sample names inside the BASE metric's entry, so
+    ``scrape["serve_request_latency_seconds"].samples`` holds the whole
+    histogram.
+    """
+
+    def __init__(self, metrics: Dict[str, "ScrapedMetric"]):
+        self.metrics = metrics
+
+    def __getitem__(self, name: str) -> "ScrapedMetric":
+        return self.metrics[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.metrics
+
+    def get(self, name: str, default=None):
+        return self.metrics.get(name, default)
+
+    def value(self, name: str, **labels) -> float:
+        """The sample value for one series (0.0 when the series — or
+        the whole metric — has not rendered yet; absent and zero are
+        the same thing to a delta assertion)."""
+        m = self.metrics.get(name)
+        if m is None:
+            return 0.0
+        return m.value(name, **labels)
+
+    def total(self, name: str) -> float:
+        """Label-blind sum over a family's series (counters/gauges)."""
+        m = self.metrics.get(name)
+        if m is None:
+            return 0.0
+        return sum(v for (sname, _), v in m.samples.items()
+                   if sname == name)
+
+    def delta(self, before: "Scrape", name: str, **labels) -> float:
+        """This scrape's series value minus ``before``'s — the
+        metric-delta primitive the SLO harness asserts on."""
+        return self.value(name, **labels) - before.value(name, **labels)
+
+
+class ScrapedMetric:
+    """One declared metric from a scrape (see ``Scrape``)."""
+
+    def __init__(self, kind: str, help_: str):
+        self.kind = kind
+        self.help = help_
+        # (sample_name, label_items) -> value; sample_name differs from
+        # the base only for histogram _bucket/_sum/_count series.
+        self.samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                           float] = {}
+
+    def value(self, sample_name: str, **labels) -> float:
+        key = (sample_name,
+               tuple(sorted((k, str(v)) for k, v in labels.items())))
+        for (sname, litems), v in self.samples.items():
+            if sname == sample_name and tuple(sorted(litems)) == key[1]:
+                return v
+        return 0.0
+
+    def series(self, sample_name: Optional[str] = None):
+        """[(label_items, value)] for one sample name (default: all)."""
+        return [(litems, v) for (sname, litems), v in self.samples.items()
+                if sample_name is None or sname == sample_name]
+
+
+def parse_text(text: str) -> Scrape:
+    """Parse a Prometheus 0.0.4 exposition into a ``Scrape`` — the
+    inverse of ``MetricsRegistry.render``, labels included.
+
+    Strict: raises ``ValueError`` listing the problems if the text
+    fails ``validate_prometheus`` — a harness asserting metric deltas
+    on a malformed scrape would certify garbage.  Tests that previously
+    regexed ``/metrics`` by hand get structured lookups instead:
+
+        scrape = parse_text(client.metrics_text())
+        scrape.value("serve_requests_total",
+                     endpoint="predict", outcome="ok")
+        scrape.delta(before, "serve_shed_total")
+    """
+    errors = validate_prometheus(text)
+    if errors:
+        raise ValueError("malformed exposition: " + "; ".join(errors))
+    metrics: Dict[str, ScrapedMetric] = {}
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line)
+            if m:
+                helps[m.group(1)] = m.group(2) or ""
+                continue
+            m = _TYPE_RE.match(line)
+            if m:
+                types[m.group(1)] = m.group(2)
+                metrics[m.group(1)] = ScrapedMetric(
+                    m.group(2), helps.get(m.group(1), ""))
+            continue
+        name, labels, value = parse_sample(line)
+        base = _base_of(name, types)
+        # The validator guaranteed base is not None.
+        metrics[base].samples[(name, labels)] = value
+    for name, m in metrics.items():  # HELP-after-TYPE is legal format
+        m.help = helps.get(name, m.help)
+    return Scrape(metrics)
 
 
 # ------------------------------------------------------------------- lint

@@ -22,10 +22,28 @@ REFUSED and warrant immediate scale-out advice.
 from __future__ import annotations
 
 import dataclasses
+import json
+import math
 import threading
 from typing import Dict, Optional, Tuple
 
-__all__ = ["AutoscalePolicy", "Autoscaler", "recommend"]
+__all__ = ["AutoscalePolicy", "Autoscaler", "load_capacity_model",
+           "recommend"]
+
+
+def load_capacity_model(path: str) -> Dict:
+    """Read a ``loadgen.capacity`` JSON model (``cli.loadgen fit``)
+    for the autoscaler.  Stdlib re-implementation of
+    ``loadgen.capacity.load_model`` ON PURPOSE: the model-free router
+    embeds this module and must not import the loadgen package (whose
+    replay engine pulls the serve client stack)."""
+    with open(path) as f:
+        model = json.load(f)
+    if model.get("capacity_model") != "raftstereo_tpu.loadgen.capacity":
+        raise ValueError(f"{path}: not a capacity model file")
+    if not isinstance(model.get("per_chip_rps"), (int, float)):
+        raise ValueError(f"{path}: capacity model has no per_chip_rps")
+    return model
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,12 +95,46 @@ class Autoscaler:
     hysteresis streak across observations.  Thread-safe — the dispatcher
     calls ``observe`` from every request-settling thread."""
 
-    def __init__(self, policy: Optional[AutoscalePolicy] = None):
+    def __init__(self, policy: Optional[AutoscalePolicy] = None,
+                 capacity: Optional[Dict] = None,
+                 target_rps: float = 0.0):
+        """``capacity`` is an optional fitted model dict
+        (``load_capacity_model`` / ``loadgen.capacity.fit``); with one,
+        every advice carries a ``capacity`` block sizing the cluster
+        for ``target_rps`` (requests/s the operator plans for) instead
+        of only reacting to gauges."""
         self.policy = policy or AutoscalePolicy()
+        self.capacity = capacity
+        self.target_rps = float(target_rps)
         self._lock = threading.Lock()
         self._last_shed = 0.0  # guarded_by: _lock
         self._streak_dir = 0  # guarded_by: _lock
         self._streak = 0  # guarded_by: _lock
+
+    def capacity_advice(self, ready: int) -> Optional[Dict[str, object]]:
+        """Model-based sizing for the planned ``target_rps``:
+        recommended replica count and the headroom fraction of the
+        CURRENT fleet (1 = fully idle capacity, 0 = at the fitted
+        limit, negative = past it).  None without a model."""
+        if self.capacity is None:
+            return None
+        per_chip = float(self.capacity.get("per_chip_rps", 0.0))
+        target = self.target_rps
+        if per_chip <= 0:
+            recommended = None
+            headroom = 0.0
+        else:
+            recommended = max(self.policy.min_replicas,
+                              int(math.ceil(target / per_chip))
+                              if target > 0 else self.policy.min_replicas)
+            fleet_rps = max(ready, 0) * per_chip
+            headroom = (1.0 - target / fleet_rps) if fleet_rps > 0 else 0.0
+        return {
+            "per_chip_rps": per_chip,
+            "target_rps": target,
+            "recommended_replicas": recommended,
+            "headroom": round(headroom, 4),
+        }
 
     def observe(self, *, ready: int, utilization: float,
                 occupancy: Optional[float] = None,
@@ -108,7 +160,7 @@ class Autoscaler:
                 delta = -min(-delta, max(0, ready - policy.min_replicas))
         action = ("scale_up" if delta > 0
                   else "scale_down" if delta < 0 else "hold")
-        return {
+        advice: Dict[str, object] = {
             "action": action,
             "delta": delta,
             "reason": reason,
@@ -120,3 +172,7 @@ class Autoscaler:
                 "shed_delta": shed_delta,
             },
         }
+        cap = self.capacity_advice(ready)
+        if cap is not None:
+            advice["capacity"] = cap
+        return advice

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import math
 import socket
 import threading
 import time
@@ -26,8 +27,8 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..loadgen.records import Recorder, RequestRow, summarize
 from ..utils.backoff import backoff_delay
-from ..utils.profiling import LatencyHistogram
 from .server import decode_array, encode_array
 
 __all__ = ["ServeClient", "ServeError", "run_load", "synthetic_pair_pool"]
@@ -323,6 +324,12 @@ def run_load(host: str, port: int,
     session's frames must arrive in order), and the stats grow
     ``warm_frames``/``cold_frames`` from the response meta — a quick check
     that warm starts actually engaged.
+
+    Implementation rides the SLO harness's recorder
+    (raftstereo_tpu/loadgen/records.py): one ``RequestRow`` per request,
+    and the summary — including the historical key set — is
+    ``records.summarize`` over the rows, so the same per-request data
+    that certifies SLOs backs this quick path too.
     """
     assert mode in ("closed", "open"), mode
     if mode == "open" and not rate:
@@ -333,12 +340,7 @@ def run_load(host: str, port: int,
             raise ValueError("explicit iters cannot drive sequence replay "
                              "(the server's controller owns per-frame "
                              "iterations)")
-    lat = LatencyHistogram()
-    send_lag = LatencyHistogram()  # open loop: scheduled vs actual send
-    counts = {"ok": 0, "shed": 0, "timeout": 0, "error": 0}
-    if sequence_len is not None:
-        counts["warm_frames"] = 0
-        counts["cold_frames"] = 0
+    recorder = Recorder()
     lock = threading.Lock()
     next_idx = [0]
     t_start = time.perf_counter()
@@ -354,6 +356,50 @@ def run_load(host: str, port: int,
             next_idx[0] += stride
             return i
 
+    def run_one(client: ServeClient, i: int) -> None:
+        lag_ms = 0.0
+        sched_ms = math.nan
+        if mode == "open":
+            sched_ms = i / rate * 1e3
+            delay = t_start + i / rate - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                lag_ms = -delay * 1e3
+        left, right = make_pair(i)
+        session = seq = None
+        if sequence_len is not None:
+            session = f"loadgen-{i // sequence_len}"
+            seq = i % sequence_len
+        fields = dict(index=i, t_sched_ms=sched_ms,
+                      t_send_ms=(time.perf_counter() - t_start) * 1e3,
+                      send_lag_ms=lag_ms, tier=accuracy or "default",
+                      iters=iters, height=int(left.shape[0]),
+                      width=int(left.shape[1]),
+                      session=session or "", seq_no=seq)
+        t0 = time.perf_counter()
+        try:
+            _, meta = client.predict(left, right, iters=iters,
+                                     session_id=session, seq_no=seq,
+                                     accuracy=accuracy)
+        except ServeError as e:
+            kind = {503: "shed", 504: "timeout"}.get(e.status, "error")
+            recorder.add(RequestRow(
+                outcome=kind, latency_ms=(time.perf_counter() - t0) * 1e3,
+                status=e.status, request_id=e.request_id or "", **fields))
+        except Exception:
+            recorder.add(RequestRow(outcome="error", latency_ms=math.nan,
+                                    **fields))
+        else:
+            recorder.add(RequestRow(
+                outcome="ok",
+                latency_ms=(time.perf_counter() - t0) * 1e3,
+                status=200, iters_done=meta.get("iters"),
+                warm=meta.get("warm"),
+                degraded=bool(meta.get("degraded", False)),
+                backend=meta.get("backend", ""),
+                request_id=meta.get("request_id") or "", **fields))
+
     def worker():
         client = ServeClient(host, port, timeout=timeout, retries=retries)
         try:
@@ -363,39 +409,7 @@ def run_load(host: str, port: int,
                     return
                 stop = min(start + (sequence_len or 1), requests)
                 for i in range(start, stop):
-                    if mode == "open":
-                        delay = t_start + i / rate - time.perf_counter()
-                        if delay > 0:
-                            time.sleep(delay)
-                        else:
-                            send_lag.observe(-delay)
-                    left, right = make_pair(i)
-                    session = seq = None
-                    if sequence_len is not None:
-                        session = f"loadgen-{i // sequence_len}"
-                        seq = i % sequence_len
-                    t0 = time.perf_counter()
-                    try:
-                        _, meta = client.predict(left, right, iters=iters,
-                                                 session_id=session,
-                                                 seq_no=seq,
-                                                 accuracy=accuracy)
-                    except ServeError as e:
-                        kind = {503: "shed", 504: "timeout"}.get(e.status,
-                                                                 "error")
-                        with lock:
-                            counts[kind] += 1
-                    except Exception:
-                        with lock:
-                            counts["error"] += 1
-                    else:
-                        lat.observe(time.perf_counter() - t0)
-                        with lock:
-                            counts["ok"] += 1
-                            if sequence_len is not None:
-                                key = ("warm_frames" if meta.get("warm")
-                                       else "cold_frames")
-                                counts[key] += 1
+                    run_one(client, i)
         finally:
             client.close()
 
@@ -407,25 +421,6 @@ def run_load(host: str, port: int,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    stats = {
-        "mode": mode, "requests": requests, "concurrency": concurrency,
-        "wall_s": round(wall, 3),
-        "pairs_per_sec": round(counts["ok"] / wall, 4) if wall else 0.0,
-        **counts,
-    }
-    if sequence_len is not None:
-        stats["sequence_len"] = sequence_len
-    if rate:
-        stats["offered_rate"] = rate
-        # How far behind schedule sends fell (0 observations = on time):
-        # large values mean concurrency was too low for the offered rate
-        # and the run degraded toward closed-loop.
-        stats["late_sends"] = send_lag.count
-        stats["send_lag_p99_ms"] = (round(send_lag.percentile(99) * 1e3, 2)
-                                    if send_lag.count else 0.0)
-    if lat.count:
-        s = lat.summary()
-        stats.update(p50_ms=round(s["p50"] * 1e3, 2),
-                     p90_ms=round(s["p90"] * 1e3, 2),
-                     p99_ms=round(s["p99"] * 1e3, 2))
-    return stats
+    return summarize(recorder.rows(), mode=mode, requests=requests,
+                     concurrency=concurrency, wall_s=wall, rate=rate,
+                     sequence_len=sequence_len)

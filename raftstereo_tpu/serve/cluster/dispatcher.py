@@ -45,7 +45,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ...config import ServeConfig
-from ...ops.autoscale import Autoscaler
+from ...ops.autoscale import Autoscaler, load_capacity_model
 from ..batcher import Future, Overloaded, RequestTimedOut, ShuttingDown
 from ..metrics import ClusterMetrics, ServeMetrics
 from .pins import PinTable
@@ -101,7 +101,11 @@ class ClusterDispatcher:
         # import guard makes the race safe, the marker makes it cheap).
         self._migrate_lock = threading.Lock()
         self._migrating = set()  # guarded_by: _migrate_lock
-        self._autoscaler = Autoscaler()
+        ccfg = self.rset.cluster_cfg
+        capacity = (load_capacity_model(ccfg.capacity_model)
+                    if ccfg.capacity_model else None)
+        self._autoscaler = Autoscaler(capacity=capacity,
+                                      target_rps=ccfg.target_rps)
         self._advice: Dict[str, object] = {}
 
     # ----------------------------------------------------------- placement
@@ -186,6 +190,11 @@ class ClusterDispatcher:
                        if self.cfg.sched is not None else None),
             shed_total=shed)
         cm.autoscale_recommendation.set(advice["delta"])
+        cap = advice.get("capacity")
+        # 0.0 without a model: the gauge renders from startup either
+        # way, and "no model" and "no headroom information" read the
+        # same to an alerting rule (documented in docs/serving.md).
+        cm.capacity_headroom.set(cap["headroom"] if cap else 0.0)
         self._advice = advice
 
     # ------------------------------------------------------------ admission

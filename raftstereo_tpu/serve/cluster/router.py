@@ -62,7 +62,7 @@ from urllib.parse import parse_qs, quote, urlparse
 
 from ...config import RouterConfig
 from ...obs import Tracer, build_info, dump_threads, trace_response
-from ...ops.autoscale import Autoscaler
+from ...ops.autoscale import Autoscaler, load_capacity_model
 from ...utils.backoff import backoff_delay
 from ..httpbase import JsonRequestHandler
 from ..metrics import ClusterMetrics, MetricsRegistry
@@ -247,10 +247,19 @@ class _RouterHandler(JsonRequestHandler):
         elif url.path == "/debug/threads":
             self._send(200, dump_threads().encode(), "text/plain")
         elif url.path == "/debug/vars":
+            hop = rt.cluster_metrics.router_latency
             self._json(200, {
                 "backends": {b.name: b.snapshot() for b in rt.backends},
                 "session_pins": rt.pin_count(),
                 "autoscale": rt.autoscale_advice,
+                # Live hop-latency percentiles (utils/profiling
+                # quantile) — operators see p50/p99 without a
+                # Prometheus stack.  null until the first forward.
+                "latency": ({
+                    "count": hop.count,
+                    "hop_p50_ms": round(hop.quantile(0.5) * 1e3, 3),
+                    "hop_p99_ms": round(hop.quantile(0.99) * 1e3, 3),
+                } if hop.count else None),
                 "build": build_info(),
             })
         else:
@@ -391,7 +400,10 @@ class StereoRouter(ThreadingHTTPServer):
         # import guard makes the race safe, the marker makes it cheap).
         self._migrate_lock = threading.Lock()
         self._migrating = set()  # guarded_by: _migrate_lock
-        self._autoscaler = Autoscaler()
+        capacity = (load_capacity_model(config.capacity_model)
+                    if config.capacity_model else None)
+        self._autoscaler = Autoscaler(capacity=capacity,
+                                      target_rps=config.target_rps)
         self._advice: Dict[str, object] = {}
         self._prober = _Prober(self)
         super().__init__((config.host, config.port), _RouterHandler)
@@ -580,6 +592,9 @@ class StereoRouter(ThreadingHTTPServer):
             ready=len(ready), utilization=cm.utilization.value,
             shed_total=shed)
         cm.autoscale_recommendation.set(advice["delta"])
+        cap = advice.get("capacity")
+        # 0.0 without a model (same convention as the dispatcher).
+        cm.capacity_headroom.set(cap["headroom"] if cap else 0.0)
         self._advice = advice
 
     @property

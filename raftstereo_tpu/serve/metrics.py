@@ -383,6 +383,12 @@ class ClusterMetrics:
             "cluster_autoscale_recommendation",
             "recommended change in replica count from ops/autoscale.py "
             "(positive = scale out, negative = scale in, 0 = hold)")
+        self.capacity_headroom = r.gauge(
+            "cluster_capacity_headroom",
+            "fraction of the ready fleet's fitted capacity left above "
+            "the planned target_rps (1 = idle, 0 = at the fitted limit, "
+            "negative = overcommitted), from the loadgen capacity model "
+            "(docs/slo_harness.md); 0 when no model is configured")
         self.probe_failures = r.counter(
             "cluster_probe_failures_total",
             "health-probe failures per backend (router only)",

@@ -229,9 +229,18 @@ class _Handler(JsonRequestHandler):
             else:
                 self._json(200, snapshot_to_wire(snapshot))
         elif url.path == "/debug/vars":
+            lat = srv.metrics.latency
             self._json(200, {
                 "config": dataclasses.asdict(srv.config),
                 "build": build_info(),
+                # Live request-latency percentiles (utils/profiling
+                # quantile) — operators see p50/p99 without a
+                # Prometheus stack.  null until the first request.
+                "latency": ({
+                    "count": lat.count,
+                    "p50_ms": round(lat.quantile(0.5) * 1e3, 3),
+                    "p99_ms": round(lat.quantile(0.99) * 1e3, 3),
+                } if lat.count else None),
                 "engine": {
                     "compiled_buckets": sorted(srv.engine.compiled_keys),
                     "queue_depth": srv.queue_depth,

@@ -196,6 +196,14 @@ class LatencyHistogram:
         counts, n, _, vmin, vmax = self._snapshot()
         return self._percentile_from(counts, n, vmin, vmax, q)
 
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile, q in [0, 1] — ``quantile(0.99)`` is
+        ``percentile(99)``.  The SLO-spec convention (loadgen/slo.py,
+        ``/debug/vars`` live percentiles) alongside the Prometheus-style
+        ``percentile``; NaN when empty, asserts on out-of-range q."""
+        assert 0.0 <= q <= 1.0, q
+        return self.percentile(q * 100.0)
+
     def summary(self) -> Dict[str, float]:
         counts, n, total, vmin, vmax = self._snapshot()
         if not n:

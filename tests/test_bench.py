@@ -87,3 +87,22 @@ def test_spatial_mode_contract():
     # sharded program is BITWISE-identical to the single-device jit at
     # fp32, so the gap is exactly zero — not merely small.
     assert r["max_abs_gap"] == 0.0
+
+
+@pytest.mark.slow
+def test_slo_mode_contract():
+    """bench --slo: trace gen -> open-loop replay against a 2-replica
+    CPU cluster -> SLO verdict -> capacity fit, one JSON line out."""
+    r = _run(["--slo", "--quick"])
+    assert r["unit"] == "pairs/sec" and r["value"] > 0
+    assert {"replicas", "trace_events", "slo_pass", "checks", "groups",
+            "metric_deltas", "per_chip_rps", "utilization", "whatif",
+            "wall_s"} <= set(r)
+    assert r["replicas"] == 2
+    assert r["slo_pass"] is True
+    assert all(c["pass"] for c in r["checks"])
+    # The fit answers the headline question from the same run.
+    assert r["per_chip_rps"] > 0
+    assert r["whatif"]["users_served"] >= 1
+    # Server-side cross-check of the client-observed request count.
+    assert r["metric_deltas"]["cluster_dispatch_total"] == r["trace_events"]

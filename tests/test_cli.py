@@ -28,7 +28,8 @@ class TestHelpRegression:
     would pay ~10 s of fresh jax import each for no extra coverage."""
 
     SUBCOMMANDS = ["train", "evaluate", "demo", "serve", "convert",
-                   "sl", "sl_smoke", "stream", "router", "certify"]
+                   "sl", "sl_smoke", "stream", "router", "certify",
+                   "loadgen"]
 
     @pytest.mark.parametrize("name", SUBCOMMANDS)
     def test_help_exits_zero(self, name, capsys):

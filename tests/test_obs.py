@@ -22,7 +22,7 @@ import jax
 
 from raftstereo_tpu.config import RAFTStereoConfig, ServeConfig, StreamConfig
 from raftstereo_tpu.obs import (TelemetryServer, Tracer, dump_threads,
-                                lint_registry, parse_sample,
+                                lint_registry, parse_sample, parse_text,
                                 to_chrome_trace, validate_prometheus)
 from raftstereo_tpu.serve import ServeClient, ServeError, ServeMetrics, \
     build_server
@@ -210,6 +210,62 @@ class TestValidator:
         from scripts.check_metrics import check
 
         assert check() == []
+
+
+# ------------------------------------------------------------ scrape parser
+
+class TestParseText:
+    def test_structured_lookups(self):
+        scrape = parse_text(GOOD)
+        assert "x_total" in scrape and "nope_total" not in scrape
+        assert scrape["x_total"].kind == "counter"
+        assert scrape["x_total"].help == "a counter"
+        assert scrape.value("x_total", endpoint="predict",
+                            outcome="ok") == 3.0
+        # Label order never matters; absent series/metrics read as 0.
+        assert scrape.value("x_total", outcome="ok",
+                            endpoint="predict") == 3.0
+        assert scrape.value("x_total", outcome="shed",
+                            endpoint="predict") == 0.0
+        assert scrape.value("nope_total") == 0.0
+        assert scrape.get("nope_total") is None
+
+    def test_total_sums_across_label_sets(self):
+        text = ("# TYPE r_total counter\n"
+                'r_total{tier="fast"} 2\n'
+                'r_total{tier="certified"} 5\n')
+        assert parse_text(text).total("r_total") == 7.0
+        assert parse_text(text).total("absent_total") == 0.0
+
+    def test_histogram_series_group_under_base(self):
+        scrape = parse_text(GOOD)
+        h = scrape["h_seconds"]
+        assert h.kind == "histogram"
+        assert h.value("h_seconds_bucket", le="0.1") == 1.0
+        assert h.value("h_seconds_bucket", le="+Inf") == 2.0
+        assert h.value("h_seconds_sum") == 0.5
+        assert h.value("h_seconds_count") == 2.0
+        assert len(h.series("h_seconds_bucket")) == 2
+        # _bucket/_sum/_count never surface as metrics of their own.
+        assert "h_seconds_bucket" not in scrape
+
+    def test_delta_between_scrapes(self):
+        before = parse_text("# TYPE s_total counter\ns_total 3\n")
+        after = parse_text("# TYPE s_total counter\ns_total 11\n")
+        assert after.delta(before, "s_total") == 8.0
+
+    def test_help_after_type_is_backfilled(self):
+        text = ("# TYPE late_total counter\n"
+                "late_total 1\n"
+                "# HELP late_total documented below its samples\n")
+        assert parse_text(text)["late_total"].help == \
+            "documented below its samples"
+
+    def test_rejects_invalid_exposition(self):
+        with pytest.raises(ValueError, match="malformed exposition"):
+            parse_text("x_total 1\n")       # sample without TYPE
+        with pytest.raises(ValueError, match="malformed exposition"):
+            parse_text("# TYPE x_total counter\nx_total oops\n")
 
 
 # --------------------------------------------------- bounded Timer + Gauge
@@ -424,22 +480,27 @@ class TestEndToEnd:
             # server's request window.
             assert by_name["request"]["dur"] / 1e6 <= observed_latency
 
-            # /metrics: format-valid, labeled families populated.
-            text = client.metrics_text()
-            assert validate_prometheus(text) == []
-            assert 'serve_requests_total{endpoint="predict",outcome="ok"}' \
-                in text
-            assert ('serve_compile_cache_hits_total{bucket="64x96",'
-                    'iters="3",') in text
+            # /metrics: parse_text both validates the exposition and
+            # replaces the old hand-regexed substring assertions with
+            # structured lookups.
+            scrape = parse_text(client.metrics_text())
+            assert scrape.value("serve_requests_total",
+                                endpoint="predict", outcome="ok") >= 1
+            hits = scrape["serve_compile_cache_hits_total"]
+            assert any(dict(litems).get("bucket") == "64x96"
+                       and dict(litems).get("iters") == "3"
+                       for litems, v in hits.series() if v > 0)
 
             # Bad request -> 400 with its own request id, counted by
             # outcome.
             with pytest.raises(ServeError) as ei:
                 client.predict(_img(), _img(70, 100))
             assert ei.value.request_id  # error replies keep their trace key
-            text = client.metrics_text()
-            assert ('serve_requests_total{endpoint="predict",'
-                    'outcome="bad_request"} 1') in text
+            after = parse_text(client.metrics_text())
+            assert after.value("serve_requests_total", endpoint="predict",
+                               outcome="bad_request") == 1
+            assert after.delta(scrape, "serve_requests_total",
+                               endpoint="predict", outcome="bad_request") == 1
 
         # The engine-level view of the same invariant: warmup paid the
         # only compile, traffic added no cache keys.

@@ -107,6 +107,18 @@ class TestLatencyHistogram:
         assert cum[float("inf")] == 6  # overflow lands in +Inf only
         assert h.total == 112
 
+    def test_quantile_is_percentile_rescaled(self):
+        h = LatencyHistogram(lo=1e-3, hi=10.0)
+        for v in np.linspace(0.001, 1.0, 1000):
+            h.observe(float(v))
+        for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+            assert h.quantile(q) == h.percentile(q * 100.0)
+        with pytest.raises(AssertionError):
+            h.quantile(50)         # percentile scale on the quantile API
+
+    def test_quantile_empty_is_nan(self):
+        assert np.isnan(LatencyHistogram().quantile(0.5))
+
     def test_reset(self):
         h = LatencyHistogram()
         h.observe(0.5)
