@@ -526,7 +526,10 @@ def bench_serve(height: int, width: int, iters: int, max_batch: int,
     drive closed-loop traffic through the real wire format via the load-gen
     client, and report achieved pairs/sec + p99 latency.  Exercises the
     whole subsystem — bucketed compile cache, micro-batcher, admission
-    control, metrics — not just the forward (docs/serving.md)."""
+    control, metrics — not just the forward (docs/serving.md).  Runs the
+    same traffic under BOTH /predict dialects (binary wire frames, then
+    the legacy base64 JSON) so the record states the measured
+    wire-bytes/pair reduction (docs/wire_format.md)."""
     import threading
 
     from raftstereo_tpu.config import RAFTStereoConfig, ServeConfig
@@ -559,9 +562,24 @@ def bench_serve(height: int, width: int, iters: int, max_batch: int,
         stats = run_load(serve_cfg.host, server.port,
                          synthetic_pair_pool(height, width),
                          requests=requests, concurrency=concurrency)
+        stats_json = run_load(serve_cfg.host, server.port,
+                              synthetic_pair_pool(height, width),
+                              requests=requests, concurrency=concurrency,
+                              wire_format="json")
     finally:
         server.close()
         thread.join(10)
+    # Primary keys stay the binary run (the default dialect); the JSON
+    # rerun of the same traffic makes the reduction a measured number.
+    if "wire_bytes_per_pair" in stats and "wire_bytes_per_pair" in stats_json:
+        stats["wire_reduction_x"] = round(
+            stats_json["wire_bytes_per_pair"]
+            / max(stats["wire_bytes_per_pair"], 1.0), 2)
+    stats["json"] = {k: stats_json[k]
+                     for k in ("pairs_per_sec", "ok", "p99_ms",
+                               "wire_bytes_per_pair", "wire_mb_sent",
+                               "wire_mb_received")
+                     if k in stats_json}
     return stats
 
 
@@ -721,6 +739,14 @@ def bench_slo(height: int, width: int, iters: int, replicas: int,
         events = lg_trace.generate(spec)
         rcfg = lg_replay.ReplayConfig(host=serve_cfg.host, port=server.port,
                                       concurrency=concurrency)
+        # Same trace under the legacy JSON dialect first (comparison run
+        # — its sessions re-run cold on the binary pass, a documented
+        # out_of_order frame, not an error); the verdict and the metric
+        # scrapes bracket the BINARY replay, the default dialect.
+        rcfg_json = lg_replay.ReplayConfig(
+            host=serve_cfg.host, port=server.port,
+            concurrency=concurrency, wire_format="json")
+        rows_json = lg_replay.replay(events, rcfg_json).rows()
         scraper = ServeClient(serve_cfg.host, server.port, timeout=120.0)
         try:
             before = scraper.metrics_text()
@@ -743,13 +769,22 @@ def bench_slo(height: int, width: int, iters: int, replicas: int,
     finally:
         server.close()
         thread.join(10)
+    from raftstereo_tpu.loadgen.records import wire_bytes as lg_wire_bytes
     ok = sum(1 for r in rows if r.outcome == "ok")
+    wb_bin = verdict.get("wire")
+    wb_json = lg_wire_bytes(rows_json)
+    wire = {"binary": wb_bin, "json": wb_json}
+    if wb_bin and wb_json:
+        wire["reduction_x"] = round(
+            wb_json["wire_bytes_per_pair"]
+            / max(wb_bin["wire_bytes_per_pair"], 1.0), 2)
     return {
         "replicas": replicas,
         "trace_events": len(events),
         "slo_pass": verdict["pass"],
         "checks": verdict["checks"],
         "groups": verdict["groups"],
+        "wire": wire,
         "metric_deltas": verdict["metrics"]["deltas"],
         "per_chip_rps": capacity["per_chip_rps"],
         "utilization": capacity["utilization"],
@@ -1461,7 +1496,9 @@ def main() -> None:
             "vs_baseline": 0.0,
         }
         for k in ("p50_ms", "p99_ms", "ok", "shed", "timeout", "error",
-                  "wall_s", "concurrency"):
+                  "wall_s", "concurrency", "wire_format",
+                  "wire_bytes_per_pair", "wire_mb_sent",
+                  "wire_mb_received", "wire_reduction_x", "json"):
             if k in stats:
                 record[k] = stats[k]
         print(json.dumps(record))

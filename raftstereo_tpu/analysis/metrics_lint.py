@@ -72,6 +72,9 @@ def run_metrics_lint() -> List[Finding]:
     serve.compile_hits.labels(bucket="64x96", iters="8",
                               mode="stream", tier="bf16").inc()
     serve.stream_cold_frames.labels(reason="new").inc()
+    serve.wire_bytes.labels(direction="in", format="binary").inc(1024)
+    serve.wire_negotiations.labels(request="binary",
+                                   response="json").inc()
     serve.latency.observe(0.01)
     cluster.set_states({"ready": 1})
     cluster.queue_depth.labels(replica="r0").set(0)
@@ -82,6 +85,8 @@ def run_metrics_lint() -> List[Finding]:
     cluster.probe_failures.labels(replica="r0").inc()
     cluster.router_latency.observe(0.001)
     cluster.capacity_headroom.set(0.5)
+    cluster.wire_stream_bytes.labels(direction="in").inc(65536)
+    cluster.wire_stream_peak_chunk.set(65536)
     loadgen.requests.labels(outcome="ok", tier="default").inc()
     loadgen.send_lag.observe(0.001)
     loadgen.latency.observe(0.01)

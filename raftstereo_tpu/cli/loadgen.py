@@ -104,6 +104,16 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--pair_seed", type=int, default=0)
     r.add_argument("--speed", type=float, default=1.0,
                    help=">1 replays the trace faster than recorded")
+    r.add_argument("--wire", default="binary",
+                   choices=["binary", "json"],
+                   help="/predict dialect: binary wire frames (default) "
+                        "or the legacy base64 JSON — replay the same "
+                        "trace under both to measure the wire-bytes/pair "
+                        "reduction (docs/wire_format.md)")
+    r.add_argument("--response_encoding", default="f32",
+                   choices=["f32", "int16"],
+                   help="binary-dialect disparity encoding (int16 adds "
+                        "the per-response exactness manifest)")
     r.add_argument("--report", default=None,
                    help="write verdict + per-request rows JSON here")
     r.add_argument("--p50_ms", type=float, default=math.inf,
@@ -166,7 +176,9 @@ def _cmd_replay(args) -> int:
     cfg = R.ReplayConfig(host=args.host, port=args.port,
                          concurrency=args.concurrency,
                          timeout_s=args.timeout_s, retries=args.retries,
-                         pair_seed=args.pair_seed, speed=args.speed)
+                         pair_seed=args.pair_seed, speed=args.speed,
+                         wire_format=args.wire,
+                         response_encoding=args.response_encoding)
     scraper = ServeClient(args.host, args.port, timeout=args.timeout_s)
     try:
         before = scraper.metrics_text()
@@ -191,6 +203,8 @@ def _cmd_replay(args) -> int:
             f.write("\n")
     out = {k: verdict[k] for k in
            ("pass", "requests", "wall_s", "groups")}
+    if "wire" in verdict:
+        out["wire"] = verdict["wire"]
     out["report"] = args.report
     print(json.dumps(out), flush=True)
     return 0 if verdict["pass"] else 1

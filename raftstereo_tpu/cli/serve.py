@@ -62,6 +62,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="accuracy tier sent with every load-gen request "
                         "(the server must advertise it; docs/serving.md "
                         "\"Accuracy tiers\")")
+    g.add_argument("--json", action="store_true", dest="wire_json",
+                   help="send the legacy base64 JSON /predict dialect "
+                        "instead of the default binary wire frames "
+                        "(docs/wire_format.md)")
+    g.add_argument("--response_encoding", default="f32",
+                   choices=["f32", "int16"],
+                   help="binary-dialect disparity encoding: bitwise "
+                        "float32 (default) or int16 fixed-point with a "
+                        "per-response exactness manifest")
     p.add_argument("--no_stream", action="store_true",
                    help="disable the session-aware streaming path "
                         "(session_id/seq_no on /predict)")
@@ -98,7 +107,9 @@ def run_loadgen(args) -> int:
         requests=args.requests, concurrency=args.concurrency,
         mode="open" if args.open_rate else "closed", rate=args.open_rate,
         iters=args.request_iters, sequence_len=args.sequence_len,
-        accuracy=args.accuracy)
+        accuracy=args.accuracy,
+        wire_format="json" if args.wire_json else "binary",
+        response_encoding=args.response_encoding)
     print(json.dumps(stats))
     return 0
 

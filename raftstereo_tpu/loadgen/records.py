@@ -19,7 +19,8 @@ import math
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["Recorder", "RequestRow", "percentile", "summarize"]
+__all__ = ["Recorder", "RequestRow", "percentile", "summarize",
+           "wire_bytes"]
 
 #: Row outcomes, in the order the legacy ``run_load`` counted them.
 OUTCOMES = ("ok", "shed", "timeout", "error")
@@ -58,6 +59,9 @@ class RequestRow:
     degraded: bool = False
     backend: str = ""                 # X-Backend via the router
     request_id: str = ""
+    wire: str = "json"                # request dialect: json | binary
+    bytes_sent: int = 0               # request body bytes on the wire
+    bytes_received: int = 0           # response body bytes on the wire
 
     def bucket(self) -> str:
         """Capacity-model bucket key: tier|iters|HxW (docs/slo_harness.md)."""
@@ -162,7 +166,29 @@ def summarize(rows: Sequence[RequestRow], *, mode: str, requests: int,
         stats.update(p50_ms=round(percentile(lats, 50), 2),
                      p90_ms=round(percentile(lats, 90), 2),
                      p99_ms=round(percentile(lats, 99), 2))
+    wb = wire_bytes(rows)
+    if wb is not None:
+        stats.update(wb)
     split = backend_split(rows)
     if split:
         stats["backends"] = dict(sorted(split.items()))
     return stats
+
+
+def wire_bytes(rows: Sequence[RequestRow]) -> Optional[Dict]:
+    """Wire-byte summary over ok rows (None when nothing was counted —
+    rows recorded by a pre-wire client).  ``wire_bytes_per_pair`` is the
+    round-trip mean (request body + response body), the number the SLO
+    verdict states alongside latency (docs/wire_format.md)."""
+    ok = [r for r in rows
+          if r.outcome == "ok" and (r.bytes_sent or r.bytes_received)]
+    if not ok:
+        return None
+    total = sum(r.bytes_sent + r.bytes_received for r in ok)
+    return {
+        "wire_format": ok[0].wire,
+        "wire_bytes_per_pair": round(total / len(ok), 1),
+        "wire_mb_sent": round(sum(r.bytes_sent for r in ok) / 2 ** 20, 3),
+        "wire_mb_received": round(sum(r.bytes_received for r in ok)
+                                  / 2 ** 20, 3),
+    }

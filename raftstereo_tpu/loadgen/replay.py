@@ -51,6 +51,11 @@ class ReplayConfig:
     pair_seed: int = 0
     pool_size: int = 4      # distinct pairs per resolution
     speed: float = 1.0      # >1 replays the trace faster than recorded
+    # /predict dialect (docs/wire_format.md): "binary" wire frames or
+    # the legacy base64 "json" — replaying the SAME trace under both is
+    # how the SLO harness states the wire-bytes/pair reduction.
+    wire_format: str = "binary"
+    response_encoding: str = "f32"  # binary replies: bitwise | int16
     # Upper bound on waiting for a session predecessor before the frame
     # is recorded as an error (a crashed predecessor worker must not
     # hang the replay).
@@ -166,7 +171,16 @@ def replay(events: Sequence[TraceEvent], cfg: ReplayConfig,
                    tier=ev.tier or "default", priority=ev.priority or "",
                    deadline_ms=ev.deadline_ms, iters=ev.iters,
                    height=ev.height, width=ev.width,
-                   session=ev.session or "", seq_no=ev.seq_no)
+                   session=ev.session or "", seq_no=ev.seq_no,
+                   wire=client.wire_format)
+        sent0, recv0 = client.bytes_sent, client.bytes_received
+
+        def used() -> Dict[str, int]:
+            # Byte deltas are per-request because each worker owns its
+            # client (the counters are never shared across threads).
+            return dict(bytes_sent=client.bytes_sent - sent0,
+                        bytes_received=client.bytes_received - recv0)
+
         if not gated:
             recorder.add(RequestRow(outcome="error", latency_ms=math.nan,
                                     **row))
@@ -183,10 +197,11 @@ def replay(events: Sequence[TraceEvent], cfg: ReplayConfig,
             outcome = {503: "shed", 504: "timeout"}.get(e.status, "error")
             recorder.add(RequestRow(
                 outcome=outcome, latency_ms=(time.perf_counter() - t0) * 1e3,
-                status=e.status, request_id=e.request_id or "", **row))
+                status=e.status, request_id=e.request_id or "",
+                **used(), **row))
         except Exception:
             recorder.add(RequestRow(outcome="error", latency_ms=math.nan,
-                                    **row))
+                                    **used(), **row))
         else:
             latency_ms = (time.perf_counter() - t0) * 1e3
             hit = None
@@ -198,14 +213,17 @@ def replay(events: Sequence[TraceEvent], cfg: ReplayConfig,
                 warm=meta.get("warm"),
                 degraded=bool(meta.get("degraded", False)),
                 backend=meta.get("backend", ""),
-                request_id=meta.get("request_id") or "", **row))
+                request_id=meta.get("request_id") or "",
+                **used(), **row))
             if on_result is not None:
                 with result_lock:
                     on_result(ev, disparity, meta)
 
     def worker():
         client = ServeClient(cfg.host, cfg.port, timeout=cfg.timeout_s,
-                             retries=cfg.retries)
+                             retries=cfg.retries,
+                             wire_format=cfg.wire_format,
+                             response_encoding=cfg.response_encoding)
         try:
             while True:
                 ev = claim()

@@ -31,7 +31,7 @@ import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..obs.prom import parse_text
-from .records import RequestRow, percentile
+from .records import RequestRow, percentile, wire_bytes
 
 __all__ = ["SLOClass", "SLOSpec", "evaluate"]
 
@@ -109,7 +109,8 @@ _DELTA_FAMILIES = (
     "serve_errors_total", "serve_tier_requests_total",
     "stream_warm_frames_total", "stream_cold_frames_total",
     "sched_early_exits_total", "cluster_dispatch_total",
-    "loadgen_requests_total",
+    "loadgen_requests_total", "wire_bytes_total",
+    "cluster_wire_stream_bytes_total",
 )
 
 
@@ -225,6 +226,13 @@ def evaluate(spec: SLOSpec, rows: Sequence[RequestRow], *,
         "metrics": {"validator_errors": validator_errors,
                     "deltas": deltas},
     }
+    # Wire-bytes/pair rides along whenever the client counted bytes:
+    # the SLO statement is "N chips serve M users at SLO at B bytes/pair"
+    # (docs/wire_format.md) — replaying the same trace under json vs
+    # binary makes the reduction a verdict-level number, not a guess.
+    wb = wire_bytes(rows)
+    if wb is not None:
+        verdict["wire"] = wb
     if retraces is not None:
         verdict["retraces"] = retraces
     return verdict

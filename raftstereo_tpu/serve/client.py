@@ -27,6 +27,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import wire
 from ..loadgen.records import Recorder, RequestRow, summarize
 from ..utils.backoff import backoff_delay
 from .server import decode_array, encode_array
@@ -89,16 +90,39 @@ class ServeClient:
     timeouts are NEVER retried: the server may still be computing and a
     resend would double the work and the wait.  Default ``retries=0``
     preserves the historical fail-fast behaviour.
+
+    ``wire_format`` picks the /predict dialect: ``"binary"`` (default —
+    wire frames both ways, docs/wire_format.md) or ``"json"`` (the
+    base64 dialect; the ``--json`` opt-out in cli.serve / cli.loadgen).
+    ``response_encoding="int16"`` asks a binary server for the
+    fixed-point disparity encoding; the exactness manifest arrives as
+    ``meta["wire_manifest"]``.  ``bytes_sent``/``bytes_received`` count
+    /predict body bytes both ways (the wire-bytes/pair signal the SLO
+    harness reports).
     """
 
     def __init__(self, host: str, port: int, timeout: float = 120.0,
                  retries: int = 0, retry_backoff_ms: float = 100.0,
-                 retry_statuses: Tuple[int, ...] = (502, 503)):
+                 retry_statuses: Tuple[int, ...] = (502, 503),
+                 wire_format: str = "binary",
+                 response_encoding: str = "f32",
+                 compress: bool = True, compress_level: int = 1):
         self._conn = http.client.HTTPConnection(host, port, timeout=timeout)
         assert retries >= 0, retries
+        assert wire_format in ("binary", "json"), wire_format
+        assert response_encoding in ("f32", "int16"), response_encoding
         self.retries = retries
         self.retry_backoff_ms = retry_backoff_ms
         self.retry_statuses = tuple(retry_statuses)
+        self.wire_format = wire_format
+        self.response_encoding = response_encoding
+        self.compress = compress
+        # Level 1 by default: the shuffle filter does most of the ratio
+        # work (docs/wire_format.md "Compression"), and client-side CPU
+        # is the load generator's scarce resource.
+        self.compress_level = compress_level
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
     def close(self) -> None:
         self._conn.close()
@@ -107,15 +131,16 @@ class ServeClient:
         time.sleep(backoff_delay(self.retry_backoff_ms, attempt))
 
     def _request(self, method: str, path: str,
-                 body: Optional[bytes] = None
+                 body: Optional[bytes] = None,
+                 headers: Optional[Dict[str, str]] = None
                  ) -> Tuple[int, bytes, Dict[str, str]]:
         last_exc: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             if attempt:
                 self._backoff(attempt - 1)
             try:
-                status, raw, headers = self._request_once(method, path,
-                                                          body)
+                status, raw, headers_out = self._request_once(
+                    method, path, body, headers)
             except socket.timeout:
                 raise  # never resend: the server may still be computing
             except _RetrySafe as e:
@@ -130,13 +155,16 @@ class ServeClient:
                 continue
             if status in self.retry_statuses and attempt < self.retries:
                 continue
-            return status, raw, headers
+            return status, raw, headers_out
         raise last_exc
 
     def _request_once(self, method: str, path: str,
-                      body: Optional[bytes] = None
+                      body: Optional[bytes] = None,
+                      headers: Optional[Dict[str, str]] = None
                       ) -> Tuple[int, bytes, Dict[str, str]]:
-        headers = {"Content-Type": "application/json"} if body else {}
+        if headers is None:
+            headers = ({"Content-Type": "application/json"} if body
+                       else {})
         try:
             self._conn.request(method, path, body=body, headers=headers)
         except (http.client.HTTPException, ConnectionError, OSError):
@@ -215,29 +243,71 @@ class ServeClient:
         ``--spatial_buckets`` cover it (the cap auto-sizes to those
         buckets), not a retry.
         """
-        payload = {"left": encode_array(np.asarray(left, np.float32)),
-                   "right": encode_array(np.asarray(right, np.float32))}
+        fields: Dict = {}
         if iters is not None:
-            payload["iters"] = int(iters)
+            fields["iters"] = int(iters)
         if accuracy is not None:
-            payload["accuracy"] = str(accuracy)
+            fields["accuracy"] = str(accuracy)
         if spatial is not None:
-            payload["spatial"] = bool(spatial)
+            fields["spatial"] = bool(spatial)
         if deadline_ms is not None:
-            payload["deadline_ms"] = float(deadline_ms)
+            fields["deadline_ms"] = float(deadline_ms)
         if priority is not None:
-            payload["priority"] = str(priority)
+            fields["priority"] = str(priority)
         if session_id is not None:
-            payload["session_id"] = str(session_id)
+            fields["session_id"] = str(session_id)
             if seq_no is not None:
-                payload["seq_no"] = int(seq_no)
-        status, body, headers = self._request(
-            "POST", "/predict", json.dumps(payload).encode())
-        data = json.loads(body)
+                fields["seq_no"] = int(seq_no)
+        use_binary = self.wire_format == "binary"
+        if use_binary:
+            if self.response_encoding != "f32" or not self.compress:
+                fields["response"] = {"encoding": self.response_encoding,
+                                      "compress": self.compress}
+            try:
+                body = wire.encode_request(
+                    np.asarray(left, np.float32),
+                    np.asarray(right, np.float32), fields,
+                    compress=self.compress, level=self.compress_level)
+            except wire.WireError:
+                # A pair the frame format cannot carry (e.g. mismatched
+                # shapes) must still reach the server so its validation
+                # answers — the dialect choice must not change error
+                # semantics. Fall back to JSON for this request.
+                use_binary = False
+                fields.pop("response", None)
+            else:
+                req_headers = {
+                    "Content-Type": wire.WIRE_CONTENT_TYPE,
+                    # Errors are always JSON (wire/negotiate.py):
+                    # accept both.
+                    "Accept": f"{wire.WIRE_CONTENT_TYPE}, "
+                              "application/json",
+                }
+        if not use_binary:
+            payload = dict(fields)
+            payload["left"] = encode_array(np.asarray(left, np.float32))
+            payload["right"] = encode_array(np.asarray(right, np.float32))
+            body = json.dumps(payload).encode()
+            req_headers = {"Content-Type": "application/json"}
+        self.bytes_sent += len(body)
+        status, resp, headers = self._request("POST", "/predict", body,
+                                              headers=req_headers)
+        self.bytes_received += len(resp)
         if status != 200:
+            # Error replies are JSON in both dialects.
+            data = json.loads(resp)
             raise ServeError(status, data,
                              request_id=headers.get("X-Request-Id"))
-        meta = data["meta"]
+        if wire.is_wire_content_type(headers.get("Content-Type")):
+            res = wire.decode_response(resp)
+            disparity, meta = res.disparity, dict(res.meta)
+            if res.manifest is not None:
+                # Exactness certificate for the int16 encoding
+                # (docs/wire_format.md "int16 manifest").
+                meta.setdefault("wire_manifest", res.manifest)
+        else:
+            data = json.loads(resp)
+            disparity, meta = decode_array(data["disparity"]), data["meta"]
         # The server already puts request_id in meta; the header is
         # authoritative (and present on error replies too).
         meta.setdefault("request_id", headers.get("X-Request-Id"))
@@ -245,7 +315,7 @@ class ServeClient:
             # Talking through the cluster router: which backend answered
             # (docs/serving.md "Cluster").
             meta.setdefault("backend", headers["X-Backend"])
-        return decode_array(data["disparity"]), meta
+        return disparity, meta
 
     def _get_json(self, path: str) -> Dict:
         status, body, _ = self._request("GET", path)
@@ -304,7 +374,9 @@ def run_load(host: str, port: int,
              iters: Optional[int] = None,
              sequence_len: Optional[int] = None,
              timeout: float = 120.0, retries: int = 0,
-             accuracy: Optional[str] = None) -> Dict:
+             accuracy: Optional[str] = None,
+             wire_format: str = "binary",
+             response_encoding: str = "f32") -> Dict:
     """Drive ``requests`` pairs at the server; returns a stats dict.
 
     ``make_pair(i)`` supplies the i-th request's images (mix shapes to
@@ -324,6 +396,12 @@ def run_load(host: str, port: int,
     session's frames must arrive in order), and the stats grow
     ``warm_frames``/``cold_frames`` from the response meta — a quick check
     that warm starts actually engaged.
+
+    ``wire_format`` selects the /predict dialect per ``ServeClient``
+    (binary wire frames by default, ``"json"`` for the base64 dialect);
+    the summary then carries ``wire_bytes_per_pair`` —
+    request + response body bytes per served pair — so the two formats
+    are directly comparable on the same traffic (docs/wire_format.md).
 
     Implementation rides the SLO harness's recorder
     (raftstereo_tpu/loadgen/records.py): one ``RequestRow`` per request,
@@ -376,7 +454,14 @@ def run_load(host: str, port: int,
                       send_lag_ms=lag_ms, tier=accuracy or "default",
                       iters=iters, height=int(left.shape[0]),
                       width=int(left.shape[1]),
-                      session=session or "", seq_no=seq)
+                      session=session or "", seq_no=seq,
+                      wire=client.wire_format)
+        sent0, recv0 = client.bytes_sent, client.bytes_received
+
+        def used() -> Dict:
+            return dict(bytes_sent=client.bytes_sent - sent0,
+                        bytes_received=client.bytes_received - recv0)
+
         t0 = time.perf_counter()
         try:
             _, meta = client.predict(left, right, iters=iters,
@@ -386,10 +471,11 @@ def run_load(host: str, port: int,
             kind = {503: "shed", 504: "timeout"}.get(e.status, "error")
             recorder.add(RequestRow(
                 outcome=kind, latency_ms=(time.perf_counter() - t0) * 1e3,
-                status=e.status, request_id=e.request_id or "", **fields))
+                status=e.status, request_id=e.request_id or "",
+                **used(), **fields))
         except Exception:
             recorder.add(RequestRow(outcome="error", latency_ms=math.nan,
-                                    **fields))
+                                    **used(), **fields))
         else:
             recorder.add(RequestRow(
                 outcome="ok",
@@ -398,10 +484,13 @@ def run_load(host: str, port: int,
                 warm=meta.get("warm"),
                 degraded=bool(meta.get("degraded", False)),
                 backend=meta.get("backend", ""),
-                request_id=meta.get("request_id") or "", **fields))
+                request_id=meta.get("request_id") or "",
+                **used(), **fields))
 
     def worker():
-        client = ServeClient(host, port, timeout=timeout, retries=retries)
+        client = ServeClient(host, port, timeout=timeout, retries=retries,
+                             wire_format=wire_format,
+                             response_encoding=response_encoding)
         try:
             while True:
                 start = claim()

@@ -60,11 +60,12 @@ from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, quote, urlparse
 
+from ... import wire
 from ...config import RouterConfig
 from ...obs import Tracer, build_info, dump_threads, trace_response
 from ...ops.autoscale import Autoscaler, load_capacity_model
 from ...utils.backoff import backoff_delay
-from ..httpbase import JsonRequestHandler
+from ..httpbase import WIRE_CHUNK, JsonRequestHandler
 from ..metrics import ClusterMetrics, MetricsRegistry
 from .pins import PinTable
 
@@ -340,6 +341,13 @@ class _RouterHandler(JsonRequestHandler):
     def do_POST(self):
         rt: "StereoRouter" = self.server
         path = urlparse(self.path).path
+        if path == "/predict" and wire.is_wire_content_type(
+                self.headers.get("Content-Type")):
+            # Binary frames stream through without full-body buffering —
+            # the whole point of the wire format at router scale
+            # (docs/wire_format.md "Router forwarding").
+            self._predict_stream(rt)
+            return
         raw = self._read_body(rt.config.max_body_mb)
         if raw is None:
             return
@@ -365,8 +373,68 @@ class _RouterHandler(JsonRequestHandler):
             self._json(400, {"error": f"bad request: {e}"},
                        {"X-Request-Id": rid})
             return
-        status, body, headers = rt.route_predict(raw, session_id, rid)
-        self._send(status, body, "application/json", headers)
+        status, body, ctype, headers = rt.route_predict(
+            raw, session_id, rid, accept=self.headers.get("Accept"))
+        self._send(status, body, ctype, headers)
+
+    def _predict_stream(self, rt: "StereoRouter") -> None:
+        """Binary /predict: peek the fixed header + JSON meta (bounded,
+        small — the session pin needs ``session_id``), then hand the
+        connection to ``route_predict_stream`` which pumps the remaining
+        planes rfile -> backend socket in WIRE_CHUNK slices.  The full
+        body never exists in router memory."""
+        rid = (self.headers.get("X-Request-Id") or "")[:64] \
+            or rt.tracer.new_trace_id()
+        reject = self._reject_body(rt.config.max_body_mb)
+        if reject is not None:
+            code, payload = reject
+            self._json(code, payload, {"X-Request-Id": rid})
+            return
+        length = self._body_length
+
+        def bad(msg: str) -> None:
+            # The body is partially read: nothing further on this
+            # connection can be framed.
+            self.close_connection = True
+            self._json(400, {"error": msg}, {"X-Request-Id": rid})
+
+        if length < wire.HEADER_SIZE:
+            bad(f"body too short for a wire frame ({length} bytes)")
+            return
+        parts: List[bytes] = []
+        if not self._read_body_stream(wire.HEADER_SIZE, parts.append):
+            return  # short read: connection already marked close
+        head = b"".join(parts)
+        try:
+            hdr = wire.parse_header(
+                head, expect=wire.FRAME_REQUEST,
+                max_payload_bytes=int(rt.config.max_body_mb * 2 ** 20) * 8)
+        except wire.WireError as e:
+            # WireVersionError rides through str(e) naming the
+            # supported range — same 400 contract as the backend.
+            bad(str(e))
+            return
+        meta_len = hdr["meta_len"]
+        if wire.HEADER_SIZE + meta_len > length:
+            bad("meta_len overruns Content-Length")
+            return
+        meta_parts: List[bytes] = []
+        if meta_len and not self._read_body_stream(meta_len,
+                                                   meta_parts.append):
+            return
+        meta_raw = b"".join(meta_parts)
+        session_id = None
+        if meta_raw:
+            try:
+                meta = json.loads(meta_raw)
+                session_id = (meta.get("fields") or {}).get("session_id")
+            except Exception as e:
+                bad(f"bad frame meta: {e}")
+                return
+        rt.route_predict_stream(self, head + meta_raw,
+                                length - wire.HEADER_SIZE - meta_len,
+                                session_id, rid,
+                                accept=self.headers.get("Accept"))
 
 
 class StereoRouter(ThreadingHTTPServer):
@@ -400,6 +468,12 @@ class StereoRouter(ThreadingHTTPServer):
         # import guard makes the race safe, the marker makes it cheap).
         self._migrate_lock = threading.Lock()
         self._migrating = set()  # guarded_by: _migrate_lock
+        # Streaming-forward instrumentation (stream_stats / the
+        # no-full-buffering assertion in tests): peak is the largest
+        # single chunk the binary path ever staged, NOT a body size.
+        self._stream_lock = threading.Lock()
+        self._stream_requests = 0  # guarded_by: _stream_lock
+        self._stream_peak_chunk = 0  # guarded_by: _stream_lock
         capacity = (load_capacity_model(config.capacity_model)
                     if config.capacity_model else None)
         self._autoscaler = Autoscaler(capacity=capacity,
@@ -508,15 +582,15 @@ class StereoRouter(ThreadingHTTPServer):
         outcome = "cold_lost"
         if src is not None and src.bid != dst.bid:
             try:
-                status, wire = _http_json(
+                status, snapshot = _http_json(
                     src.host, src.port, "GET",
                     "/debug/sessions/" + quote(session_id, safe=""),
                     timeout=self.config.probe_timeout_s)
-                if status == 200 and wire:
+                if status == 200 and snapshot:
                     status2, reply = _http_json(
                         dst.host, dst.port, "POST", "/debug/sessions",
                         timeout=self.config.probe_timeout_s,
-                        body=json.dumps(wire).encode(),
+                        body=json.dumps(snapshot).encode(),
                         headers={"Content-Type": "application/json"})
                     if status2 == 200:
                         outcome = str(reply.get("outcome", "cold_lost"))
@@ -601,44 +675,54 @@ class StereoRouter(ThreadingHTTPServer):
     def autoscale_advice(self) -> Dict[str, object]:
         return self._advice
 
-    def _forward(self, backend: Backend, raw: bytes, rid: str
-                 ) -> Tuple[str, int, bytes, Dict[str, str]]:
-        """One proxy attempt.  Returns (phase, status, body, headers):
-        phase ``"ok"`` carries a backend reply; ``"connect"`` failed
-        before the request reached the backend (always safe to retry);
-        ``"response"`` failed after (only idempotent work may retry);
-        ``"timeout"`` means the backend may still be computing."""
+    def _forward(self, backend: Backend, raw: bytes, rid: str,
+                 accept: Optional[str] = None
+                 ) -> Tuple[str, int, bytes, str, Dict[str, str]]:
+        """One proxy attempt.  Returns (phase, status, body, ctype,
+        headers): phase ``"ok"`` carries a backend reply; ``"connect"``
+        failed before the request reached the backend (always safe to
+        retry); ``"response"`` failed after (only idempotent work may
+        retry); ``"timeout"`` means the backend may still be computing.
+        The client's ``Accept`` forwards verbatim so the BACKEND decides
+        the response dialect — the router relays bytes, it never
+        negotiates."""
         conn = http.client.HTTPConnection(
             backend.host, backend.port,
             timeout=self.config.request_timeout_s)
+        headers_out = {"Content-Type": "application/json",
+                       "X-Request-Id": rid}
+        if accept:
+            headers_out["Accept"] = accept
         try:
             try:
                 conn.request("POST", "/predict", body=raw,
-                             headers={"Content-Type": "application/json",
-                                      "X-Request-Id": rid})
+                             headers=headers_out)
             except OSError:
                 backend.mark_unreachable()
-                return "connect", 0, b"", {}
+                return "connect", 0, b"", "application/json", {}
             try:
                 resp = conn.getresponse()
                 body = resp.read()
             except socket.timeout:
-                return "timeout", 0, b"", {}
+                return "timeout", 0, b"", "application/json", {}
             except (http.client.HTTPException, OSError):
                 backend.mark_unreachable()
-                return "response", 0, b"", {}
+                return "response", 0, b"", "application/json", {}
             headers = {"X-Request-Id": resp.headers.get("X-Request-Id",
                                                         rid),
                        "X-Backend": backend.name}
-            return "ok", resp.status, body, headers
+            ctype = resp.headers.get("Content-Type", "application/json")
+            return "ok", resp.status, body, ctype, headers
         finally:
             conn.close()
 
     def route_predict(self, raw: bytes, session_id: Optional[str],
-                      rid: str) -> Tuple[int, bytes, Dict[str, str]]:
+                      rid: str, accept: Optional[str] = None
+                      ) -> Tuple[int, bytes, str, Dict[str, str]]:
         """Pick a backend and proxy; bounded failover for cold requests.
         Never blocks without a timeout and never retries work that may
-        have executed unless it is idempotent (cold inference)."""
+        have executed unless it is idempotent (cold inference).
+        Returns (status, body, content_type, headers)."""
         cfg = self.config
         t0 = time.perf_counter()
         is_session = session_id is not None
@@ -669,8 +753,8 @@ class StereoRouter(ThreadingHTTPServer):
             backend.begin()
             t_fwd = time.perf_counter()
             try:
-                phase, status, body, headers = self._forward(backend, raw,
-                                                             rid)
+                phase, status, body, ctype, headers = self._forward(
+                    backend, raw, rid, accept)
             finally:
                 backend.end()
             self.tracer.record(
@@ -708,7 +792,7 @@ class StereoRouter(ThreadingHTTPServer):
                                    attrs={"backend": backend.name,
                                           "attempts": attempt + 1,
                                           "status": status})
-                return status, body, headers
+                return status, body, ctype, headers
             if phase == "timeout":
                 # The backend may still be computing: a blind retry would
                 # run inference twice AND double the client's wait.
@@ -717,7 +801,7 @@ class StereoRouter(ThreadingHTTPServer):
                     {"error": "timeout",
                      "detail": f"backend {backend.name} exceeded "
                                f"{cfg.request_timeout_s}s"}).encode(), \
-                    {"X-Request-Id": rid}
+                    "application/json", {"X-Request-Id": rid}
             if phase == "response" and is_session:
                 # The frame may have executed; a duplicate would advance
                 # the session state.  Fail clean, client decides.
@@ -726,7 +810,8 @@ class StereoRouter(ThreadingHTTPServer):
                     {"error": "unavailable",
                      "detail": f"backend {backend.name} failed "
                                f"mid-frame; session state unknown"}
-                ).encode(), {"X-Request-Id": rid, "Retry-After": "1"}
+                ).encode(), "application/json", \
+                    {"X-Request-Id": rid, "Retry-After": "1"}
             # connect-phase failure (any request), or response-phase
             # failure of an idempotent cold request: fail over.
             self._record(backend, "connect_error" if phase == "connect"
@@ -738,8 +823,216 @@ class StereoRouter(ThreadingHTTPServer):
                                   "detail": detail})
         return 503, json.dumps(
             {"error": "unavailable", "detail": detail,
-             "attempts": len(tried)}).encode(), \
+             "attempts": len(tried)}).encode(), "application/json", \
             {"X-Request-Id": rid, "Retry-After": "1"}
+
+    # -------------------------------------------------- binary streaming
+
+    def route_predict_stream(self, handler, prefix: bytes,
+                             remaining: int, session_id: Optional[str],
+                             rid: str,
+                             accept: Optional[str] = None) -> None:
+        """Forward a binary /predict without ever holding the full body.
+
+        ``prefix`` is the already-peeked header + meta block (needed for
+        session routing); ``remaining`` is how many body bytes are still
+        unread on ``handler.rfile``.  The body is pumped to the chosen
+        backend in ``WIRE_CHUNK`` slices and the response is relayed the
+        same way, so the router's peak buffering per request stays at
+        one chunk regardless of pair size — the whole point of routing a
+        spatial-bucket body through a 64 KiB window.
+
+        Failover is connect-phase only: once a single payload byte has
+        been consumed from the client socket it cannot be replayed, so
+        any later failure answers the client directly (503/504) after
+        draining what the client is still sending, leaving keep-alive in
+        a defined state.  Replies are written straight to ``handler``;
+        this method returns nothing.
+        """
+        cfg = self.config
+        t0 = time.perf_counter()
+        is_session = session_id is not None
+        attempts = cfg.retries + 1
+        tried: List[int] = []
+        detail = "no ready backend"
+        conn = None
+        backend = None
+        for attempt in range(attempts):
+            if is_session:
+                backend = self._pin_backend(str(session_id),
+                                            exclude=tuple(tried))
+            else:
+                cands = self._ready_backends(exclude=tuple(tried))
+                backend = cands[0] if cands else None
+            if backend is None:
+                break
+            tried.append(backend.bid)
+            if attempt:
+                time.sleep(backoff_delay(cfg.retry_backoff_ms,
+                                         attempt - 1))
+            conn = http.client.HTTPConnection(
+                backend.host, backend.port,
+                timeout=cfg.request_timeout_s)
+            try:
+                conn.putrequest("POST", "/predict")
+                conn.putheader("Content-Type", wire.WIRE_CONTENT_TYPE)
+                conn.putheader("Content-Length",
+                               str(len(prefix) + remaining))
+                conn.putheader("X-Request-Id", rid)
+                if accept:
+                    conn.putheader("Accept", accept)
+                conn.endheaders()
+                conn.send(prefix)
+            except OSError:
+                backend.mark_unreachable()
+                self._record(backend, "connect_error")
+                detail = f"backend {backend.name} connect failure"
+                conn.close()
+                conn = None
+                continue
+            break
+        if conn is None or backend is None:
+            self.refresh_gauges()
+            self._json_reply(handler, 503,
+                             {"error": "unavailable", "detail": detail,
+                              "attempts": len(tried)},
+                             {"X-Request-Id": rid, "Retry-After": "1"})
+            return
+        # Past this point the client body starts draining; no failover.
+        backend.begin()
+        t_fwd = time.perf_counter()
+        sent = len(prefix)
+        peak = len(prefix)
+        try:
+            try:
+                left = remaining
+                while left:
+                    chunk = handler.rfile.read(min(WIRE_CHUNK, left))
+                    if not chunk:
+                        # Client hung up mid-body; nothing sane to reply.
+                        handler.close_connection = True
+                        self._record(backend, "error")
+                        return
+                    conn.send(chunk)
+                    left -= len(chunk)
+                    sent += len(chunk)
+                    peak = max(peak, len(chunk))
+            except (socket.timeout, OSError):
+                backend.mark_unreachable()
+                self._record(backend, "error")
+                self._drain_client(handler, left)
+                self._json_reply(
+                    handler, 503,
+                    {"error": "unavailable",
+                     "detail": f"backend {backend.name} failed "
+                               f"mid-stream"},
+                    {"X-Request-Id": rid, "Retry-After": "1"})
+                return
+            try:
+                resp = conn.getresponse()
+            except socket.timeout:
+                self._record(backend, "timeout")
+                self._json_reply(
+                    handler, 504,
+                    {"error": "timeout",
+                     "detail": f"backend {backend.name} exceeded "
+                               f"{cfg.request_timeout_s}s"},
+                    {"X-Request-Id": rid})
+                return
+            except (http.client.HTTPException, OSError):
+                backend.mark_unreachable()
+                self._record(backend, "error")
+                self._json_reply(
+                    handler, 503,
+                    {"error": "unavailable",
+                     "detail": f"backend {backend.name} failed "
+                               f"mid-stream"},
+                    {"X-Request-Id": rid, "Retry-After": "1"})
+                return
+            self._record(backend, {200: "ok", 503: "shed",
+                                   504: "timeout"}.get(resp.status,
+                                                       "error"))
+            self.cluster_metrics.router_latency.observe(t_fwd - t0)
+            received = self._relay_response(handler, resp, backend, rid)
+            peak = max(peak, min(received, WIRE_CHUNK))
+            with self._stream_lock:
+                self._stream_requests += 1
+                self._stream_peak_chunk = max(self._stream_peak_chunk,
+                                              peak)
+                peak_seen = self._stream_peak_chunk
+            m = self.cluster_metrics
+            m.wire_stream_bytes.labels(direction="in").inc(sent)
+            m.wire_stream_bytes.labels(direction="out").inc(received)
+            m.wire_stream_peak_chunk.set(peak_seen)
+            self.tracer.record(
+                "route", t0, time.perf_counter(), rid,
+                attrs={"backend": backend.name, "attempts": len(tried),
+                       "status": resp.status, "stream": True,
+                       "bytes_in": sent, "bytes_out": received})
+        finally:
+            backend.end()
+            conn.close()
+
+    def _relay_response(self, handler, resp, backend: Backend,
+                        rid: str) -> int:
+        """Relay a backend reply chunk-at-a-time; returns body bytes."""
+        length = resp.headers.get("Content-Length")
+        handler.send_response(resp.status)
+        handler.send_header("Content-Type",
+                            resp.headers.get("Content-Type",
+                                             "application/json"))
+        if length is not None:
+            handler.send_header("Content-Length", length)
+        handler.send_header("X-Request-Id",
+                            resp.headers.get("X-Request-Id", rid))
+        handler.send_header("X-Backend", backend.name)
+        handler.end_headers()
+        received = 0
+        while True:
+            chunk = resp.read(WIRE_CHUNK)
+            if not chunk:
+                break
+            handler.wfile.write(chunk)
+            received += len(chunk)
+        return received
+
+    @staticmethod
+    def _drain_client(handler, left: int) -> None:
+        """Swallow the rest of a client body after a mid-stream backend
+        failure so the error reply lands on a keep-alive connection in a
+        defined state (mirrors httpbase's short-read discipline)."""
+        try:
+            while left:
+                chunk = handler.rfile.read(min(WIRE_CHUNK, left))
+                if not chunk:
+                    handler.close_connection = True
+                    return
+                left -= len(chunk)
+        except OSError:
+            handler.close_connection = True
+
+    @staticmethod
+    def _json_reply(handler, status: int, obj: Dict,
+                    headers: Dict[str, str]) -> None:
+        """Router-originated error reply (errors are ALWAYS JSON —
+        docs/wire_format.md negotiation matrix)."""
+        body = json.dumps(obj).encode()
+        handler.send_response(status)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            handler.send_header(k, v)
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def stream_stats(self) -> Dict[str, int]:
+        """Instrumentation for the no-full-buffering assertion: the
+        largest single buffer the streaming path ever held is
+        ``peak_chunk_bytes`` — tests pin it to ``WIRE_CHUNK`` while
+        pushing spatial-bucket-sized bodies through."""
+        with self._stream_lock:
+            return {"requests": self._stream_requests,
+                    "peak_chunk_bytes": self._stream_peak_chunk}
 
 
 def build_router(config: RouterConfig,

@@ -2,18 +2,26 @@
 
 Both the single-server front-end (``serve/server.py``) and the cluster
 router (``serve/cluster/router.py``) speak the same small dialect:
-JSON replies with explicit Content-Length (keep-alive), and a bounded
-Content-Length check before any body is buffered.  One base class keeps
-the two handlers byte-identical on that dialect — a fix to the body-cap
-or header logic lands in both.
+JSON (or binary wire-frame, docs/wire_format.md) replies with explicit
+Content-Length (keep-alive), and a bounded Content-Length check before
+any body is buffered.  One base class keeps the two handlers
+byte-identical on that dialect — a fix to the body-cap or header logic
+lands in both.
 
 The body cap is a POLICY ARGUMENT, not a constant: every call takes
 ``limit_mb`` from the caller's ``ServeConfig.max_body_mb``, which
 auto-raises to fit the largest configured spatial bucket
-(``config.spatial_body_mb`` — a 4K fp32 pair is ~95 MB of base64, far
-over the default cap).  Over-limit requests get an explicit 413 naming
-the limit, never a silent drop: a client sending a bucket-scale pair to
-a server not configured for it must learn which knob to turn.
+(``config.spatial_body_mb`` — a 4K fp32 pair is 253.1 MiB of base64
+JSON body, measured as ``len(json.dumps(payload))`` for a
+3840x2160x3 pair, so the cap lands at ~316 MiB after the 25% decode
+headroom; the binary wire format carries the same pair in under a
+fifth of that).  Over-limit requests get an explicit 413 naming the
+limit, never a silent drop: a client sending a bucket-scale pair to a
+server not configured for it must learn which knob to turn.
+
+Every refusal here carries an ``X-Request-Id`` header: pre-dispatch
+errors (413/411/short reads) happen before the serving layer's tracer sees
+the request, but the reply must still be joinable to client logs.
 
 This module must stay importable without the engine/model stack: the
 router is model-free (see serve/__init__.py's lazy exports).
@@ -23,10 +31,16 @@ from __future__ import annotations
 
 import json
 import logging
+import uuid
 from http.server import BaseHTTPRequestHandler
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
-__all__ = ["JsonRequestHandler"]
+__all__ = ["JsonRequestHandler", "WIRE_CHUNK"]
+
+#: chunk size for the streaming body reader — also the upper bound on
+#: what a streaming consumer (router forward, frame decoder) ever
+#: buffers of the raw body at once.
+WIRE_CHUNK = 64 * 1024
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -38,8 +52,19 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"  # keep-alive: load-gen reuses connections
     _log = logging.getLogger(__name__)
 
+    WIRE_CHUNK = WIRE_CHUNK  # class alias for subclass convenience
+
     def log_message(self, fmt, *args):
         self._log.debug("%s %s", self.address_string(), fmt % args)
+
+    def request_id(self) -> str:
+        """Propagated or fresh request id for THIS request.
+
+        Computed per call, never cached on ``self``: handler instances
+        are REUSED across keep-alive requests, so cached per-request
+        state would leak one request's id into the next."""
+        return (self.headers.get("X-Request-Id") or "")[:64] \
+            or uuid.uuid4().hex
 
     def _send(self, code: int, body: bytes, ctype: str,
               extra_headers: Optional[Dict[str, str]] = None) -> None:
@@ -56,30 +81,83 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(obj).encode(), "application/json",
                    extra_headers)
 
-    def _content_length(self, limit_mb: float) -> Optional[int]:
-        """Parse + bound Content-Length WITHOUT reading the body.
+    def _reject_body(self, limit_mb: float) -> Optional[Tuple[int, Dict]]:
+        """Body-policy gate, applied BEFORE reading a single body byte.
 
-        Returns the length, or None when it is missing/unparseable/over
-        ``limit_mb`` — the connection is then marked for close (refusing
-        before buffering means the unread body can never be drained, so
-        keep-alive would misparse it as the next request line).  The
-        caller sends its own 413."""
+        Returns ``(status, error_payload)`` when the request must be
+        refused — the connection is then marked for close (an unread or
+        unframed body can never be drained, so keep-alive would
+        misparse it as the next request line) — or None to proceed, with
+        the parsed length stashed in ``self._body_length``.
+
+        Refusals:
+
+        * ``Transfer-Encoding`` present -> 411: a chunked body has no
+          Content-Length, would read as length 0 here, and its unread
+          frames would desync the connection.
+        * missing/unparseable/over-limit Content-Length -> 413 naming
+          the limit.
+        """
+        te = (self.headers.get("Transfer-Encoding") or "").strip()
+        if te:
+            self.close_connection = True
+            return 411, {"error": "Transfer-Encoding not supported; "
+                                  "send a Content-Length body",
+                         "transfer_encoding": te}
         try:
             length = int(self.headers.get("Content-Length", 0) or 0)
         except ValueError:
             length = -1
         if length < 0 or length > limit_mb * 2 ** 20:
             self.close_connection = True
+            return 413, {"error": "body too large or bad Content-Length",
+                         "limit_mb": limit_mb}
+        self._body_length = length
+        return None
+
+    def _content_length(self, limit_mb: float) -> Optional[int]:
+        """Parse + bound Content-Length WITHOUT reading the body.
+
+        Returns the length, or None when the body policy refuses it
+        (see ``_reject_body``); the caller sends its own error reply.
+        ``self.body_reject`` then holds the (status, payload) to send."""
+        self.body_reject = self._reject_body(limit_mb)
+        if self.body_reject is not None:
             return None
-        return length
+        return self._body_length
+
+    def _read_body_stream(self, length: int,
+                          sink: Callable[[bytes], None]) -> bool:
+        """Drain exactly ``length`` body bytes in bounded chunks into
+        ``sink(chunk)`` — the streaming read path: the full body never
+        exists in this layer, only one <= WIRE_CHUNK slice at a time.
+
+        Returns False on a short read (client hung up or lied about
+        Content-Length); the connection is marked close — the stream
+        position is undefined, nothing further can be parsed."""
+        remaining = length
+        while remaining:
+            chunk = self.rfile.read(min(self.WIRE_CHUNK, remaining))
+            if not chunk:
+                self.close_connection = True
+                return False
+            remaining -= len(chunk)
+            sink(chunk)
+        return True
 
     def _read_body(self, limit_mb: float) -> Optional[bytes]:
-        """Bounded body read; replies 413 itself and returns None on a
-        bad/oversize Content-Length."""
-        length = self._content_length(limit_mb)
-        if length is None:
-            self._json(413, {"error": "body too large or bad "
-                                      "Content-Length",
-                             "limit_mb": limit_mb})
+        """Bounded whole-body read; replies itself (with an
+        ``X-Request-Id``) and returns None on a policy refusal or a
+        short read."""
+        reject = self._reject_body(limit_mb)
+        if reject is not None:
+            code, payload = reject
+            self._json(code, payload,
+                       {"X-Request-Id": self.request_id()})
             return None
-        return self.rfile.read(length) if length else b""
+        parts = []
+        if not self._read_body_stream(self._body_length, parts.append):
+            self._json(400, {"error": "body shorter than Content-Length"},
+                       {"X-Request-Id": self.request_id()})
+            return None
+        return b"".join(parts)

@@ -324,6 +324,21 @@ class ServeMetrics:
             "engine wall-clock per spatial dispatch (pad + sharded "
             "forward + host fetch); the mesh is exclusive, so this is "
             "also the mesh-busy time per request")
+        # Binary wire format (raftstereo_tpu/wire, docs/wire_format.md).
+        self.wire_bytes = r.counter(
+            "wire_bytes_total",
+            "/predict data-plane bytes by direction (in = request "
+            "bodies, out = 200 response bodies) and format "
+            "(json = base64 dialect, binary = wire frames) — the "
+            "wire-bytes/pair SLO signal is out+in over "
+            "serve_requests_total",
+            labels=("direction", "format"))
+        self.wire_negotiations = r.counter(
+            "wire_negotiations_total",
+            "/predict format negotiations by resolved request dialect "
+            "(Content-Type) and response dialect (Accept; error "
+            "replies are always JSON regardless)",
+            labels=("request", "response"))
 
     def render(self) -> str:
         return self.registry.render()
@@ -397,6 +412,20 @@ class ClusterMetrics:
             "cluster_router_hop_latency_seconds",
             "router-added latency per forwarded request (route pick + "
             "proxying, excluding the backend's own compute)")
+        self.wire_stream_bytes = r.counter(
+            "cluster_wire_stream_bytes_total",
+            "binary /predict bytes relayed chunk-wise by the streaming "
+            "forward path, by direction (in = client->backend request "
+            "bodies including the peeked header+meta prefix, out = "
+            "backend->client response bodies); router only "
+            "(docs/wire_format.md)",
+            labels=("direction",))
+        self.wire_stream_peak_chunk = r.gauge(
+            "cluster_wire_stream_peak_chunk_bytes",
+            "largest single buffer the streaming forward path has held "
+            "for any request — bounded by the 64 KiB pump window no "
+            "matter the pair size, which is the router's "
+            "never-buffers-a-full-body guarantee")
 
     def set_states(self, states: Dict[str, int]) -> None:
         """Overwrite the per-state replica gauge (absent states -> 0, so

@@ -2,10 +2,20 @@
 
 Endpoints:
 
-* ``POST /predict`` — body ``{"left": <array>, "right": <array>,
-  "iters": optional int}``; an ``<array>`` is either a nested JSON list or
-  the compact form ``{"shape": [H, W, 3], "dtype": "float32",
-  "data_b64": "..."}`` (raw little-endian bytes, base64).  ``iters`` must
+* ``POST /predict`` — two request dialects, negotiated per request
+  (docs/wire_format.md):
+
+  - JSON (``Content-Type: application/json``): body ``{"left": <array>,
+    "right": <array>, "iters": optional int}``; an ``<array>`` is either
+    a nested JSON list or the compact form ``{"shape": [H, W, 3],
+    "dtype": "float32", "data_b64": "..."}``.
+  - binary (``Content-Type: application/x-raftstereo-frame``): one wire
+    frame (raftstereo_tpu/wire), decoded chunk-at-a-time straight into
+    plane staging — the request never exists as body + decoded copies
+    at once.  Responses are binary iff the request's ``Accept`` names
+    the wire type; error replies are always JSON.
+
+  ``iters`` must
   be one of the server's configured levels (``iters`` /
   ``degraded_iters`` — those executables are warmed; arbitrary values
   would compile under load).  Replies 200 with ``{"disparity": <array>,
@@ -60,6 +70,7 @@ from urllib.parse import unquote, urlparse
 
 import numpy as np
 
+from .. import wire
 from ..config import ServeConfig
 from ..obs import Tracer, build_info, dump_threads, trace_response
 from ..utils.profiling import OnDemandProfiler, ProfilerBusy
@@ -117,6 +128,31 @@ def wire_to_snapshot(obj: Dict) -> Dict:
     return snap
 
 
+def _response_prefs(obj) -> Dict:
+    """Wire-encode kwargs for a negotiated binary response.
+
+    The optional request field ``response`` selects the disparity
+    encoding: ``{"encoding": "f32"|"int16", "compress": bool}``.
+    Anything unrecognized raises — surfacing as the caller's clean 400,
+    never a mid-encode 500 after inference already ran."""
+    prefs = {"encoding": "f32", "compress": True}
+    if obj is None:
+        return prefs
+    if not isinstance(obj, dict):
+        raise ValueError("response preferences must be an object")
+    unknown = set(obj) - {"encoding", "compress"}
+    if unknown:
+        raise ValueError(
+            f"unknown response preference(s) {sorted(unknown)}")
+    enc = obj.get("encoding", "f32")
+    if enc not in ("f32", "int16"):
+        raise ValueError(
+            f"unknown response encoding {enc!r} (choose f32 or int16)")
+    prefs["encoding"] = enc
+    prefs["compress"] = bool(obj.get("compress", True))
+    return prefs
+
+
 def _outcome(code: int, obj: Dict) -> str:
     """Label value for ``serve_requests_total{outcome=}``."""
     if code == 200:
@@ -125,6 +161,8 @@ def _outcome(code: int, obj: Dict) -> str:
         return "bad_request"
     if code == 404:
         return "not_found"
+    if code == 411:
+        return "length_required"
     if code == 413:
         return "too_large"
     if code == 503:
@@ -138,14 +176,22 @@ class _Handler(JsonRequestHandler):
     server_version = "raftstereo-serve/1.0"
     _log = logger  # request chatter to this module's logger, not stderr
 
+    # Response-format negotiation for the CURRENT /predict request:
+    # None = JSON reply, else the wire-encode kwargs.  Handler instances
+    # are reused across keep-alive requests, so do_POST resets this at
+    # the top of every /predict before any dispatch can read it.
+    _wire_ctx: Optional[Dict] = None
+
     # ------------------------------------------------------------- plumbing
-    # (_send/_json/_content_length come from JsonRequestHandler, shared
+    # (_send/_json/_reject_body come from JsonRequestHandler, shared
     # byte-for-byte with the cluster router's handler.)
     def _finish(self, code: int, obj: Dict, endpoint: str, rid: str,
                 t0: float,
                 extra_headers: Optional[Dict[str, str]] = None) -> None:
-        """Terminal reply for a /predict request: attach the request id,
-        count the labeled outcome, close the root trace span."""
+        """Terminal JSON reply for a /predict request: attach the
+        request id, count the labeled outcome, close the root trace
+        span.  Error replies always land here — whatever was negotiated,
+        an error body stays JSON (wire/negotiate.py)."""
         srv: "StereoServer" = self.server
         if code == 200 and "meta" in obj:
             obj["meta"]["request_id"] = rid
@@ -160,7 +206,33 @@ class _Handler(JsonRequestHandler):
         srv.tracer.record("request", t0, time.perf_counter(), rid,
                           attrs={"endpoint": endpoint, "status": code,
                                  "outcome": outcome})
-        self._json(code, obj, headers)
+        body = json.dumps(obj).encode()
+        if code == 200 and "disparity" in obj:
+            srv.metrics.wire_bytes.labels(
+                direction="out", format="json").inc(len(body))
+        self._send(code, body, "application/json", headers)
+
+    def _finish_ok(self, srv: "StereoServer", disparity: np.ndarray,
+                   meta: Dict, endpoint: str, rid: str,
+                   t0: float) -> None:
+        """Terminal 200 for /predict: encode the disparity in whichever
+        response format this request negotiated (``_wire_ctx``)."""
+        ctx = self._wire_ctx
+        if ctx is None:
+            self._finish(200, {"disparity": encode_array(disparity),
+                               "meta": meta}, endpoint, rid, t0)
+            return
+        meta = dict(meta)
+        meta["request_id"] = rid
+        frame = wire.encode_response(disparity, meta, **ctx)
+        srv.metrics.wire_bytes.labels(
+            direction="out", format="binary").inc(len(frame))
+        srv.metrics.requests.labels(endpoint=endpoint, outcome="ok").inc()
+        srv.tracer.record("request", t0, time.perf_counter(), rid,
+                          attrs={"endpoint": endpoint, "status": 200,
+                                 "outcome": "ok"})
+        self._send(200, frame, wire.WIRE_CONTENT_TYPE,
+                   {"X-Request-Id": rid})
 
     # ------------------------------------------------------------- endpoints
     def do_GET(self):
@@ -338,24 +410,74 @@ class _Handler(JsonRequestHandler):
             or srv.tracer.new_trace_id()
         t_req0 = time.perf_counter()
         endpoint = "predict"
-        # Refuse before buffering (shared body cap; connection marked
-        # close): the reply rides through _finish so the 413 is counted
-        # and traced like every other /predict outcome.
-        length = self._content_length(srv.config.max_body_mb)
-        if length is None:
-            self._finish(413, {"error": "body too large or bad "
-                                        "Content-Length",
-                               "limit_mb": srv.config.max_body_mb},
-                         endpoint, rid, t_req0)
+        # Reset per request — the handler instance is reused across
+        # keep-alive requests, so stale negotiation must never leak.
+        self._wire_ctx = None
+        # Refuse before buffering (shared body cap + chunked-encoding
+        # policy; connection marked close): the reply rides through
+        # _finish so the 411/413 is counted and traced like every other
+        # /predict outcome.
+        reject = self._reject_body(srv.config.max_body_mb)
+        if reject is not None:
+            code, payload = reject
+            if code == 413:
+                payload["limit_mb"] = srv.config.max_body_mb
+            self._finish(code, payload, endpoint, rid, t_req0)
             return
+        length = self._body_length
+        binary_in = wire.is_wire_content_type(
+            self.headers.get("Content-Type"))
+        binary_out = wire.accepts_wire(self.headers.get("Accept"))
+        srv.metrics.wire_bytes.labels(
+            direction="in",
+            format="binary" if binary_in else "json").inc(length)
+        srv.metrics.wire_negotiations.labels(
+            request="binary" if binary_in else "json",
+            response="binary" if binary_out else "json").inc()
         # Bound CONCURRENT buffering, not just per-request size: each
-        # in-flight decode transiently holds body + base64 text + decoded
-        # arrays (~3x the body).  Without this, a handful of parallel
-        # near-limit POSTs OOM the host before queue_limit ever engages.
+        # in-flight JSON decode transiently holds body + base64 text +
+        # decoded arrays (~3x the body); the binary path streams the
+        # body chunk-at-a-time into plane staging (wire.FrameDecoder),
+        # so it holds decoded arrays + one 64 KiB chunk — the slot then
+        # bounds concurrent decoded pairs.  Without this, a handful of
+        # parallel near-limit POSTs OOM the host before queue_limit
+        # ever engages.
+        wire_fields = None
         with srv.decode_slots:
-            # Drain the body BEFORE any reply: under HTTP/1.1 keep-alive,
-            # unread body bytes would be parsed as the next request line.
-            raw = self.rfile.read(length) if length else b""
+            if binary_in:
+                # Decoded planes may legitimately exceed the body byte
+                # count (tile compression, uint8->float32 promotion is
+                # 4x) — cap the allocation a header can demand at 8x
+                # the body cap instead of the raw cap itself.
+                dec = wire.FrameDecoder(
+                    expect=wire.FRAME_REQUEST,
+                    max_payload_bytes=int(
+                        srv.config.max_body_mb * 2 ** 20) * 8)
+                try:
+                    complete = self._read_body_stream(length, dec.feed)
+                except wire.WireError as e:
+                    # Mid-body reject: the unread remainder can never
+                    # be reframed — the connection must close.
+                    self.close_connection = True
+                    prefix = ("" if isinstance(e, wire.WireVersionError)
+                              else "bad wire frame: ")
+                    self._finish(400, {"error": f"{prefix}{e}"},
+                                 endpoint, rid, t_req0)
+                    return
+                raw = b""
+            else:
+                # Drain the body BEFORE any reply: under HTTP/1.1
+                # keep-alive, unread body bytes would be parsed as the
+                # next request line.
+                parts = []
+                complete = self._read_body_stream(length, parts.append)
+                raw = b"".join(parts)
+                del parts
+            if not complete:
+                self._finish(400, {"error": "body shorter than "
+                                            "Content-Length"},
+                             endpoint, rid, t_req0)
+                return
             if self.path != "/predict":
                 self._finish(404, {"error": f"no such path {self.path!r}"},
                              "other", rid, t_req0)
@@ -376,9 +498,18 @@ class _Handler(JsonRequestHandler):
                              endpoint, rid, t_req0, {"Retry-After": "1"})
                 return
             try:
-                payload = json.loads(raw)
-                left = decode_array(payload["left"])
-                right = decode_array(payload["right"])
+                if binary_in:
+                    req = dec.request()
+                    # Mirror decode_array's contract: the engine always
+                    # sees float32 (exact for uint8/int16 payloads).
+                    left = np.ascontiguousarray(req.left, np.float32)
+                    right = np.ascontiguousarray(req.right, np.float32)
+                    payload = req.fields
+                    del dec, req
+                else:
+                    payload = json.loads(raw)
+                    left = decode_array(payload["left"])
+                    right = decode_array(payload["right"])
                 iters = payload.get("iters")
                 session_id = payload.get("session_id")
                 seq_no = payload.get("seq_no")
@@ -386,6 +517,9 @@ class _Handler(JsonRequestHandler):
                 priority = payload.get("priority")
                 accuracy = payload.get("accuracy")
                 spatial = payload.get("spatial")
+                if binary_out:
+                    self._wire_ctx = _response_prefs(
+                        payload.get("response"))
             except Exception as e:
                 srv.end_predict()
                 self._finish(400, {"error": f"bad request: {e}"},
@@ -628,10 +762,8 @@ class _Handler(JsonRequestHandler):
             # metric is the per-tier adoption signal.
             srv.metrics.tier_requests.labels(
                 tier=accuracy or "default").inc()
-            self._finish(200, {
-                "disparity": encode_array(res.disparity),
-                "meta": meta,
-            }, endpoint, rid, t_req0)
+            self._finish_ok(srv, res.disparity, meta, endpoint, rid,
+                            t_req0)
             return
         # Size the HTTP-side wait for what can actually be ahead of this
         # request: one in-flight batch (60 s) — or a cold XLA compile,
@@ -704,10 +836,7 @@ class _Handler(JsonRequestHandler):
         # Counted at the 200 (see the session path): only requests
         # actually served at the tier feed the adoption signal.
         srv.metrics.tier_requests.labels(tier=accuracy or "default").inc()
-        self._finish(200, {
-            "disparity": encode_array(res.disparity),
-            "meta": meta,
-        }, endpoint, rid, t_req0)
+        self._finish_ok(srv, res.disparity, meta, endpoint, rid, t_req0)
 
     def _spatial_dispatch(self, srv: "StereoServer", endpoint, rid, t_req0,
                           left, right, iters) -> None:
@@ -759,8 +888,7 @@ class _Handler(JsonRequestHandler):
         # Spatial serves only the base precision (admission rejects
         # tiers), so the adoption signal lands on the default tier.
         srv.metrics.tier_requests.labels(tier="default").inc()
-        self._finish(200, {"disparity": encode_array(disp), "meta": meta},
-                     endpoint, rid, t_req0)
+        self._finish_ok(srv, disp, meta, endpoint, rid, t_req0)
 
 
 class StereoServer(ThreadingHTTPServer):

@@ -1,0 +1,54 @@
+"""Content negotiation for the binary frame dialect.
+
+The rule set is deliberately tiny (docs/wire_format.md has the full
+matrix):
+
+* A request IS binary iff its ``Content-Type`` is the wire media type.
+* A response is binary iff the request's ``Accept`` header names the
+  wire media type explicitly with a non-zero q.  ``*/*`` (or a missing
+  Accept) does NOT select binary: a JSON-only client that never heard
+  of the format must never receive a frame it cannot parse — wildcard
+  acceptance of an unknown binary type is how negotiation 500s start.
+* Error replies are ALWAYS JSON, whatever was negotiated: an error
+  body must be readable by whatever logged it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["JSON_CONTENT_TYPE", "WIRE_CONTENT_TYPE", "accepts_wire",
+           "is_wire_content_type"]
+
+WIRE_CONTENT_TYPE = "application/x-raftstereo-frame"
+JSON_CONTENT_TYPE = "application/json"
+
+
+def _media_type(token: str) -> str:
+    return token.split(";", 1)[0].strip().lower()
+
+
+def is_wire_content_type(ctype: Optional[str]) -> bool:
+    """True when a Content-Type header selects the binary dialect."""
+    return bool(ctype) and _media_type(ctype) == WIRE_CONTENT_TYPE
+
+
+def accepts_wire(accept: Optional[str]) -> bool:
+    """True when an Accept header explicitly lists the wire media type
+    with q > 0.  Wildcards never match — see the module docstring."""
+    if not accept:
+        return False
+    for token in accept.split(","):
+        if _media_type(token) != WIRE_CONTENT_TYPE:
+            continue
+        q = 1.0
+        for param in token.split(";")[1:]:
+            k, _, v = param.partition("=")
+            if k.strip().lower() == "q":
+                try:
+                    q = float(v.strip())
+                except ValueError:
+                    q = 0.0
+        if q > 0:
+            return True
+    return False

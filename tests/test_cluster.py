@@ -1007,6 +1007,68 @@ class TestRouter:
                     pass
                 th.join(5)
 
+    def test_router_streams_binary_without_buffering(self, cluster_model):
+        """Tentpole assertion (docs/wire_format.md "Router forwarding"):
+        a binary /predict larger than the 64 KiB pump window crosses the
+        router bitwise-correct while the router's peak per-request
+        buffer stays AT OR UNDER one WIRE_CHUNK — instrumented via
+        ``stream_stats()``, so "never buffers the full body" is a
+        measured number, not a code-reading claim.  Also pins the
+        session route off the streamed frame's meta and keeps the legacy
+        JSON dialect working through the same router."""
+        from raftstereo_tpu.serve.httpbase import WIRE_CHUNK
+
+        b0, t0 = self._backend(cluster_model)
+        router = build_router(RouterConfig(
+            port=0, backends=(("127.0.0.1", b0.port),),
+            probe_interval_s=0.15, fail_after=1, retries=1,
+            retry_backoff_ms=20.0, request_timeout_s=60.0))
+        rt = threading.Thread(target=router.serve_forever, daemon=True)
+        rt.start()
+        # Non-integer pixels defeat the codec's uint8-exact demotion and
+        # compress=False keeps the planes raw: two 60x90x3 f32 planes
+        # ≈ 127 KiB of body — comfortably more than one chunk, so a
+        # buffering regression would show up in peak_chunk_bytes
+        # immediately.
+        a = _img(60, 90, 3) + 0.5
+        client = ServeClient("127.0.0.1", router.port, timeout=120,
+                             compress=False)
+        direct = ServeClient("127.0.0.1", b0.port, timeout=120)
+        json_client = ServeClient("127.0.0.1", router.port, timeout=120,
+                                  wire_format="json")
+        try:
+            disp, meta = client.predict(a, a)
+            assert meta["backend"] == "b0"
+            ref, _ = direct.predict(a, a)
+            np.testing.assert_array_equal(disp, ref)
+            assert client.bytes_sent > WIRE_CHUNK  # body spans chunks
+            stats = router.stream_stats()
+            assert stats["requests"] >= 1
+            assert 0 < stats["peak_chunk_bytes"] <= WIRE_CHUNK, stats
+            # Session pinning reads session_id out of the streamed
+            # frame's meta block (never the decoded planes).
+            for seq in range(2):
+                _, m = client.predict(a, a, session_id="scam0", seq_no=seq)
+                assert m["backend"] == "b0"
+            assert router.pin_count() >= 1
+            # JSON dialect through the same router: the relay must hand
+            # back the backend's Content-Type, not assume one.
+            dj, mj = json_client.predict(a, a)
+            np.testing.assert_array_equal(dj, ref)
+            # The stream counters are scrapeable and label by direction.
+            text = router.cluster_metrics.render()
+            assert 'cluster_wire_stream_bytes_total{direction="in"}' \
+                in text
+            assert "cluster_wire_stream_peak_chunk_bytes" in text
+        finally:
+            client.close()
+            direct.close()
+            json_client.close()
+            router.close()
+            rt.join(10)
+            b0.close()
+            t0.join(5)
+
     def test_zero_downtime_restart_and_kill(self, cluster_model,
                                             retrace_guard):
         """THE acceptance gate (ISSUE 13): zero-downtime cluster ops
@@ -1304,7 +1366,7 @@ class TestRouter:
             b0 = router.backends[0]
             with b0._lock:
                 b0.live = b0.ready = True
-            status, body, headers = router.route_predict(
+            status, body, ctype, headers = router.route_predict(
                 json.dumps({"left": [], "right": []}).encode(), None,
                 "rid-1")
             assert status == 200 and headers["X-Backend"] == "b1"
@@ -1321,7 +1383,8 @@ class TestRouter:
                 with b._lock:
                     b.live = b.ready = True
             t0 = time.perf_counter()
-            status, body, _ = router.route_predict(b"{}", None, "rid-2")
+            status, body, _, _ = router.route_predict(b"{}", None,
+                                                      "rid-2")
             assert status == 503
             assert json.loads(body)["error"] == "unavailable"
             assert time.perf_counter() - t0 < 5.0

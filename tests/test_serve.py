@@ -491,3 +491,262 @@ class TestEndToEnd:
         assert record["unit"] == "pairs/sec" and record["value"] > 0
         assert record["p99_ms"] > 0
         assert record["ok"] >= 12 and record["error"] == 0
+        # Dual-dialect measurement (docs/wire_format.md): the record
+        # states the wire-bytes/pair of BOTH formats and the acceptance
+        # floor — binary carries a pair in at least 4x fewer bytes.
+        assert record["wire_format"] == "binary"
+        assert record["json"]["ok"] >= 12
+        assert record["wire_reduction_x"] >= 4.0, record
+
+
+# ------------------------------------------------- binary wire over HTTP
+
+class TestWireHTTP:
+    """The /predict dual dialect end-to-end (docs/wire_format.md) plus
+    the pre-dispatch body-policy edges (411/413/length mismatches) —
+    every case leaves keep-alive in a defined state."""
+
+    @pytest.fixture(scope="class")
+    def wire_server(self, serve_model):
+        model, variables = serve_model
+        cfg = _cfg(iters=3, degraded_iters=3, request_timeout_ms=120000.0,
+                   max_body_mb=1.0, max_image_dim=128)
+        metrics = ServeMetrics()
+        server = build_server(model, variables, cfg, metrics)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        yield server, metrics
+        server.close()
+        thread.join(10)
+
+    def test_binary_json_bitwise_parity(self, wire_server):
+        """A JSON-only client against the binary-default server (and
+        vice versa) round-trips BITWISE — the compat guarantee that lets
+        the dialects deploy independently."""
+        server, metrics = wire_server
+        l, r = _img(60, 90, seed=11), _img(60, 90, seed=12)
+        cb = ServeClient("127.0.0.1", server.port, timeout=120)
+        cj = ServeClient("127.0.0.1", server.port, timeout=120,
+                         wire_format="json")
+        try:
+            db, mb = cb.predict(l, r)
+            dj, mj = cj.predict(l, r)
+            np.testing.assert_array_equal(db, dj)
+            assert db.dtype == np.float32
+            assert mb["iters"] == mj["iters"]
+            # The binary request/response really is smaller on the wire.
+            assert cb.bytes_sent < cj.bytes_sent
+            assert cb.bytes_received < cj.bytes_received
+            # Negotiation observability: both dialect pairs counted.
+            negos = {lv: c.value
+                     for lv, c in metrics.wire_negotiations.series()}
+            assert negos.get(("binary", "binary"), 0) >= 1
+            assert negos.get(("json", "json"), 0) >= 1
+            wired = {lv: c.value for lv, c in metrics.wire_bytes.series()}
+            assert wired.get(("in", "binary"), 0) > 0
+            assert wired.get(("out", "binary"), 0) > 0
+        finally:
+            cb.close()
+            cj.close()
+
+    def test_int16_manifest_over_http(self, wire_server):
+        """response.encoding=int16: the reply carries the exactness
+        manifest and the decoded disparity honors its error bound
+        against the bitwise f32 answer."""
+        server, _ = wire_server
+        l, r = _img(60, 90, seed=11), _img(60, 90, seed=12)
+        c32 = ServeClient("127.0.0.1", server.port, timeout=120)
+        c16 = ServeClient("127.0.0.1", server.port, timeout=120,
+                          response_encoding="int16")
+        try:
+            d32, _ = c32.predict(l, r)
+            d16, m16 = c16.predict(l, r)
+            man = m16["wire_manifest"]
+            assert man["encoding"] == "int16_fixed"
+            err = float(np.max(np.abs(d16 - d32)))
+            assert err <= man["err_bound"] + 1e-12
+            assert man["max_abs_err"] <= man["err_bound"] + 1e-12
+            assert np.isclose(err, man["max_abs_err"], atol=1e-6)
+            assert c16.bytes_received < c32.bytes_received
+        finally:
+            c32.close()
+            c16.close()
+
+    def test_negotiation_matrix_never_500s(self, wire_server):
+        """Binary in + JSON out (Accept without the wire type), bad
+        response prefs, and a non-wire Accept all answer 4xx/200 — the
+        negotiation layer never turns a client choice into a 500."""
+        import http.client as hc
+
+        from raftstereo_tpu import wire
+
+        server, _ = wire_server
+        l, r = _img(60, 90, seed=11), _img(60, 90, seed=12)
+        conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=120)
+        try:
+            # Binary request, JSON-only Accept -> base64 JSON response.
+            frame = wire.encode_request(l, r)
+            conn.request("POST", "/predict", body=frame,
+                         headers={"Content-Type": wire.WIRE_CONTENT_TYPE,
+                                  "Accept": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            assert "disparity" in json.loads(body)
+            # Bad response prefs: clean 400 BEFORE inference, not a
+            # post-compute 500.
+            frame = wire.encode_request(
+                l, r, fields={"response": {"encoding": "f64"}})
+            conn.request("POST", "/predict", body=frame,
+                         headers={"Content-Type": wire.WIRE_CONTENT_TYPE,
+                                  "Accept": wire.WIRE_CONTENT_TYPE})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 400
+            assert resp.headers["Content-Type"] == "application/json"
+            assert "encoding" in json.loads(body)["error"]
+        finally:
+            conn.close()
+
+    def test_unknown_wire_version_explicit_400(self, wire_server):
+        """A future-version frame gets a 400 NAMING the supported range
+        — the contract that lets old servers reject new clients
+        legibly."""
+        import http.client as hc
+        import struct
+
+        from raftstereo_tpu import wire
+
+        server, _ = wire_server
+        frame = bytearray(wire.encode_request(_img(60, 90), _img(60, 90)))
+        struct.pack_into("<H", frame, 4, 99)  # version field
+        conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        try:
+            conn.request("POST", "/predict", body=bytes(frame),
+                         headers={"Content-Type": wire.WIRE_CONTENT_TYPE})
+            resp = conn.getresponse()
+            err = json.loads(resp.read())["error"]
+            assert resp.status == 400
+            assert "99" in err and "1..1" in err, err
+        except (BrokenPipeError, ConnectionResetError):
+            pytest.fail("version reject must reply, not just drop")
+        finally:
+            conn.close()
+
+    def test_zero_length_post_keepalive_survives(self, wire_server):
+        """Content-Length: 0 -> clean 400 with X-Request-Id and NO body
+        to drain: the same connection serves the next request."""
+        import http.client as hc
+
+        server, _ = wire_server
+        conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        try:
+            conn.request("POST", "/predict", body=b"",
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 400
+            assert resp.headers.get("X-Request-Id")
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+        finally:
+            conn.close()
+
+    def test_content_length_longer_than_body_400_closes(self, wire_server):
+        """Client promises more bytes than it sends: the short read is a
+        400 (with X-Request-Id) and the connection closes — the stream
+        position is undefined, nothing further could be framed."""
+        import socket as sk
+
+        server, _ = wire_server
+        s = sk.create_connection(("127.0.0.1", server.port), timeout=30)
+        try:
+            s.sendall(b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: 100\r\n\r\n{\"left\":")
+            s.shutdown(sk.SHUT_WR)
+            reply = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                reply += chunk
+            assert reply.split(b"\r\n", 1)[0].split(b" ")[1] == b"400"
+            assert b"X-Request-Id:" in reply
+            assert b"shorter than Content-Length" in reply
+        finally:
+            s.close()
+
+    def test_content_length_shorter_than_body_defined_state(
+            self, wire_server):
+        """Client sends MORE bytes than Content-Length: the request is
+        answered off the declared length and the trailing garbage can
+        only desync THIS connection — the server survives and fresh
+        connections are untouched."""
+        import http.client as hc
+        import socket as sk
+
+        server, _ = wire_server
+        s = sk.create_connection(("127.0.0.1", server.port), timeout=30)
+        try:
+            s.sendall(b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: 2\r\n\r\n{}GARBAGE")
+            reply = s.recv(65536)
+            # {} parses but has no images -> a clean 400 for request 1.
+            assert reply.split(b"\r\n", 1)[0].split(b" ")[1] == b"400"
+        finally:
+            s.close()
+        conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+        finally:
+            conn.close()
+
+    def test_chunked_transfer_encoding_411(self, wire_server):
+        """Satellite contract: Transfer-Encoding is refused with 411 +
+        X-Request-Id and the connection closes (chunked frames can't be
+        drained off a Content-Length reader)."""
+        import socket as sk
+
+        server, _ = wire_server
+        s = sk.create_connection(("127.0.0.1", server.port), timeout=30)
+        try:
+            s.sendall(b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+                      b"X-Request-Id: te-test\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Transfer-Encoding: chunked\r\n\r\n"
+                      b"0\r\n\r\n")
+            reply = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break  # server closed: the 411 contract
+                reply += chunk
+            assert reply.split(b"\r\n", 1)[0].split(b" ")[1] == b"411"
+            assert b"X-Request-Id: te-test" in reply
+        finally:
+            s.close()
+
+    def test_413_carries_request_id(self, wire_server):
+        """Pre-dispatch 413 replies are joinable to client logs."""
+        import socket as sk
+
+        server, _ = wire_server
+        s = sk.create_connection(("127.0.0.1", server.port), timeout=30)
+        try:
+            s.sendall(b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+                      b"X-Request-Id: cap-test\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: 999999999\r\n\r\n")
+            reply = s.recv(65536)
+            assert reply.split(b"\r\n", 1)[0].split(b" ")[1] == b"413"
+            assert b"X-Request-Id: cap-test" in reply
+        finally:
+            s.close()

@@ -93,7 +93,7 @@ class TestSpatialValidation:
         assert _cfg(max_body_mb=0.1).max_body_mb > 0.1
         big = _cfg(max_body_mb=160.0,
                    spatial_buckets=((2160, 3840),)).max_body_mb
-        assert big > 300.0  # a base64 4K pair is ~316 MB
+        assert big > 300.0  # 253.1 MiB 4K pair -> cap ~316 MiB with headroom
         # No spatial buckets -> the operator's cap stands untouched.
         assert ServeConfig(port=0, max_body_mb=0.1).max_body_mb == 0.1
 
@@ -280,9 +280,13 @@ class TestSpatialHTTP:
 
             # Body cap: a pair beyond every configured bucket hits the
             # 413 (possibly as a mid-upload reset — both are the refusal,
-            # httpbase module docstring).
+            # httpbase module docstring).  The cap is a bytes policy
+            # sized to the base64 dialect — the same pair as a wire
+            # frame fits under it (that is the wire format's point,
+            # docs/wire_format.md), so exercise the refusal over JSON.
             try:
-                client2 = ServeClient("127.0.0.1", server.port, timeout=30)
+                client2 = ServeClient("127.0.0.1", server.port, timeout=30,
+                                      wire_format="json")
                 with pytest.raises(ServeError) as ei:
                     client2.predict(_img(128, 192, seed=9),
                                     _img(128, 192, seed=10))

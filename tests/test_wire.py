@@ -1,0 +1,258 @@
+"""Tier-1 tests for raftstereo_tpu.wire — the binary frame codec.
+
+Pure numpy + stdlib: no jax, no server.  The seeded fuzz round-trip is
+the contract test the serving stack leans on — random shapes, dtypes
+and flag combinations must encode -> decode bitwise, fed whole or in
+adversarially small chunks.
+"""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from raftstereo_tpu import wire
+from raftstereo_tpu.wire.format import SUPPORTED_VERSIONS, TILE_BYTES, _HEADER
+
+
+def _feed_chunked(buf, rng, expect):
+    """Decode via the streaming decoder with random chunk sizes."""
+    dec = wire.FrameDecoder(expect=expect)
+    pos = 0
+    while pos < len(buf):
+        step = int(rng.integers(1, 65537))
+        dec.feed(buf[pos:pos + step])
+        pos += step
+    assert dec.done
+    return dec
+
+
+class TestHeader:
+    def test_header_size_is_fixed(self):
+        assert wire.HEADER_SIZE == 32
+
+    def test_bad_magic_rejected(self):
+        buf = bytearray(wire.encode_response(np.zeros((4, 5), np.float32)))
+        buf[:4] = b"NOPE"
+        with pytest.raises(wire.WireError, match="magic"):
+            wire.decode_response(bytes(buf))
+
+    def test_unknown_version_names_supported_range(self):
+        buf = bytearray(wire.encode_response(np.zeros((4, 5), np.float32)))
+        struct.pack_into("<H", buf, 4, 7)  # version field
+        with pytest.raises(wire.WireVersionError) as ei:
+            wire.decode_response(bytes(buf))
+        lo, hi = SUPPORTED_VERSIONS
+        assert f"{lo}..{hi}" in str(ei.value)
+        assert "7" in str(ei.value)
+
+    def test_truncated_frame_rejected(self):
+        buf = wire.encode_request(np.ones((6, 7, 3), np.float32) * 0.5,
+                                  np.ones((6, 7, 3), np.float32))
+        with pytest.raises(wire.WireError, match="truncated"):
+            wire.decode_request(buf[:-3])
+
+    def test_trailing_garbage_rejected(self):
+        buf = wire.encode_response(np.zeros((4, 5), np.float32))
+        with pytest.raises(wire.WireError, match="trailing"):
+            wire.decode_response(buf + b"x")
+
+    def test_wrong_frame_type_rejected(self):
+        req = wire.encode_request(np.ones((4, 4, 3), np.float32) * 0.25,
+                                  np.ones((4, 4, 3), np.float32))
+        with pytest.raises(wire.WireError, match="response"):
+            wire.decode_response(req)
+
+    def test_hostile_dims_fail_before_allocation(self):
+        # A header claiming a ~70 TB plane must be refused by the size
+        # guard, not by a MemoryError out of the staging allocation.
+        hdr = _HEADER.pack(wire.MAGIC, wire.VERSION, wire.FRAME_REQUEST,
+                           0, 1, 3, 2, 2 ** 32 - 1, 2 ** 12, 0, 2 ** 40)
+        dec = wire.FrameDecoder(expect=wire.FRAME_REQUEST,
+                                max_payload_bytes=256 << 20)
+        with pytest.raises(wire.WireError, match="cap"):
+            dec.feed(hdr)
+
+
+class TestRoundTrip:
+    def test_seeded_fuzz_bitwise(self):
+        # The satellite fuzz test: random shapes/dtypes/flag combos,
+        # encode -> decode bitwise, whole-buffer AND chunk-fed.
+        rng = np.random.default_rng(20260806)
+        dtypes = [np.float32, np.float16, np.uint8, np.int16]
+        for trial in range(40):
+            h = int(rng.integers(1, 50))
+            w = int(rng.integers(1, 50))
+            c = int(rng.choice([1, 3, 12]))
+            dt = dtypes[trial % len(dtypes)]
+            if np.issubdtype(dt, np.floating):
+                left = rng.standard_normal((h, w, c)).astype(dt)
+                right = rng.standard_normal((h, w, c)).astype(dt)
+            else:
+                info = np.iinfo(dt)
+                left = rng.integers(info.min, info.max, (h, w, c)).astype(dt)
+                right = rng.integers(info.min, info.max, (h, w, c)).astype(dt)
+            compress = bool(trial % 2)
+            shuffle = bool((trial // 2) % 2)
+            fields = {"iters": 8, "session_id": f"s{trial}"}
+            buf = wire.encode_request(left, right, fields,
+                                      compress=compress, shuffle=shuffle,
+                                      level=1, allow_uint8=bool(trial % 3))
+            for req in (wire.decode_request(buf),
+                        _feed_chunked(buf, rng,
+                                      wire.FRAME_REQUEST).request()):
+                assert req.left.tobytes() == left.tobytes()
+                assert req.right.tobytes() == right.tobytes()
+                assert req.left.dtype == left.dtype
+                assert req.fields == fields
+
+    def test_uint8_demotion_is_bitwise_for_promoted_captures(self):
+        # float32 images holding exact 0..255 integers travel as uint8
+        # and come back bitwise float32 — at ~4x fewer raw bytes.
+        rng = np.random.default_rng(7)
+        left = rng.integers(0, 256, (32, 48, 3)).astype(np.float32)
+        right = rng.integers(0, 256, (32, 48, 3)).astype(np.float32)
+        buf = wire.encode_request(left, right, compress=False)
+        req = wire.decode_request(buf)
+        assert req.left.dtype == np.float32
+        assert req.left.tobytes() == left.tobytes()
+        assert req.right.tobytes() == right.tobytes()
+        raw = left.nbytes + right.nbytes
+        assert len(buf) < raw / 3.9
+
+    def test_non_integer_floats_stay_float32(self):
+        left = np.full((4, 4, 3), 0.5, np.float32)
+        right = np.full((4, 4, 3), 1.5, np.float32)
+        req = wire.decode_request(wire.encode_request(left, right))
+        assert req.left.dtype == np.float32
+        assert req.left.tobytes() == left.tobytes()
+
+    def test_response_f32_bitwise(self):
+        rng = np.random.default_rng(3)
+        disp = (rng.standard_normal((33, 47)) * 60).astype(np.float32)
+        meta = {"iters": 12, "warm": True}
+        for compress in (False, True):
+            buf = wire.encode_response(disp, meta, compress=compress)
+            res = wire.decode_response(buf)
+            assert res.disparity.tobytes() == disp.tobytes()
+            assert res.meta == meta
+            assert res.manifest is None
+
+    def test_single_byte_chunk_feed_matches_one_shot(self):
+        rng = np.random.default_rng(11)
+        disp = rng.standard_normal((9, 13)).astype(np.float32)
+        buf = wire.encode_response(disp, {"k": 1})
+        dec = wire.FrameDecoder(expect=wire.FRAME_RESPONSE)
+        for i in range(len(buf)):
+            dec.feed(buf[i:i + 1])
+        assert dec.done
+        assert dec.response().disparity.tobytes() == disp.tobytes()
+
+    def test_multi_tile_plane(self):
+        # Plane bigger than one tile: tiles partition and reassemble.
+        rng = np.random.default_rng(5)
+        h = (3 * TILE_BYTES) // (512 * 4) + 1
+        disp = rng.standard_normal((h, 512)).astype(np.float32)
+        assert disp.nbytes > 2 * TILE_BYTES
+        buf = wire.encode_response(disp, {}, level=1)
+        res = _feed_chunked(buf, rng, wire.FRAME_RESPONSE).response()
+        assert res.disparity.tobytes() == disp.tobytes()
+
+
+class TestInt16Manifest:
+    def test_manifest_bounds_hold(self):
+        rng = np.random.default_rng(17)
+        disp = (rng.random((64, 96)) * 190).astype(np.float32)
+        buf = wire.encode_response(disp, {}, encoding="int16")
+        res = wire.decode_response(buf)
+        m = res.manifest
+        assert m is not None and m["encoding"] == "int16_fixed"
+        # scale is an exact power of two
+        assert m["scale"] == 2.0 ** m["scale_log2"]
+        measured = float(np.max(np.abs(
+            res.disparity.astype(np.float64) - disp.astype(np.float64))))
+        # the manifest's measured error is exact, and within the
+        # half-step bound of the fixed-point grid
+        assert measured == pytest.approx(m["max_abs_err"], abs=0.0)
+        assert m["max_abs_err"] <= m["err_bound"]
+        assert m["err_bound"] <= 2.0 ** -7  # 190 max -> k >= 7
+
+    def test_zero_disparity_is_exact(self):
+        disp = np.zeros((8, 8), np.float32)
+        res = wire.decode_response(
+            wire.encode_response(disp, {}, encoding="int16"))
+        assert res.manifest["max_abs_err"] == 0.0
+        assert res.disparity.tobytes() == disp.tobytes()
+
+    def test_nonfinite_falls_back_to_f32(self):
+        disp = np.full((6, 6), np.nan, np.float32)
+        buf = wire.encode_response(disp, {}, encoding="int16")
+        res = wire.decode_response(buf)
+        assert res.manifest is None  # fell back: bitwise f32
+        assert np.isnan(res.disparity).all()
+        assert res.disparity.tobytes() == disp.tobytes()
+
+    def test_int16_smaller_than_f32(self):
+        rng = np.random.default_rng(23)
+        disp = (rng.random((128, 128)) * 100).astype(np.float32)
+        f32 = wire.encode_response(disp, {}, encoding="f32")
+        i16 = wire.encode_response(disp, {}, encoding="int16")
+        assert len(i16) < len(f32)
+
+
+class TestNegotiation:
+    def test_content_type_matching(self):
+        assert wire.is_wire_content_type(wire.WIRE_CONTENT_TYPE)
+        assert wire.is_wire_content_type(
+            "application/x-raftstereo-frame; charset=binary")
+        assert wire.is_wire_content_type(" Application/X-RaftStereo-Frame ")
+        assert not wire.is_wire_content_type("application/json")
+        assert not wire.is_wire_content_type(None)
+        assert not wire.is_wire_content_type("")
+
+    def test_accept_requires_explicit_listing(self):
+        assert wire.accepts_wire(wire.WIRE_CONTENT_TYPE)
+        assert wire.accepts_wire(
+            "application/json, application/x-raftstereo-frame;q=0.9")
+        # wildcards and q=0 never select binary
+        assert not wire.accepts_wire("*/*")
+        assert not wire.accepts_wire("application/*")
+        assert not wire.accepts_wire(None)
+        assert not wire.accepts_wire(
+            "application/x-raftstereo-frame;q=0")
+        assert not wire.accepts_wire("application/json")
+
+
+class TestMalformedPayload:
+    def test_payload_len_mismatch_rejected(self):
+        disp = np.ones((4, 4), np.float32)
+        buf = bytearray(wire.encode_response(disp, {}, compress=False))
+        struct.pack_into("<Q", buf, 24, 9999)  # payload_len field
+        with pytest.raises(wire.WireError):
+            wire.decode_response(bytes(buf))
+
+    def test_corrupt_tile_rejected(self):
+        disp = np.ones((64, 64), np.float32)
+        buf = bytearray(wire.encode_response(disp, {}))
+        buf[-20] ^= 0xFF  # flip a byte inside the zlib stream
+        with pytest.raises(wire.WireError):
+            wire.decode_response(bytes(buf))
+
+    def test_bad_meta_rejected(self):
+        disp = np.ones((4, 4), np.float32)
+        buf = bytearray(wire.encode_response(disp, {"a": 1},
+                                             compress=False))
+        meta_len = struct.unpack_from("<I", buf, 20)[0]
+        buf[32:32 + meta_len] = b"{" * meta_len  # still meta_len bytes
+        with pytest.raises(wire.WireError, match="meta"):
+            wire.decode_response(bytes(buf))
+
+    def test_meta_survives_json_round_trip(self):
+        # frames embed meta as compact JSON — any JSON-legal fields ride
+        fields = {"iters": None, "spatial": {"mode": "auto"},
+                  "deadline_ms": 33.5, "accuracy": "certified"}
+        buf = wire.encode_request(np.ones((2, 2, 3), np.float32) * 0.5,
+                                  np.zeros((2, 2, 3), np.float32), fields)
+        assert wire.decode_request(buf).fields == json.loads(
+            json.dumps(fields))
