@@ -794,6 +794,130 @@ def bench_slo(height: int, width: int, iters: int, replicas: int,
     }
 
 
+def bench_chaos(height: int, width: int, iters: int, requests: int,
+                concurrency: int, corr: str, compute_dtype: str,
+                quick: bool):
+    """Chaos-mode serving smoke (docs/fault_tolerance.md): a burst trace
+    open-loop replayed against a real 2-backend router cluster while a
+    ChaosPlan blackholes one backend mid-replay.  The verdict is the
+    degraded-mode SLO machinery end to end — steady bounds on the
+    unfaulted slices, relaxed bounds inside the declared window, and a
+    recovery check after it — plus the router's breaker/hedge counters
+    and a validator-clean /metrics scrape.  Refuses a dirty analysis
+    baseline like every other smoke mode."""
+    import threading
+    import time as _time
+
+    from raftstereo_tpu.config import (RAFTStereoConfig, RouterConfig,
+                                       ServeConfig)
+    from raftstereo_tpu.loadgen import chaos as lg_chaos
+    from raftstereo_tpu.loadgen import replay as lg_replay
+    from raftstereo_tpu.loadgen import slo as lg_slo
+    from raftstereo_tpu.loadgen import trace as lg_trace
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.obs.prom import parse_text
+    from raftstereo_tpu.serve import build_server
+    from raftstereo_tpu.serve.client import ServeClient
+    from raftstereo_tpu.serve.cluster import build_router
+
+    import jax
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype, **model_kw)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+    iters = max(iters, 2)
+    serve_cfg = ServeConfig(port=0, buckets=((height, width),),
+                            max_batch_size=2, max_wait_ms=5.0,
+                            queue_limit=64, iters=iters,
+                            degraded_iters=iters, degrade_queue_depth=64)
+    servers, threads = [], []
+    router = None
+    try:
+        for _ in range(2):
+            srv = build_server(model, variables, serve_cfg)
+            th = threading.Thread(target=srv.serve_forever, daemon=True)
+            th.start()
+            servers.append(srv)
+            threads.append(th)
+        router = build_router(RouterConfig(
+            port=0, backends=tuple(("127.0.0.1", s.port) for s in servers),
+            probe_interval_s=0.1, probe_timeout_s=0.3, fail_after=1,
+            breaker_reset_s=0.4, retries=2, retry_backoff_ms=20.0,
+            request_timeout_s=60.0))
+        rt = threading.Thread(target=router.serve_forever, daemon=True)
+        rt.start()
+        threads.append(rt)
+        spec = lg_trace.TraceSpec(
+            seed=0, requests=requests, duration_s=4.0, shape="burst",
+            resolutions=((height, width),), iters_choices=(iters,),
+            iters_fraction=0.0)
+        events = lg_trace.generate(spec)
+        # One blackhole on b0 starting 800 ms into the trace, open for
+        # 800 ms; probes time out, the breaker opens, traffic spills to
+        # b1, and the held requests drain when the window closes (late,
+        # never lost).
+        plan = lg_chaos.ChaosPlan(
+            actions=(lg_chaos.ChaosAction(
+                t_ms=800.0, target="b0",
+                faults="blackhole_backend@t_ms=0:0.8"),),
+            windows=(lg_slo.DegradedWindow(
+                t_start_ms=800.0, t_end_ms=2200.0, label="blackhole_b0",
+                max_error_rate=0.5, recover_by_ms=300.0,
+                recovery_max_error_rate=0.0),))
+        controller = lg_chaos.ChaosController(
+            plan, {"b0": ("127.0.0.1", servers[0].port),
+                   "router": ("127.0.0.1", router.port)})
+        rcfg = lg_replay.ReplayConfig(host="127.0.0.1", port=router.port,
+                                      concurrency=concurrency)
+        scraper = ServeClient("127.0.0.1", router.port, timeout=120.0)
+        try:
+            before = scraper.metrics_text()
+            t0 = _time.perf_counter()
+            recorder = lg_replay.replay(events, rcfg, chaos=controller)
+            wall_s = _time.perf_counter() - t0
+            after = scraper.metrics_text()
+        finally:
+            scraper.close()
+        rows = recorder.rows()
+        slo_spec = lg_slo.SLOSpec(
+            classes=(lg_slo.SLOClass(max_error_rate=0.0,
+                                     max_shed_rate=0.0),),
+            windows=plan.degraded_windows())
+        verdict = lg_slo.evaluate(slo_spec, rows, wall_s=wall_s,
+                                  metrics_before=before,
+                                  metrics_after=after)
+    finally:
+        if router is not None:
+            router.close()
+        for srv in servers:
+            srv.close()
+        for th in threads:
+            th.join(10)
+    fams = parse_text(after)
+    breaker_transitions = (fams.total("cluster_breaker_transitions_total")
+                           if "cluster_breaker_transitions_total" in fams
+                           else 0.0)
+    ok = sum(1 for r in rows if r.outcome == "ok")
+    return {
+        "trace_events": len(events),
+        "slo_pass": verdict["pass"],
+        "checks": verdict["checks"],
+        "windows": verdict.get("windows", {}),
+        "chaos": {k: controller.summary()[k]
+                  for k in ("actions", "armed", "failed")},
+        "breaker_transitions": breaker_transitions,
+        "metric_deltas": verdict["metrics"]["deltas"],
+        "pairs_per_sec": round(ok / max(wall_s, 1e-9), 4),
+        "wall_s": round(wall_s, 3),
+    }
+
+
 def bench_stream(height: int, width: int, frames: int, iters: int,
                  corr: str, compute_dtype: str, quick: bool):
     """Streaming smoke benchmark (mirrors --serve): replay an N-frame
@@ -1289,6 +1413,13 @@ def main() -> None:
                         "open-loop replay against a --replicas cluster "
                         "server in scheduler mode, SLO verdict + fitted "
                         "capacity model (--reps = request count)")
+    p.add_argument("--chaos", action="store_true",
+                   help="run the chaos-mode serving smoke "
+                        "(docs/fault_tolerance.md): burst trace replayed "
+                        "against a 2-backend router cluster while a "
+                        "ChaosPlan blackholes one backend; emits the "
+                        "degraded-mode SLO verdict JSON (--reps = "
+                        "request count)")
     p.add_argument("--stream", action="store_true",
                    help="benchmark the temporal warm-start streaming "
                         "subsystem: N-frame synthetic video sequence, "
@@ -1327,7 +1458,7 @@ def main() -> None:
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
     if args.quick or args.serve or args.stream or args.sched \
             or args.cluster or args.gru or args.quant or args.sl \
-            or args.spatial or args.slo:
+            or args.spatial or args.slo or args.chaos:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -1430,6 +1561,31 @@ def main() -> None:
             "metric": f"SLO harness pairs/sec @{w}x{h}, {args.replicas} "
                       f"replicas, burst trace (sessions+tiers+deadlines) "
                       f"over HTTP",
+            "value": summary["pairs_per_sec"],
+            "unit": "pairs/sec",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
+
+    if args.chaos:
+        h, w = args.height, args.width
+        requests = args.reps
+        if args.quick:
+            # Tiny model + shape; still crosses trace -> chaos arming ->
+            # blackhole -> breaker -> degraded verdict over real HTTP.
+            if not explicit_hw:
+                h, w = 64, 96
+            requests = max(args.reps, 24)
+            if not explicit_iters:
+                args.iters = min(args.iters, 2)
+        summary = bench_chaos(h, w, args.iters, requests,
+                              args.serve_concurrency, args.corr,
+                              args.compute_dtype, quick=args.quick)
+        record = {
+            "metric": f"chaos-mode pairs/sec @{w}x{h}, 2 backends behind "
+                      f"the router, one blackhole window mid-replay",
             "value": summary["pairs_per_sec"],
             "unit": "pairs/sec",
             "vs_baseline": 0.0,

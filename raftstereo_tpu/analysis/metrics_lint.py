@@ -59,7 +59,8 @@ def run_metrics_lint() -> List[Finding]:
     for msg in lint_registry(registry.entries()):
         name = msg.split(":")[0]
         path = _TRAIN_PATH if name.startswith("train") \
-            else _LOADGEN_PATH if name.startswith(("loadgen", "slo")) \
+            else _LOADGEN_PATH \
+            if name.startswith(("loadgen", "slo", "chaos")) \
             else _SERVE_PATH
         findings.append(Finding("RSA501", path, 1, msg, "metrics"))
 
@@ -87,7 +88,12 @@ def run_metrics_lint() -> List[Finding]:
     cluster.capacity_headroom.set(0.5)
     cluster.wire_stream_bytes.labels(direction="in").inc(65536)
     cluster.wire_stream_peak_chunk.set(65536)
+    cluster.breaker_state.labels(backend="b0").set(0)
+    cluster.breaker_transitions.labels(backend="b0", to="open").inc()
+    cluster.hedges.labels(outcome="won").inc()
     loadgen.requests.labels(outcome="ok", tier="default").inc()
+    loadgen.chaos_actions.labels(kind="slow_replica",
+                                 outcome="armed").inc()
     loadgen.send_lag.observe(0.001)
     loadgen.latency.observe(0.01)
     loadgen.slo_checks.labels(status="pass").inc()

@@ -116,6 +116,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the per-response exactness manifest)")
     r.add_argument("--report", default=None,
                    help="write verdict + per-request rows JSON here")
+    r.add_argument("--chaos", default=None, metavar="PLAN.json",
+                   help="chaos plan (loadgen/chaos.py) armed against "
+                        "trace time during the replay; its declared "
+                        "degraded windows become SLO windows "
+                        "(docs/fault_tolerance.md)")
+    r.add_argument("--chaos_target", action="append", default=[],
+                   metavar="NAME=HOST:PORT",
+                   help="map a chaos plan's logical target name to an "
+                        "endpoint (repeatable); the replayed endpoint "
+                        "itself is always available as 'default'")
     r.add_argument("--p50_ms", type=float, default=math.inf,
                    help="SLO: p50 latency bound over all requests")
     r.add_argument("--p99_ms", type=float, default=math.inf)
@@ -179,11 +189,28 @@ def _cmd_replay(args) -> int:
                          pair_seed=args.pair_seed, speed=args.speed,
                          wire_format=args.wire,
                          response_encoding=args.response_encoding)
+    chaos_plan = controller = None
+    windows = ()
+    if args.chaos:
+        from ..loadgen import chaos as X
+
+        chaos_plan = X.ChaosPlan.load(args.chaos)
+        targets = {"default": (args.host, args.port)}
+        for item in args.chaos_target:
+            name, _, hp = item.partition("=")
+            host, _, port = hp.rpartition(":")
+            if not (name and host and port):
+                raise SystemExit(
+                    f"--chaos_target {item!r} is not NAME=HOST:PORT")
+            targets[name] = (host, int(port))
+        controller = X.ChaosController(chaos_plan, targets,
+                                       timeout_s=args.timeout_s)
+        windows = chaos_plan.degraded_windows()
     scraper = ServeClient(args.host, args.port, timeout=args.timeout_s)
     try:
         before = scraper.metrics_text()
         t0 = time.perf_counter()
-        recorder = R.replay(events, cfg)
+        recorder = R.replay(events, cfg, chaos=controller)
         wall_s = time.perf_counter() - t0
         after = scraper.metrics_text()
     finally:
@@ -191,20 +218,27 @@ def _cmd_replay(args) -> int:
     spec = S.SLOSpec(classes=(S.SLOClass(
         p50_ms=args.p50_ms, p99_ms=args.p99_ms,
         max_shed_rate=args.max_shed_rate,
-        min_deadline_hit_rate=args.min_deadline_hit_rate),))
+        min_deadline_hit_rate=args.min_deadline_hit_rate),),
+        windows=windows)
     rows = recorder.rows()
     verdict = S.evaluate(spec, rows, wall_s=wall_s,
                          metrics_before=before, metrics_after=after)
+    chaos_summary = controller.summary() if controller is not None else None
     if args.report:
+        report = {"trace": header, "verdict": verdict,
+                  "rows": [dataclasses.asdict(r) for r in rows]}
+        if chaos_summary is not None:
+            report["chaos"] = chaos_summary
         with open(args.report, "w") as f:
-            json.dump({"trace": header, "verdict": verdict,
-                       "rows": [dataclasses.asdict(r) for r in rows]},
-                      f, indent=2, sort_keys=True)
+            json.dump(report, f, indent=2, sort_keys=True)
             f.write("\n")
     out = {k: verdict[k] for k in
            ("pass", "requests", "wall_s", "groups")}
     if "wire" in verdict:
         out["wire"] = verdict["wire"]
+    if chaos_summary is not None:
+        out["chaos"] = {k: chaos_summary[k]
+                        for k in ("actions", "armed", "failed")}
     out["report"] = args.report
     print(json.dumps(out), flush=True)
     return 0 if verdict["pass"] else 1

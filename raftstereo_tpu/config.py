@@ -400,6 +400,19 @@ class RouterConfig:
     # staying model-free).
     capacity_model: Optional[str] = None
     target_rps: float = 0.0
+    # Circuit breaker (serve/cluster/router.py, docs/fault_tolerance.md):
+    # a backend's breaker opens after fail_after consecutive
+    # connect/timeout failures (request path or probes); after
+    # breaker_reset_s it admits ONE half-open trial, whose outcome
+    # closes or re-opens it.
+    breaker_reset_s: float = 5.0
+    # Hedged requests for idempotent cold JSON /predict forwards:
+    # 0 disables hedging (default).  When > 0, a hedge to the next
+    # ready backend fires after max(hedge_floor_ms, live forward p99)
+    # — the p99 term engages once hedge_min_samples forwards have been
+    # observed.  Never for sessions or streamed binary bodies.
+    hedge_floor_ms: float = 0.0
+    hedge_min_samples: int = 20
 
     def __post_init__(self):
         if isinstance(self.backends, list):
@@ -415,6 +428,9 @@ class RouterConfig:
         assert self.trace_buffer >= 1, self.trace_buffer
         assert self.session_pin_limit >= 1, self.session_pin_limit
         assert self.target_rps >= 0, self.target_rps
+        assert self.breaker_reset_s > 0, self.breaker_reset_s
+        assert self.hedge_floor_ms >= 0, self.hedge_floor_ms
+        assert self.hedge_min_samples >= 1, self.hedge_min_samples
 
 
 @dataclasses.dataclass(frozen=True)
@@ -783,6 +799,18 @@ def add_router_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--target_rps", type=float, default=d.target_rps,
                    help="planned aggregate request rate the capacity "
                         "model sizes the backend fleet for")
+    g.add_argument("--breaker_reset_s", type=float,
+                   default=d.breaker_reset_s,
+                   help="seconds an open circuit breaker waits before "
+                        "admitting a half-open trial request")
+    g.add_argument("--hedge_floor_ms", type=float,
+                   default=d.hedge_floor_ms,
+                   help="floor on the hedged-request delay for idempotent "
+                        "cold JSON requests; 0 disables hedging")
+    g.add_argument("--hedge_min_samples", type=int,
+                   default=d.hedge_min_samples,
+                   help="forward-latency samples required before the hedge "
+                        "delay tracks live p99 instead of the floor")
 
 
 def router_config_from_args(args: argparse.Namespace) -> RouterConfig:
@@ -801,6 +829,9 @@ def router_config_from_args(args: argparse.Namespace) -> RouterConfig:
         session_pin_limit=args.session_pin_limit,
         capacity_model=args.capacity_model,
         target_rps=args.target_rps,
+        breaker_reset_s=args.breaker_reset_s,
+        hedge_floor_ms=args.hedge_floor_ms,
+        hedge_min_samples=args.hedge_min_samples,
     )
 
 

@@ -1,0 +1,223 @@
+"""Versioned chaos plans scheduled against trace time during replay.
+
+A :class:`ChaosPlan` is the fault-side twin of a loadgen trace: a list
+of :class:`ChaosAction` rows, each "at trace offset ``t_ms``, arm this
+``utils/faults.py`` spec on this named target".  The
+:class:`ChaosController` runs the plan on the REPLAY'S clock — the same
+``t_start`` and ``speed`` the open-loop sender uses — by POSTing each
+action's spec to the target's ``/debug/faults`` arming endpoint
+(serve/server.py and serve/cluster/router.py both expose it).  Faults
+are therefore injected at declared trace offsets, which is what lets
+``loadgen/slo.py``'s :class:`~raftstereo_tpu.loadgen.slo.DegradedWindow`
+bounds line up with the fault windows: the plan DECLARES when service is
+allowed to degrade, the verdict checks that it degraded no further and
+recovered on time.
+
+Plans are JSON on disk (``save``/``load``) with an explicit format tag +
+version, like traces and capacity models — a chaos certification is only
+reproducible if the fault schedule is an artifact, not a shell script.
+
+Every fault spec is validated against the fault grammar at plan
+construction (``FaultPlan.parse``), so a typo fails when the plan is
+BUILT, not minutes into a replay.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.faults import FaultPlan
+from .slo import DegradedWindow
+
+__all__ = ["ChaosAction", "ChaosPlan", "ChaosController",
+           "CHAOS_FORMAT", "CHAOS_VERSION"]
+
+logger = logging.getLogger(__name__)
+
+CHAOS_FORMAT = "raftstereo_tpu.chaos"
+CHAOS_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosAction:
+    """One arming event: at trace offset ``t_ms``, POST ``faults`` (a
+    ``utils/faults.py`` spec string) to the target named ``target``.
+
+    Targets are LOGICAL names ("router", "b0", ...) resolved to
+    host:port at replay time — the plan artifact stays portable across
+    port assignments.  Timed faults (``@t_ms=OFFSET``) measure their
+    offset from ARMING, so an action's effective window is
+    ``t_ms + offset`` in trace time."""
+
+    t_ms: float
+    target: str
+    faults: str
+
+    def __post_init__(self):
+        if self.t_ms < 0:
+            raise ValueError(f"chaos action t_ms must be >= 0: {self.t_ms}")
+        if not self.target:
+            raise ValueError("chaos action needs a target name")
+        # Validate the spec against the grammar now, not mid-replay.
+        FaultPlan.parse(self.faults)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    """The whole schedule plus the degraded-mode bounds it justifies."""
+
+    actions: Tuple[ChaosAction, ...] = ()
+    windows: Tuple[DegradedWindow, ...] = ()
+
+    def degraded_windows(self) -> Tuple[DegradedWindow, ...]:
+        """The declared degraded-mode windows, for ``SLOSpec.windows``."""
+        return self.windows
+
+    def to_json(self) -> Dict:
+        return {
+            "chaos_plan": CHAOS_FORMAT,
+            "version": CHAOS_VERSION,
+            "actions": [dataclasses.asdict(a) for a in
+                        sorted(self.actions, key=lambda a: a.t_ms)],
+            "windows": [dataclasses.asdict(w) for w in self.windows],
+        }
+
+    @classmethod
+    def from_json(cls, data: Dict) -> "ChaosPlan":
+        if data.get("chaos_plan") != CHAOS_FORMAT:
+            raise ValueError(
+                f"not a chaos plan (chaos_plan={data.get('chaos_plan')!r})")
+        version = data.get("version")
+        if version != CHAOS_VERSION:
+            raise ValueError(
+                f"chaos plan version {version!r} not supported "
+                f"(this build reads version {CHAOS_VERSION})")
+        actions = tuple(ChaosAction(**a) for a in data.get("actions", ()))
+        windows = tuple(DegradedWindow(**w) for w in data.get("windows", ()))
+        return cls(actions=actions, windows=windows)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "ChaosPlan":
+        with open(path) as f:
+            return cls.from_json(json.load(f))
+
+
+def _arm(host: str, port: int, spec: str, timeout_s: float) -> Dict:
+    """POST one spec to ``/debug/faults``; raises on refusal."""
+    body = json.dumps({"faults": spec}).encode()
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("POST", "/debug/faults", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"/debug/faults on {host}:{port} refused {spec!r}: "
+                f"{resp.status} {data[:200]!r}")
+        return json.loads(data)
+    finally:
+        conn.close()
+
+
+class ChaosController:
+    """Runs a plan's actions on the replay clock, in its own thread.
+
+    ``targets`` maps each action's logical target name to ``(host,
+    port)``.  The controller is handed the replay's ``t_start`` (a
+    ``time.perf_counter()`` stamp) and ``speed`` by ``replay()`` so
+    action offsets land on the same compressed timeline as the sends.
+    Arming failures are COUNTED (``chaos_actions_total{outcome=
+    "failed"}``) and logged, never raised — a chaos harness that dies
+    when its fault landed on an already-dead backend certifies nothing.
+    """
+
+    def __init__(self, plan: ChaosPlan,
+                 targets: Dict[str, Tuple[str, int]],
+                 timeout_s: float = 10.0, metrics=None):
+        missing = sorted({a.target for a in plan.actions} - set(targets))
+        if missing:
+            raise ValueError(
+                f"chaos plan targets not mapped: {missing} "
+                f"(known: {sorted(targets)})")
+        self.plan = plan
+        self.targets = dict(targets)
+        self.timeout_s = timeout_s
+        self.metrics = metrics  # LoadgenMetrics or None
+        self.results: List[Dict] = []  # guarded_by: _lock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, t_start: float, speed: float = 1.0) -> "ChaosController":
+        self._thread = threading.Thread(
+            target=self._run, args=(t_start, max(speed, 1e-9)),
+            name="chaos-controller", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout_s: float = 60.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.join()
+
+    # ------------------------------------------------------------------
+
+    def _count(self, spec: str, outcome: str) -> None:
+        if self.metrics is None:
+            return
+        # One count per fault KIND in the spec: the metric answers "how
+        # many slow_replica armings failed", not "how many POSTs".
+        for fault in FaultPlan.parse(spec).faults:
+            self.metrics.chaos_actions.labels(
+                kind=fault.kind, outcome=outcome).inc()
+
+    def _run(self, t_start: float, speed: float) -> None:
+        for action in sorted(self.plan.actions, key=lambda a: a.t_ms):
+            due = t_start + action.t_ms / 1e3 / speed
+            while True:
+                delay = due - time.perf_counter()
+                if delay <= 0:
+                    break
+                if self._stop.wait(min(delay, 0.05)):
+                    return
+            host, port = self.targets[action.target]
+            record = {"t_ms": action.t_ms, "target": action.target,
+                      "faults": action.faults}
+            try:
+                reply = _arm(host, port, action.faults, self.timeout_s)
+            except Exception as e:
+                logger.error("chaos: arming %r on %s (%s:%d) failed: %s",
+                             action.faults, action.target, host, port, e)
+                record.update(outcome="failed", error=str(e))
+                self._count(action.faults, "failed")
+            else:
+                logger.info("chaos: armed %r on %s (%s:%d)",
+                            action.faults, action.target, host, port)
+                record.update(outcome="armed", armed=reply.get("armed"))
+                self._count(action.faults, "armed")
+            with self._lock:
+                self.results.append(record)
+
+    def summary(self) -> Dict:
+        with self._lock:
+            results = list(self.results)
+        return {"actions": len(self.plan.actions),
+                "armed": sum(1 for r in results if r["outcome"] == "armed"),
+                "failed": sum(1 for r in results
+                              if r["outcome"] == "failed"),
+                "results": results}

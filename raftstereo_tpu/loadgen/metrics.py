@@ -44,6 +44,12 @@ class LoadgenMetrics:
             "client-observed send-to-reply latency per ok request "
             "(includes network + router hop, unlike the server's own "
             "serve_request_latency_seconds)")
+        self.chaos_actions = r.counter(
+            "chaos_actions_total",
+            "chaos-plan fault armings POSTed to /debug/faults during "
+            "replay, by fault kind and outcome (armed/failed) — "
+            "loadgen/chaos.py, docs/fault_tolerance.md",
+            labels=("kind", "outcome"))
         self.slo_checks = r.counter(
             "slo_checks_total",
             "individual SLO checks evaluated, by status (pass/fail)",

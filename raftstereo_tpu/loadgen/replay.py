@@ -121,13 +121,20 @@ class _SessionGate:
 
 def replay(events: Sequence[TraceEvent], cfg: ReplayConfig,
            make_pair: Optional[Callable] = None,
-           on_result: Optional[Callable] = None) -> Recorder:
+           on_result: Optional[Callable] = None,
+           chaos=None) -> Recorder:
     """Replay ``events`` against ``cfg.host:cfg.port``; returns the
     recorder holding one ``RequestRow`` per event.
 
     ``on_result(event, disparity, meta)`` runs (serialised under a
     lock) for every 200 reply — the hook the determinism test uses to
     capture disparities without the replay path knowing about it.
+
+    ``chaos`` (a ``loadgen.chaos.ChaosController``) is started on the
+    SAME clock base and speed as the sends, so its fault armings land
+    at their declared trace offsets relative to the offered load —
+    that alignment is what makes degraded-window SLO verdicts
+    meaningful (docs/slo_harness.md "Chaos mode").
     """
     events = sorted(events, key=lambda e: (e.t_ms, e.index))
     make_pair = make_pair or pair_provider(cfg.pair_seed, cfg.pool_size)
@@ -146,6 +153,8 @@ def replay(events: Sequence[TraceEvent], cfg: ReplayConfig,
             seen[ev.session] = ordinal[ev.index] + 1
 
     t_start = time.perf_counter()
+    if chaos is not None:
+        chaos.start(t_start, speed=cfg.speed)
 
     def claim() -> Optional[TraceEvent]:
         with claim_lock:
@@ -244,4 +253,9 @@ def replay(events: Sequence[TraceEvent], cfg: ReplayConfig,
         t.start()
     for t in threads:
         t.join()
+    if chaos is not None:
+        # All sends are done; any not-yet-due action would land after
+        # the traffic it was meant to shape — stop instead of arming
+        # faults into an idle cluster.
+        chaos.stop()
     return recorder

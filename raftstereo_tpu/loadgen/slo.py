@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..obs.prom import parse_text
 from .records import RequestRow, percentile, wire_bytes
 
-__all__ = ["SLOClass", "SLOSpec", "evaluate"]
+__all__ = ["SLOClass", "SLOSpec", "DegradedWindow", "evaluate"]
 
 SLO_FORMAT = "raftstereo_tpu.loadgen"
 SLO_VERSION = 1
@@ -66,6 +66,58 @@ class SLOClass:
 
 
 @dataclasses.dataclass(frozen=True)
+class DegradedWindow:
+    """A declared fault window with its own (relaxed) bounds.
+
+    While a chaos plan (loadgen/chaos.py) holds a fault open, the
+    steady-state bounds are the wrong contract — the whole point of
+    graceful degradation is that service gets WORSE, boundedly.  A
+    window scopes ``[t_start_ms, t_end_ms)`` of trace time (rows
+    partition on ``t_send_ms``): rows inside it are judged against the
+    degraded bounds here instead of the class bounds, and rows at or
+    after ``t_end_ms + recover_by_ms`` form the RECOVERY slice that
+    must be back within the recovery bounds — a breaker that opens and
+    never half-open-recovers fails the verdict even if the window
+    itself looked fine.
+
+    Both the window and (when any recovery bound is set) the recovery
+    slice fail loudly on zero traffic: a chaos verdict whose fault
+    window saw no requests certified nothing.
+    """
+
+    t_start_ms: float
+    t_end_ms: float
+    label: str = "degraded"
+    # Degraded-mode bounds over rows INSIDE the window (opt-in, like
+    # SLOClass bounds).
+    p99_ms: float = math.inf
+    max_shed_rate: float = 1.0
+    max_error_rate: float = 1.0
+    # Recovery: rows with t_send_ms >= t_end_ms + recover_by_ms must be
+    # back within these bounds.
+    recover_by_ms: float = 0.0
+    recovery_p99_ms: float = math.inf
+    recovery_max_error_rate: float = 1.0
+    recovery_max_cold_frame_rate: float = 1.0
+
+    def __post_init__(self):
+        if self.t_end_ms <= self.t_start_ms:
+            raise ValueError(
+                f"degraded window must have t_end_ms > t_start_ms "
+                f"({self.t_start_ms} .. {self.t_end_ms})")
+        if self.recover_by_ms < 0:
+            raise ValueError("recover_by_ms must be >= 0")
+
+    def contains(self, row: RequestRow) -> bool:
+        return self.t_start_ms <= row.t_send_ms < self.t_end_ms
+
+    def _has_recovery_bounds(self) -> bool:
+        return (self.recovery_p99_ms < math.inf
+                or self.recovery_max_error_rate < 1.0
+                or self.recovery_max_cold_frame_rate < 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
 class SLOSpec:
     """The whole contract: per-class bounds + global gates."""
 
@@ -73,6 +125,11 @@ class SLOSpec:
     max_retraces: int = 0              # warm steady state compiles nothing
     require_clean_metrics: bool = True
     max_late_send_rate: float = 1.0    # harness health, not server SLO
+    # Declared fault windows (chaos mode): class bounds then apply to
+    # STEADY rows only (those inside no window), each window judges its
+    # own slice against its degraded bounds, and recovery slices are
+    # checked per window (see DegradedWindow).
+    windows: Tuple[DegradedWindow, ...] = ()
 
 
 def _group_stats(rows: Sequence[RequestRow]) -> Dict:
@@ -162,8 +219,16 @@ def evaluate(spec: SLOSpec, rows: Sequence[RequestRow], *,
         groups[key].append(r)
     group_stats = {k: _group_stats(v) for k, v in sorted(groups.items())}
 
+    # Chaos mode: class bounds judge STEADY rows only — the declared
+    # windows carve their slices out and judge them against degraded
+    # bounds below.  Without windows, steady is everything (unchanged
+    # non-chaos behavior).
+    steady = ([r for r in rows
+               if not any(w.contains(r) for w in spec.windows)]
+              if spec.windows else list(rows))
+
     for cls in spec.classes:
-        sel = [r for r in rows if cls.matches(r)]
+        sel = [r for r in steady if cls.matches(r)]
         name = cls.selector()
         if not sel:
             check(name, "count", 0, 1, False)
@@ -199,6 +264,60 @@ def evaluate(spec: SLOSpec, rows: Sequence[RequestRow], *,
                   cls.max_cold_frame_rate,
                   v is not None and v <= cls.max_cold_frame_rate)
 
+    window_stats: Dict[str, Dict] = {}
+    for i, w in enumerate(spec.windows):
+        name = f"window[{i}]:{w.label}"
+        inside = [r for r in rows if w.contains(r)]
+        window_stats[name] = _group_stats(inside)
+        if not inside:
+            # A fault window no request ever hit certifies nothing.
+            check(name, "count", 0, 1, False)
+            continue
+        g = _group_stats(inside)
+        n = g["count"]
+        if w.p99_ms < math.inf:
+            v = g.get("p99_ms", math.nan)
+            check(name, "p99_ms", v, w.p99_ms,
+                  not math.isnan(v) and v <= w.p99_ms)
+        if w.max_shed_rate < 1.0:
+            v = g["shed"] / n
+            check(name, "shed_rate", v, w.max_shed_rate,
+                  v <= w.max_shed_rate)
+        if w.max_error_rate < 1.0:
+            v = (g["error"] + g["timeout"]) / n
+            check(name, "error_rate", v, w.max_error_rate,
+                  v <= w.max_error_rate)
+        if not w._has_recovery_bounds():
+            continue
+        rec = [r for r in rows
+               if r.t_send_ms >= w.t_end_ms + w.recover_by_ms]
+        window_stats[f"{name}:recovery"] = _group_stats(rec)
+        if not rec:
+            # Recovery bounds with no post-window traffic: the trace
+            # ended inside the fault — the recovery claim is untested.
+            check(name, "recovery_count", 0, 1, False)
+            continue
+        rg = _group_stats(rec)
+        rn = rg["count"]
+        if w.recovery_p99_ms < math.inf:
+            v = rg.get("p99_ms", math.nan)
+            check(name, "recovery_p99_ms", v, w.recovery_p99_ms,
+                  not math.isnan(v) and v <= w.recovery_p99_ms)
+        if w.recovery_max_error_rate < 1.0:
+            v = (rg["error"] + rg["timeout"]) / rn
+            check(name, "recovery_error_rate", v,
+                  w.recovery_max_error_rate,
+                  v <= w.recovery_max_error_rate)
+        if w.recovery_max_cold_frame_rate < 1.0:
+            # Vacuously green when the recovery slice has no stream
+            # frames — cold-frame rate is a warmth property, and a
+            # trace without sessions has no warmth to recover.
+            v = rg.get("cold_frame_rate")
+            check(name, "recovery_cold_frame_rate",
+                  math.nan if v is None else v,
+                  w.recovery_max_cold_frame_rate,
+                  v is None or v <= w.recovery_max_cold_frame_rate)
+
     if spec.max_late_send_rate < 1.0 and rows:
         late = sum(1 for r in rows if r.send_lag_ms > 0.0)
         v = late / len(rows)
@@ -226,6 +345,8 @@ def evaluate(spec: SLOSpec, rows: Sequence[RequestRow], *,
         "metrics": {"validator_errors": validator_errors,
                     "deltas": deltas},
     }
+    if spec.windows:
+        verdict["windows"] = window_stats
     # Wire-bytes/pair rides along whenever the client counted bytes:
     # the SLO statement is "N chips serve M users at SLO at B bytes/pair"
     # (docs/wire_format.md) — replaying the same trace under json vs

@@ -289,6 +289,12 @@ class ServeClient:
             payload["right"] = encode_array(np.asarray(right, np.float32))
             body = json.dumps(payload).encode()
             req_headers = {"Content-Type": "application/json"}
+        if deadline_ms is not None:
+            # The body field reaches the backend's scheduler; the header
+            # reaches the ROUTER, which decrements it by its own elapsed
+            # time at each hop and answers 504 itself once the budget is
+            # exhausted (docs/fault_tolerance.md "Deadline propagation").
+            req_headers["X-Deadline-Ms"] = f"{max(float(deadline_ms), 0.0):.0f}"
         self.bytes_sent += len(body)
         status, resp, headers = self._request("POST", "/predict", body,
                                               headers=req_headers)

@@ -438,6 +438,19 @@ class ClusterDispatcher:
             outcome=outcome).inc()
         return outcome
 
+    def evict_all(self) -> int:
+        """Drop every live session on every replica (the
+        ``evict_sessions`` chaos hook — StreamRunner contract).  Pins
+        are left alone: a pin without state just routes the session's
+        next frame to its old home, where it re-anchors cold."""
+        dropped = 0
+        for r in self.rset.replicas:
+            evictor = (getattr(r.stream, "evict_all", None)
+                       if r.stream is not None else None)
+            if evictor is not None:
+                dropped += evictor()
+        return dropped
+
     def step(self, session_id: str, seq_no: Optional[int],
              left: np.ndarray, right: np.ndarray,
              trace_id: Optional[str] = None,

@@ -77,7 +77,8 @@ class Replica:
 
     def __init__(self, rid: int, device, model, variables,
                  config: ServeConfig, metrics: ServeMetrics,
-                 tracer=None, fail_threshold: int = 3):
+                 tracer=None, fail_threshold: int = 3,
+                 fault_plan=None):
         self.rid = rid
         self.name = f"r{rid}"
         self.device = device
@@ -86,8 +87,12 @@ class Replica:
         # Scalar gauges are private per replica (see _ReplicaMetricsView);
         # the dispatcher aggregates them back onto the shared registry.
         self.metrics = _ReplicaMetricsView(metrics)
+        # fault_plan is the PROCESS-shared chaos plan (utils/faults.py):
+        # a slow_replica budget armed over /debug/faults reaches every
+        # replica's dispatch seam, and each consumed firing is counted
+        # once process-wide.
         self.engine = BatchEngine(model, variables, config, self.metrics,
-                                  device=device)
+                                  device=device, fault_plan=fault_plan)
         self.scheduler: Optional[IterationScheduler] = None
         self.batcher: Optional[DynamicBatcher] = None
         if config.sched is not None:
@@ -235,7 +240,7 @@ class ReplicaSet:
 
     def __init__(self, model, variables, config: ServeConfig,
                  metrics: Optional[ServeMetrics] = None, tracer=None,
-                 devices=None):
+                 devices=None, fault_plan=None):
         from ...parallel.mesh import replica_devices
 
         self.cfg = config
@@ -246,7 +251,8 @@ class ReplicaSet:
         self.replicas: List[Replica] = [
             Replica(i, dev, model, variables, config, self.metrics,
                     tracer=tracer,
-                    fail_threshold=self.cluster_cfg.fail_threshold)
+                    fail_threshold=self.cluster_cfg.fail_threshold,
+                    fault_plan=fault_plan)
             for i, dev in enumerate(devices)]
 
     def __len__(self) -> int:

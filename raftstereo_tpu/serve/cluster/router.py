@@ -53,9 +53,11 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import queue
 import socket
 import threading
 import time
+import zlib
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, quote, urlparse
@@ -65,23 +67,158 @@ from ...config import RouterConfig
 from ...obs import Tracer, build_info, dump_threads, trace_response
 from ...ops.autoscale import Autoscaler, load_capacity_model
 from ...utils.backoff import backoff_delay
+from ...utils.faults import FaultPlan
+from ...utils.profiling import LatencyHistogram
 from ..httpbase import WIRE_CHUNK, JsonRequestHandler
 from ..metrics import ClusterMetrics, MetricsRegistry
 from .pins import PinTable
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["Backend", "StereoRouter", "build_router"]
+__all__ = ["Backend", "CircuitBreaker", "StereoRouter", "build_router"]
+
+# cluster_breaker_state gauge encoding (docs/fault_tolerance.md).
+_BREAKER_LEVEL = {"closed": 0, "open": 1, "half_open": 2}
+
+
+class CircuitBreaker:
+    """Per-backend circuit breaker — pure policy, injected clock, no I/O.
+
+    ``closed`` -> ``open`` after ``fail_threshold`` consecutive
+    failures; ``open`` -> ``half_open`` once ``reset_s`` has elapsed (a
+    single trial is admitted — half-open exclusivity); ``half_open`` ->
+    ``closed`` on success, back to ``open`` (fresh reset window) on
+    failure.  Probe-driven recovery is deliberately two-step: the first
+    healthy probe after the reset window moves ``open`` ->
+    ``half_open`` and returns, the NEXT healthy verdict closes — one
+    lucky probe mid-flap never slams the breaker shut.
+
+    A request FAILURE is a transport failure (connect / response /
+    timeout phase).  Any HTTP reply — including a 503 shed — proves the
+    backend responsive and counts as success; load problems are the
+    spill/backoff machinery's job, not the breaker's.
+
+    ``listener(state)`` fires after each transition, outside the lock
+    (wired to the ``cluster_breaker_*`` metric families).
+    """
+
+    def __init__(self, fail_threshold: int, reset_s: float,
+                 clock=time.monotonic, listener=None):
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.reset_s = reset_s
+        self._clock = clock
+        self._listener = listener
+        self._lock = threading.Lock()
+        self._state = "closed"  # guarded_by: _lock
+        self._failures = 0  # guarded_by: _lock
+        self._opened_at = 0.0  # guarded_by: _lock
+        self._trial_inflight = False  # guarded_by: _lock
+
+    def current(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _notify(self, fired: Optional[str]) -> None:
+        # Listener dispatch stays OUTSIDE _lock: it touches metric
+        # series locks and must never nest under breaker state.
+        if fired is not None and self._listener is not None:
+            self._listener(fired)
+
+    def _open_locked(self) -> str:  # guarded_by: _lock
+        self._opened_at = self._clock()
+        self._failures = 0
+        self._trial_inflight = False
+        self._state = "open"
+        return self._state
+
+    def allow_request(self) -> bool:
+        """Admission check at backend-pick time.  While ``half_open``
+        at most one trial request is in flight until its verdict
+        lands (``record_success`` / ``record_failure``)."""
+        fired = None
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if self._clock() - self._opened_at >= self.reset_s:
+                    self._state = "half_open"
+                    fired = self._state
+                    self._trial_inflight = True
+                    allowed = True
+                else:
+                    allowed = False
+            else:  # half_open: single-trial exclusivity
+                allowed = not self._trial_inflight
+                if allowed:
+                    self._trial_inflight = True
+        self._notify(fired)
+        return allowed
+
+    def record_success(self) -> None:
+        fired = None
+        with self._lock:
+            if self._state == "half_open":
+                self._state = "closed"
+                fired = self._state
+            self._failures = 0
+            self._trial_inflight = False
+        self._notify(fired)
+
+    def record_failure(self) -> None:
+        fired = None
+        with self._lock:
+            if self._state == "half_open":
+                fired = self._open_locked()
+            elif self._state == "closed":
+                self._failures += 1
+                if self._failures >= self.fail_threshold:
+                    fired = self._open_locked()
+            else:
+                # Already open: the reset window keeps aging — repeated
+                # failures must not push recovery out forever.
+                self._trial_inflight = False
+        self._notify(fired)
+
+    def on_probe(self, ok: bool) -> None:
+        """Fold one health-probe verdict in (two-step recovery)."""
+        if not ok:
+            self.record_failure()
+            return
+        fired = None
+        with self._lock:
+            if self._state == "open":
+                if self._clock() - self._opened_at >= self.reset_s:
+                    self._state = "half_open"
+                    fired = self._state
+            elif self._state == "half_open":
+                self._state = "closed"
+                self._failures = 0
+                self._trial_inflight = False
+                fired = self._state
+            else:
+                self._failures = 0
+        self._notify(fired)
 
 
 class Backend:
-    """One backend server plus the router's view of its health."""
+    """One backend server plus the router's view of its health.
 
-    def __init__(self, bid: int, host: str, port: int):
+    The keyword arguments keep the bare ``Backend(bid, host, port)``
+    construction (unit tests, tools) working: they get a default
+    breaker that never reports transitions."""
+
+    def __init__(self, bid: int, host: str, port: int,
+                 fail_threshold: int = 2, breaker_reset_s: float = 5.0,
+                 clock=time.monotonic, breaker_listener=None):
         self.bid = bid
         self.name = f"b{bid}"
         self.host = host
         self.port = port
+        # breaker_listener receives (backend_name, new_state).
+        self.breaker = CircuitBreaker(
+            fail_threshold, breaker_reset_s, clock=clock,
+            listener=(None if breaker_listener is None else
+                      (lambda state: breaker_listener(self.name, state))))
         self._lock = threading.Lock()
         self.live = False  # guarded_by: _lock
         self.ready = False  # guarded_by: _lock
@@ -120,6 +257,9 @@ class Backend:
 
     def on_probe(self, health: Optional[Dict], fail_after: int) -> None:
         """Fold one probe result (None = probe failed) into the state."""
+        # Feed the breaker first, outside _lock (its own lock + the
+        # transition listener must never nest under backend state).
+        self.breaker.on_probe(health is not None)
         with self._lock:
             if health is None:
                 self._probe_failures += 1
@@ -157,6 +297,7 @@ class Backend:
                 "queue_depth": self._queue_depth,
                 "inflight": self.inflight,
                 "probe_failures": self._probe_failures,
+                "breaker": self.breaker.current(),
             }
 
 
@@ -175,10 +316,55 @@ def _http_json(host: str, port: int, method: str, path: str,
         conn.close()
 
 
+class _ProbeSchedule:
+    """Deterministic per-backend probe cadence with thundering-herd
+    jitter — pure policy, clock injected through explicit ``now``
+    arguments (unit-testable without sockets or sleeps).
+
+    With N backends on one synchronized period every probe round lands
+    N near-simultaneous /healthz hits on the fleet (and on any shared
+    health path behind it).  Instead each backend gets a deterministic
+    fraction ``frac = (crc32(name) % 997) / 997`` spreading both the
+    PHASE (first probe at ``frac * interval``) and the PERIOD
+    (``interval * (1 + frac/2)``) — distinct backends decorrelate and
+    STAY decorrelated instead of re-synchronizing every lcm, and the
+    schedule is identical across router restarts (no RNG)."""
+
+    def __init__(self, names, interval_s: float, now: float = 0.0):
+        self.interval_s = interval_s
+        self._period: Dict[str, float] = {}
+        self._next: Dict[str, float] = {}
+        for name in names:
+            frac = (zlib.crc32(name.encode()) % 997) / 997.0
+            self._period[name] = interval_s * (1.0 + 0.5 * frac)
+            self._next[name] = now + frac * interval_s
+
+    def period_s(self, name: str) -> float:
+        return self._period[name]
+
+    def due(self, now: float) -> List[str]:
+        """Backends due at ``now``, each advanced PAST ``now`` — a late
+        round never bursts catch-up probes."""
+        out = []
+        for name in sorted(self._next, key=self._next.get):
+            t = self._next[name]
+            if t <= now:
+                out.append(name)
+                period = self._period[name]
+                missed = int((now - t) // period) + 1
+                self._next[name] = t + missed * period
+        return out
+
+    def next_wake(self, now: float) -> float:
+        """Seconds until the earliest pending probe (>= 0)."""
+        return max(min(self._next.values()) - now, 0.0)
+
+
 class _Prober(threading.Thread):
-    """Polls every backend's /healthz on a fixed cadence and refreshes
-    the cluster gauges — the router's only source of backend readiness
-    besides in-flight connection failures."""
+    """Polls each backend's /healthz on its own jittered cadence
+    (``_ProbeSchedule``) and refreshes the cluster gauges — the
+    router's only source of backend readiness besides in-flight
+    connection failures."""
 
     def __init__(self, router: "StereoRouter"):
         super().__init__(name="router-prober", daemon=True)
@@ -188,37 +374,53 @@ class _Prober(threading.Thread):
     def stop(self) -> None:
         self._stop.set()
 
-    def probe_once(self) -> None:
+    def _probe_backend(self, b: Backend) -> None:
         cfg = self.router.config
-        for b in self.router.backends:
-            try:
-                status, health = _http_json(
-                    b.host, b.port, "GET", "/healthz",
-                    timeout=cfg.probe_timeout_s)
-                b.on_probe(health if status == 200 else None,
-                           cfg.fail_after)
-                if status != 200:
-                    self.router.cluster_metrics.probe_failures.labels(
-                        replica=b.name).inc()
-            except (OSError, ValueError):
-                # ValueError covers JSONDecodeError: a backend answering
-                # non-JSON on /healthz (wrong port, an intermediary's
-                # HTML error page) is a FAILED probe for that backend —
-                # never an exception that aborts the round (or, at
-                # startup, the router) and leaves the other backends'
-                # health stale.
-                b.on_probe(None, cfg.fail_after)
+        try:
+            status, health = _http_json(
+                b.host, b.port, "GET", "/healthz",
+                timeout=cfg.probe_timeout_s)
+            b.on_probe(health if status == 200 else None,
+                       cfg.fail_after)
+            if status != 200:
                 self.router.cluster_metrics.probe_failures.labels(
                     replica=b.name).inc()
+        except (OSError, ValueError):
+            # ValueError covers JSONDecodeError: a backend answering
+            # non-JSON on /healthz (wrong port, an intermediary's
+            # HTML error page) is a FAILED probe for that backend —
+            # never an exception that aborts the round (or, at
+            # startup, the router) and leaves the other backends'
+            # health stale.
+            b.on_probe(None, cfg.fail_after)
+            self.router.cluster_metrics.probe_failures.labels(
+                replica=b.name).inc()
+
+    def probe_once(self) -> None:
+        """Probe ALL backends synchronously (router start: the first
+        routing decision needs every backend's health, jitter or not)."""
+        for b in self.router.backends:
+            self._probe_backend(b)
         self.router.refresh_gauges()
 
     def run(self) -> None:
+        sched = _ProbeSchedule(
+            [b.name for b in self.router.backends],
+            self.router.config.probe_interval_s,
+            now=time.monotonic())
+        by_name = {b.name: b for b in self.router.backends}
         while not self._stop.is_set():
-            try:
-                self.probe_once()
-            except Exception:  # pragma: no cover - defensive
-                logger.exception("health probe round failed")
-            self._stop.wait(self.router.config.probe_interval_s)
+            due = sched.due(time.monotonic())
+            if due:
+                try:
+                    for name in due:
+                        self._probe_backend(by_name[name])
+                    self.router.refresh_gauges()
+                except Exception:  # pragma: no cover - defensive
+                    logger.exception("health probe round failed")
+            # 5 ms floor so a due-now edge never busy-spins.
+            self._stop.wait(max(sched.next_wake(time.monotonic()),
+                                0.005))
 
 
 class _RouterHandler(JsonRequestHandler):
@@ -338,6 +540,31 @@ class _RouterHandler(JsonRequestHandler):
                     "recommended); the readiness probe gates its rejoin",
         })
 
+    def _arm_faults(self, rt: "StereoRouter", raw: bytes) -> None:
+        """POST /debug/faults ``{"faults": SPEC}``: arm serving-plane
+        fault entries at runtime — the seam the loadgen chaos
+        controller drives plan entries through against trace time
+        (loadgen/chaos.py, docs/fault_tolerance.md)."""
+        try:
+            spec = json.loads(raw or b"{}").get("faults", "")
+            armed = rt.fault_plan.extend(str(spec or ""))
+        except ValueError as e:
+            self._json(400, {"error": f"bad fault spec: {e}"})
+            return
+        self._json(200, {"armed": [f.spec() for f in armed]})
+
+    def _header_deadline(self) -> Optional[float]:
+        """Client deadline budget from ``X-Deadline-Ms`` (None when
+        absent or unparseable — a garbled optional header must not
+        fail a request that never asked for a deadline contract)."""
+        raw = self.headers.get("X-Deadline-Ms")
+        if raw is None:
+            return None
+        try:
+            return max(float(raw), 0.0)
+        except ValueError:
+            return None
+
     def do_POST(self):
         rt: "StereoRouter" = self.server
         path = urlparse(self.path).path
@@ -357,6 +584,9 @@ class _RouterHandler(JsonRequestHandler):
         if path == "/debug/restart":
             self._restart(rt, raw)
             return
+        if path == "/debug/faults":
+            self._arm_faults(rt, raw)
+            return
         if path != "/predict":
             self._json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -374,7 +604,8 @@ class _RouterHandler(JsonRequestHandler):
                        {"X-Request-Id": rid})
             return
         status, body, ctype, headers = rt.route_predict(
-            raw, session_id, rid, accept=self.headers.get("Accept"))
+            raw, session_id, rid, accept=self.headers.get("Accept"),
+            deadline_ms=self._header_deadline())
         self._send(status, body, ctype, headers)
 
     def _predict_stream(self, rt: "StereoRouter") -> None:
@@ -434,7 +665,8 @@ class _RouterHandler(JsonRequestHandler):
         rt.route_predict_stream(self, head + meta_raw,
                                 length - wire.HEADER_SIZE - meta_len,
                                 session_id, rid,
-                                accept=self.headers.get("Accept"))
+                                accept=self.headers.get("Accept"),
+                                deadline_ms=self._header_deadline())
 
 
 class StereoRouter(ThreadingHTTPServer):
@@ -448,15 +680,31 @@ class StereoRouter(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, config: RouterConfig,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 fault_plan: Optional[FaultPlan] = None):
         assert config.backends, "a router needs at least one backend"
         self.config = config
-        self.backends: List[Backend] = [
-            Backend(i, host, port)
-            for i, (host, port) in enumerate(config.backends)]
+        # Metrics before backends: the breaker transition listener
+        # writes cluster_breaker_* the moment any breaker moves.
         self.registry = MetricsRegistry()
         self.cluster_metrics = ClusterMetrics(self.registry)
+        self.backends: List[Backend] = [
+            Backend(i, host, port,
+                    fail_threshold=config.fail_after,
+                    breaker_reset_s=config.breaker_reset_s,
+                    breaker_listener=self._on_breaker)
+            for i, (host, port) in enumerate(config.backends)]
         self.tracer = tracer or Tracer(capacity=config.trace_buffer)
+        # FULL forward latency (connect -> last response byte) feeding
+        # the hedge delay.  Intentionally NOT a registered family:
+        # cluster_router_hop_latency_seconds excludes backend compute
+        # by design, and the hedge policy needs the end-to-end p99.
+        self._fwd_latency = LatencyHistogram()
+        # Serving-plane fault plan (utils/faults.py): armed from
+        # RAFTSTEREO_FAULTS at construction, extended at runtime over
+        # POST /debug/faults by the chaos controller.
+        self.fault_plan = (fault_plan if fault_plan is not None
+                           else FaultPlan.from_env()).arm()
         # session_id -> backend bid (same LRU pin policy — and the same
         # PinTable implementation — as the in-process dispatcher: an
         # evicted pin behaves exactly like a lost session, the next
@@ -645,12 +893,21 @@ class StereoRouter(ThreadingHTTPServer):
         self.cluster_metrics.dispatch.labels(
             replica=backend.name, outcome=outcome).inc()
 
+    def _on_breaker(self, name: str, state: str) -> None:
+        """CircuitBreaker transition listener (fires outside the
+        breaker lock): export the move and the new level."""
+        cm = self.cluster_metrics
+        cm.breaker_transitions.labels(backend=name, to=state).inc()
+        cm.breaker_state.labels(backend=name).set(_BREAKER_LEVEL[state])
+
     def refresh_gauges(self) -> None:
         cm = self.cluster_metrics
         states: Dict[str, int] = {}
         for b in self.backends:
             states[b.state()] = states.get(b.state(), 0) + 1
             cm.queue_depth.labels(replica=b.name).set(b.outstanding())
+            cm.breaker_state.labels(backend=b.name).set(
+                _BREAKER_LEVEL[b.breaker.current()])
         cm.set_states(states)
         ready = [b for b in self.backends if b.routable()]
         # Utilization proxy without knowing backend batch capacity: the
@@ -676,7 +933,8 @@ class StereoRouter(ThreadingHTTPServer):
         return self._advice
 
     def _forward(self, backend: Backend, raw: bytes, rid: str,
-                 accept: Optional[str] = None
+                 accept: Optional[str] = None,
+                 deadline_left_ms: Optional[float] = None
                  ) -> Tuple[str, int, bytes, str, Dict[str, str]]:
         """One proxy attempt.  Returns (phase, status, body, ctype,
         headers): phase ``"ok"`` carries a backend reply; ``"connect"``
@@ -693,6 +951,12 @@ class StereoRouter(ThreadingHTTPServer):
                        "X-Request-Id": rid}
         if accept:
             headers_out["Accept"] = accept
+        if deadline_left_ms is not None:
+            # Deadline propagation: the budget the BACKEND sees already
+            # has this hop's queueing/backoff elapsed subtracted — it
+            # never computes an answer the client has abandoned.
+            headers_out["X-Deadline-Ms"] = (
+                f"{max(deadline_left_ms, 1.0):.0f}")
         try:
             try:
                 conn.request("POST", "/predict", body=raw,
@@ -716,8 +980,137 @@ class StereoRouter(ThreadingHTTPServer):
         finally:
             conn.close()
 
+    def _forward_timed(self, backend: Backend, raw: bytes, rid: str,
+                       accept: Optional[str] = None,
+                       deadline_left_ms: Optional[float] = None
+                       ) -> Tuple[str, int, bytes, str, Dict[str, str]]:
+        """``_forward`` plus the bookkeeping every attempt owes:
+        inflight begin/end, the breaker verdict (any HTTP reply =
+        responsive = success), and the full-forward latency sample the
+        hedge delay derives its p99 from."""
+        backend.begin()
+        t = time.perf_counter()
+        try:
+            result = self._forward(backend, raw, rid, accept,
+                                   deadline_left_ms)
+        finally:
+            backend.end()
+        if result[0] == "ok":
+            backend.breaker.record_success()
+            self._fwd_latency.observe(time.perf_counter() - t)
+        else:
+            backend.breaker.record_failure()
+        return result
+
+    def _pick_cold(self, tried: List[int]) -> Optional[Backend]:
+        """Least-outstanding ready backend whose breaker admits the
+        request.  A breaker-open backend is skipped (recorded as
+        ``breaker_open``) and the request SPILLS to the next ready
+        backend.  Session pins bypass this path on purpose: stickiness
+        beats breaker pessimism — a pinned backend that is truly down
+        fails its forward, which re-feeds the breaker anyway."""
+        for b in self._ready_backends(exclude=tuple(tried)):
+            if b.breaker.allow_request():
+                return b
+            self._record(b, "breaker_open")
+        return None
+
+    def _pick_hedge(self, tried: List[int]) -> Optional[Backend]:
+        """Hedge target: next admitting ready backend not yet tried
+        (no metric on a skip — a hedge that finds no spare backend
+        simply does not fire)."""
+        for b in self._ready_backends(exclude=tuple(tried)):
+            if b.breaker.allow_request():
+                return b
+        return None
+
+    def _hedge_delay_s(self) -> Optional[float]:
+        """Seconds to wait before hedging a cold JSON request, or None
+        when hedging is disabled (``hedge_floor_ms == 0``, the
+        default).  Tracks the live full-forward p99 once enough
+        samples exist so the hedge only fires on genuinely tail-slow
+        forwards; the floor guards the cold-start phase where p99 is
+        noise."""
+        cfg = self.config
+        if cfg.hedge_floor_ms <= 0:
+            return None
+        floor = cfg.hedge_floor_ms / 1e3
+        if self._fwd_latency.count >= cfg.hedge_min_samples:
+            return max(floor, self._fwd_latency.quantile(0.99))
+        return floor
+
+    def _forward_hedged(self, primary: Backend, raw: bytes, rid: str,
+                        accept: Optional[str], tried: List[int],
+                        is_session: bool,
+                        deadline_left_ms: Optional[float] = None
+                        ) -> Tuple[Backend, str, int, bytes, str,
+                                   Dict[str, str]]:
+        """Forward with an optional hedged second request (cold JSON
+        only — idempotent per the PR 8 ``_RetrySafe`` analysis; never
+        sessions, and the binary stream path cannot replay its body).
+        The primary runs in a worker thread; if no reply lands within
+        the hedge delay a second request fires at the next admitting
+        backend and the first OK reply wins.  The loser's socket is
+        abandoned — its thread ends when its own timeout fires, and
+        its breaker/latency bookkeeping still lands via
+        ``_forward_timed``.  Returns (backend_used, phase, status,
+        body, ctype, headers)."""
+        delay = None if is_session else self._hedge_delay_s()
+        if delay is None:
+            return (primary,) + self._forward_timed(
+                primary, raw, rid, accept, deadline_left_ms)
+        results: "queue.Queue" = queue.Queue()
+
+        def attempt(b: Backend) -> None:
+            results.put((b,) + self._forward_timed(
+                b, raw, rid, accept, deadline_left_ms))
+
+        threading.Thread(target=attempt, args=(primary,),
+                         name=f"hedge-p-{rid[:8]}", daemon=True).start()
+        contenders = 1
+        hedged = False
+        try:
+            res = results.get(timeout=delay)
+        except queue.Empty:
+            res = None
+            hedge = self._pick_hedge(tried)
+            if hedge is not None:
+                tried.append(hedge.bid)
+                self.cluster_metrics.hedges.labels(outcome="fired").inc()
+                threading.Thread(target=attempt, args=(hedge,),
+                                 name=f"hedge-h-{rid[:8]}",
+                                 daemon=True).start()
+                contenders = 2
+                hedged = True
+        # A failed arrival waits for the other contender (bounded by
+        # the per-attempt socket timeout each thread already carries).
+        budget = self.config.request_timeout_s + 5.0
+        seen: List[Tuple] = []
+        while True:
+            if res is None:
+                if len(seen) >= contenders:
+                    break
+                try:
+                    res = results.get(timeout=budget)
+                except queue.Empty:  # pragma: no cover - defensive
+                    break
+            seen.append(res)
+            if res[1] == "ok":
+                break
+            res = None
+        winner = next((r for r in seen if r[1] == "ok"), None)
+        if winner is None:
+            winner = seen[-1] if seen else (
+                primary, "timeout", 0, b"", "application/json", {})
+        if hedged:
+            self.cluster_metrics.hedges.labels(
+                outcome=("won" if winner[1] == "ok"
+                         and winner[0] is not primary else "lost")).inc()
+        return winner
+
     def route_predict(self, raw: bytes, session_id: Optional[str],
-                      rid: str, accept: Optional[str] = None
+                      rid: str, accept: Optional[str] = None,
+                      deadline_ms: Optional[float] = None
                       ) -> Tuple[int, bytes, str, Dict[str, str]]:
         """Pick a backend and proxy; bounded failover for cold requests.
         Never blocks without a timeout and never retries work that may
@@ -731,12 +1124,28 @@ class StereoRouter(ThreadingHTTPServer):
         detail = "no ready backend"
         spilled_shed = False
         for attempt in range(attempts):
+            left_ms = None
+            if deadline_ms is not None:
+                left_ms = deadline_ms - (time.perf_counter() - t0) * 1e3
+                if left_ms <= 0.0:
+                    # The client's budget died at this hop (queueing,
+                    # earlier failed attempts, backoff) — answering 504
+                    # here is cheaper than letting a backend compute a
+                    # disparity nobody reads.
+                    self.tracer.record(
+                        "route", t0, time.perf_counter(), rid,
+                        attrs={"attempts": len(tried), "status": 504,
+                               "detail": "deadline exhausted"})
+                    return 504, json.dumps(
+                        {"error": "timeout",
+                         "detail": "deadline exhausted at the router "
+                                   "hop"}).encode(), \
+                        "application/json", {"X-Request-Id": rid}
             if is_session:
                 backend = self._pin_backend(str(session_id),
                                             exclude=tuple(tried))
             else:
-                cands = self._ready_backends(exclude=tuple(tried))
-                backend = cands[0] if cands else None
+                backend = self._pick_cold(tried)
             if backend is None:
                 break
             tried.append(backend.bid)
@@ -750,13 +1159,10 @@ class StereoRouter(ThreadingHTTPServer):
                 time.sleep(backoff_delay(cfg.retry_backoff_ms,
                                          attempt - 1))
             spilled_shed = False
-            backend.begin()
             t_fwd = time.perf_counter()
-            try:
-                phase, status, body, ctype, headers = self._forward(
-                    backend, raw, rid, accept)
-            finally:
-                backend.end()
+            backend, phase, status, body, ctype, headers = \
+                self._forward_hedged(backend, raw, rid, accept, tried,
+                                     is_session, left_ms)
             self.tracer.record(
                 "router_hop", t_fwd, time.perf_counter(), rid,
                 attrs={"backend": backend.name, "attempt": attempt,
@@ -831,7 +1237,9 @@ class StereoRouter(ThreadingHTTPServer):
     def route_predict_stream(self, handler, prefix: bytes,
                              remaining: int, session_id: Optional[str],
                              rid: str,
-                             accept: Optional[str] = None) -> None:
+                             accept: Optional[str] = None,
+                             deadline_ms: Optional[float] = None
+                             ) -> None:
         """Forward a binary /predict without ever holding the full body.
 
         ``prefix`` is the already-peeked header + meta block (needed for
@@ -858,12 +1266,26 @@ class StereoRouter(ThreadingHTTPServer):
         conn = None
         backend = None
         for attempt in range(attempts):
+            left_ms = None
+            if deadline_ms is not None:
+                left_ms = deadline_ms - (time.perf_counter() - t0) * 1e3
+                if left_ms <= 0.0:
+                    # The unread body is still on the client socket:
+                    # drain it first so the reply lands on a keep-alive
+                    # connection in a defined state.
+                    self._drain_client(handler, remaining)
+                    self._json_reply(
+                        handler, 504,
+                        {"error": "timeout",
+                         "detail": "deadline exhausted at the router "
+                                   "hop"},
+                        {"X-Request-Id": rid})
+                    return
             if is_session:
                 backend = self._pin_backend(str(session_id),
                                             exclude=tuple(tried))
             else:
-                cands = self._ready_backends(exclude=tuple(tried))
-                backend = cands[0] if cands else None
+                backend = self._pick_cold(tried)
             if backend is None:
                 break
             tried.append(backend.bid)
@@ -881,10 +1303,14 @@ class StereoRouter(ThreadingHTTPServer):
                 conn.putheader("X-Request-Id", rid)
                 if accept:
                     conn.putheader("Accept", accept)
+                if left_ms is not None:
+                    conn.putheader("X-Deadline-Ms",
+                                   f"{max(left_ms, 1.0):.0f}")
                 conn.endheaders()
                 conn.send(prefix)
             except OSError:
                 backend.mark_unreachable()
+                backend.breaker.record_failure()
                 self._record(backend, "connect_error")
                 detail = f"backend {backend.name} connect failure"
                 conn.close()
@@ -903,6 +1329,13 @@ class StereoRouter(ThreadingHTTPServer):
         t_fwd = time.perf_counter()
         sent = len(prefix)
         peak = len(prefix)
+        # corrupt_frame@request=N chaos hook: bit-flip ONE payload byte
+        # of the next relayed frame mid-pump — wire-plane corruption
+        # between hops.  The backend's FrameDecoder rejects the frame
+        # (zlib/consistency failure -> WireError -> clean 400 with the
+        # request id) and the reply relays like any other; the stream
+        # stays length-framed so neither socket hangs.
+        corrupt = self.fault_plan.corrupt_stream()
         try:
             try:
                 left = remaining
@@ -913,12 +1346,19 @@ class StereoRouter(ThreadingHTTPServer):
                         handler.close_connection = True
                         self._record(backend, "error")
                         return
+                    if corrupt:
+                        corrupt = False
+                        i = len(chunk) // 2
+                        chunk = (chunk[:i]
+                                 + bytes((chunk[i] ^ 0xFF,))
+                                 + chunk[i + 1:])
                     conn.send(chunk)
                     left -= len(chunk)
                     sent += len(chunk)
                     peak = max(peak, len(chunk))
             except (socket.timeout, OSError):
                 backend.mark_unreachable()
+                backend.breaker.record_failure()
                 self._record(backend, "error")
                 self._drain_client(handler, left)
                 self._json_reply(
@@ -931,6 +1371,7 @@ class StereoRouter(ThreadingHTTPServer):
             try:
                 resp = conn.getresponse()
             except socket.timeout:
+                backend.breaker.record_failure()
                 self._record(backend, "timeout")
                 self._json_reply(
                     handler, 504,
@@ -941,6 +1382,7 @@ class StereoRouter(ThreadingHTTPServer):
                 return
             except (http.client.HTTPException, OSError):
                 backend.mark_unreachable()
+                backend.breaker.record_failure()
                 self._record(backend, "error")
                 self._json_reply(
                     handler, 503,
@@ -949,6 +1391,7 @@ class StereoRouter(ThreadingHTTPServer):
                                f"mid-stream"},
                     {"X-Request-Id": rid, "Retry-After": "1"})
                 return
+            backend.breaker.record_success()
             self._record(backend, {200: "ok", 503: "shed",
                                    504: "timeout"}.get(resp.status,
                                                        "error"))

@@ -50,8 +50,16 @@ class BatchEngine:
     """Batched test-mode forward behind a shape-bucketed compile cache."""
 
     def __init__(self, model, variables, config: ServeConfig,
-                 metrics: Optional[ServeMetrics] = None, device=None):
+                 metrics: Optional[ServeMetrics] = None, device=None,
+                 fault_plan=None):
         self.model = model
+        # Serving-plane chaos seam (utils/faults.py FaultPlan or None):
+        # ``slow_replica@request=N:SECS`` injects dispatch latency at
+        # the top of ``_dispatch`` — a replica that is alive but slow,
+        # the hedged-request trigger.  Host-side only: the sleep
+        # happens before any device work, so chaos runs add ZERO new
+        # XLA compiles.
+        self.fault_plan = fault_plan
         # ``device`` pins every executable (and the weights) to one chip:
         # the replicated cluster (serve/cluster/) builds one engine per
         # device from parallel.mesh.replica_devices, each with its OWN
@@ -497,6 +505,13 @@ class BatchEngine:
         # warmup missed it.
         labels = dict(bucket=f"{key[0]}x{key[1]}", iters=str(key[2]),
                       mode=kind, tier=key[-1])
+        if self.fault_plan is not None:
+            # slow_replica chaos: sleep BEFORE taking the engine lock so
+            # the injected latency models a slow device, not a convoy —
+            # concurrent stream dispatches on other engines proceed.
+            delay = self.fault_plan.dispatch_delay()
+            if delay > 0.0:
+                time.sleep(delay)
         with self._lock:
             with self._stats_lock:
                 miss = key not in self._compiled

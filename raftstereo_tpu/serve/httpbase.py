@@ -66,6 +66,21 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         return (self.headers.get("X-Request-Id") or "")[:64] \
             or uuid.uuid4().hex
 
+    def _maybe_blackhole(self) -> float:
+        """``blackhole_backend@t_ms`` chaos seam (utils/faults.py):
+        while the owning server's fault plan has an active blackhole
+        window, HOLD this request — the connection was accepted, the
+        request is parsed, but nothing is answered until the window
+        closes (then the request proceeds normally).  Probes time out
+        against their short ``probe_timeout_s`` and the router's
+        circuit breaker opens; nothing is lost, only late.  Returns
+        the seconds held (0.0 in the common no-fault path — the
+        getattr keeps the seam free for servers without a plan)."""
+        plan = getattr(self.server, "fault_plan", None)
+        if plan is None:
+            return 0.0
+        return plan.blackhole_hold()
+
     def _send(self, code: int, body: bytes, ctype: str,
               extra_headers: Optional[Dict[str, str]] = None) -> None:
         self.send_response(code)

@@ -426,6 +426,25 @@ class ClusterMetrics:
             "for any request — bounded by the 64 KiB pump window no "
             "matter the pair size, which is the router's "
             "never-buffers-a-full-body guarantee")
+        self.breaker_state = r.gauge(
+            "cluster_breaker_state",
+            "per-backend circuit-breaker state (0 = closed, 1 = open, "
+            "2 = half_open); router only (docs/fault_tolerance.md "
+            "\"Circuit breaker\")",
+            labels=("backend",))
+        self.breaker_transitions = r.counter(
+            "cluster_breaker_transitions_total",
+            "circuit-breaker state transitions per backend, by the state "
+            "entered (open/half_open/closed) — the counter the chaos "
+            "verdict asserts on (gauges race a recovery)",
+            labels=("backend", "to"))
+        self.hedges = r.counter(
+            "cluster_hedges_total",
+            "hedged cold-request forwards by outcome: fired (a hedge was "
+            "launched after the hedge delay), won (the hedge's reply was "
+            "used), lost (the primary answered first; the hedge socket "
+            "was abandoned)",
+            labels=("outcome",))
 
     def set_states(self, states: Dict[str, int]) -> None:
         """Overwrite the per-state replica gauge (absent states -> 0, so

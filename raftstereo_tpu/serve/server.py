@@ -73,6 +73,7 @@ import numpy as np
 from .. import wire
 from ..config import ServeConfig
 from ..obs import Tracer, build_info, dump_threads, trace_response
+from ..utils.faults import FaultPlan
 from ..utils.profiling import OnDemandProfiler, ProfilerBusy
 from .batcher import DynamicBatcher, Overloaded, RequestTimedOut, ShuttingDown
 from .engine import BatchEngine
@@ -237,8 +238,25 @@ class _Handler(JsonRequestHandler):
     # ------------------------------------------------------------- endpoints
     def do_GET(self):
         srv: "StereoServer" = self.server
+        # blackhole_backend chaos: hold EVERY reply (probes included —
+        # they time out against probe_timeout_s, which is the point)
+        # while a fault window is active; a no-op otherwise.
+        self._maybe_blackhole()
         url = urlparse(self.path)
         if url.path == "/healthz":
+            ready = srv.is_ready
+            if srv.fault_plan.healthz_lie():
+                # flap_probe chaos: this reply LIES ready=false on a
+                # perfectly healthy server — probe flapping with no
+                # underlying fault (the router must ride it out
+                # without dropping accepted work).
+                ready = False
+            if srv.fault_plan.evict_due():
+                # evict_sessions chaos: piggybacked on the probe
+                # cadence — the store empties within one probe
+                # interval of the armed offset, every live stream's
+                # next frame re-anchors cold.
+                srv.evict_sessions()
             health = {
                 "status": "ok",
                 # live vs ready (k8s-style): live = the process answers;
@@ -246,7 +264,7 @@ class _Handler(JsonRequestHandler):
                 # routed here will not pay a cold compile.  The cluster
                 # router gates on ready, never on live.
                 "live": True,
-                "ready": srv.is_ready,
+                "ready": ready,
                 "draining": srv.draining,
                 "drained": srv.drained,
                 "queue_depth": srv.queue_depth,
@@ -366,9 +384,29 @@ class _Handler(JsonRequestHandler):
 
     def do_POST(self):
         srv: "StereoServer" = self.server
+        # blackhole_backend chaos (see do_GET): requests are accepted
+        # and parsed, replies held until the window closes — late, not
+        # lost.  Arming POSTs land BEFORE their own window starts
+        # (@t_ms offsets are measured from arming), so /debug/faults
+        # itself is never blocked by the fault it arms.
+        self._maybe_blackhole()
         path = urlparse(self.path).path
         if path == "/debug/profile":
             self._debug_profile(srv)
+            return
+        if path == "/debug/faults":
+            # Runtime fault arming ({"faults": SPEC}) — the chaos
+            # controller's seam (loadgen/chaos.py).
+            raw = self._read_body(srv.config.max_body_mb)
+            if raw is None:
+                return
+            try:
+                spec = json.loads(raw or b"{}").get("faults", "")
+                armed = srv.fault_plan.extend(str(spec or ""))
+            except ValueError as e:
+                self._json(400, {"error": f"bad fault spec: {e}"})
+                return
+            self._json(200, {"armed": [f.spec() for f in armed]})
             return
         if path == "/debug/drain":
             # Explicit drain (the router's scale-in/maintenance hook):
@@ -514,6 +552,26 @@ class _Handler(JsonRequestHandler):
                 session_id = payload.get("session_id")
                 seq_no = payload.get("seq_no")
                 deadline_ms = payload.get("deadline_ms")
+                # Deadline propagation (docs/fault_tolerance.md): a
+                # router hop forwards the client's remaining budget in
+                # X-Deadline-Ms, already decremented by its own elapsed
+                # time.  Merge via min() — the tighter of body field
+                # and header wins — but only where a body deadline
+                # would be accepted anyway (scheduler present, cold
+                # request): elsewhere the header is silently ignored,
+                # a propagated hint must never 400 a request that
+                # did not ask for a deadline contract.
+                hdr = self.headers.get("X-Deadline-Ms")
+                if (hdr is not None and srv.scheduler is not None
+                        and session_id is None):
+                    try:
+                        hdr_ms = float(hdr)
+                    except ValueError:
+                        hdr_ms = None
+                    if hdr_ms is not None:
+                        deadline_ms = (hdr_ms if deadline_ms is None
+                                       else min(float(deadline_ms),
+                                                hdr_ms))
                 priority = payload.get("priority")
                 accuracy = payload.get("accuracy")
                 spatial = payload.get("spatial")
@@ -906,7 +964,8 @@ class StereoServer(ThreadingHTTPServer):
                  scheduler: Optional[IterationScheduler] = None,
                  cluster=None, start_ready: bool = True,
                  tiers: Optional[Dict[str, str]] = None,
-                 tier_reasons: Optional[Dict[str, str]] = None):
+                 tier_reasons: Optional[Dict[str, str]] = None,
+                 fault_plan: Optional[FaultPlan] = None):
         assert (batcher is None) != (scheduler is None), (
             "exactly one of batcher (monolithic dispatch) or scheduler "
             "(iteration-level continuous batching) must be set")
@@ -930,6 +989,14 @@ class StereoServer(ThreadingHTTPServer):
         # (healthz block, drain fan-out).
         self.cluster = cluster
         self.tracer = tracer or Tracer(capacity=config.trace_buffer)
+        # Serving-plane fault plan (utils/faults.py): armed from
+        # RAFTSTEREO_FAULTS at construction, extended at runtime over
+        # POST /debug/faults — always a plan (usually empty), so the
+        # handler hooks never branch on None.  build_server shares ONE
+        # plan between the server and its engine(s) so one /debug/faults
+        # POST arms every hook in the process.
+        self.fault_plan = (fault_plan if fault_plan is not None
+                           else FaultPlan.from_env()).arm()
         self.profiler = OnDemandProfiler(log_dir="runs/serve/profile")
         # Readiness (live vs ready on /healthz): set once warmup
         # finishes.  build_server passes start_ready=False and owns the
@@ -1073,6 +1140,22 @@ class StereoServer(ThreadingHTTPServer):
             return "cold_lost"
         return self.stream.import_session(snapshot)
 
+    def evict_sessions(self) -> int:
+        """Drop every live streaming session (the ``evict_sessions``
+        chaos hook, fired from /healthz so it lands within one probe
+        interval of its armed offset).  ``self.stream`` is the
+        StreamRunner or the cluster dispatcher — both implement
+        ``evict_all``.  Returns sessions dropped; losing state is the
+        documented cold fallback, never an error."""
+        evictor = (getattr(self.stream, "evict_all", None)
+                   if self.stream is not None else None)
+        if evictor is None:
+            return 0
+        n = evictor()
+        if n:
+            logger.warning("fault injection: evicted %d live sessions", n)
+        return n
+
     def close(self) -> None:
         """Stop accepting, drain the queue, release the socket."""
         self.shutdown()
@@ -1105,6 +1188,10 @@ def build_server(model, variables, config: ServeConfig,
     """
     metrics = metrics or ServeMetrics()
     tracer = tracer or Tracer(capacity=config.trace_buffer)
+    # ONE fault plan for the whole process (server + every engine): a
+    # single POST /debug/faults arms every hook, and a count budget is
+    # consumed once process-wide (utils/faults.py).
+    fault_plan = FaultPlan.from_env().arm()
     if config.spatial_shards > 1 and config.cluster is not None:
         raise ValueError(
             "spatial sharding and cluster replicas are mutually exclusive "
@@ -1135,7 +1222,8 @@ def build_server(model, variables, config: ServeConfig,
     if config.cluster is not None:
         from .cluster import ClusterDispatcher, ReplicaSet
 
-        rset = ReplicaSet(model, variables, config, metrics, tracer=tracer)
+        rset = ReplicaSet(model, variables, config, metrics, tracer=tracer,
+                          fault_plan=fault_plan)
         cluster = ClusterDispatcher(rset, config, metrics, tracer=tracer)
         engine = rset.engine
         # The dispatcher fills whichever dispatch slot the mode uses —
@@ -1149,7 +1237,8 @@ def build_server(model, variables, config: ServeConfig,
         def warm():
             rset.warmup(modes=warm_modes)
     else:
-        engine = BatchEngine(model, variables, config, metrics)
+        engine = BatchEngine(model, variables, config, metrics,
+                             fault_plan=fault_plan)
         scheduler = None
         if config.sched is not None:
             # Iteration-level continuous batching: the scheduler IS the
@@ -1195,7 +1284,8 @@ def build_server(model, variables, config: ServeConfig,
     server = StereoServer(config, engine, batcher, metrics, stream=stream,
                           tracer=tracer, scheduler=scheduler,
                           cluster=cluster, start_ready=False,
-                          tiers=tiers, tier_reasons=tier_reasons)
+                          tiers=tiers, tier_reasons=tier_reasons,
+                          fault_plan=fault_plan)
 
     def warm_then_ready():
         try:

@@ -99,6 +99,19 @@ class StreamRunner:
         return self.store.import_state(
             snapshot, schema=self.engine.session_schema())
 
+    def evict_all(self) -> int:
+        """Drop every live session (the ``evict_sessions`` chaos hook:
+        session-store pressure as one event).  Returns sessions
+        dropped.  Losing state is the store's documented cold fallback
+        — each stream's next frame re-anchors cold, nothing errors.  A
+        frame racing the sweep either finishes first (its session drops
+        a moment later) or re-creates the session cold."""
+        dropped = 0
+        for sid in self.store.session_ids():
+            if self.store.drop(sid):
+                dropped += 1
+        return dropped
+
     def step(self, session_id: str, seq_no: Optional[int],
              left: np.ndarray, right: np.ndarray,
              trace_id: Optional[str] = None,

@@ -32,9 +32,46 @@ Grammar (comma-separated entries)::
                           chaos test deterministic instead of
                           SIGKILL-timing-dependent (tests/test_cluster.py)
 
-All faults fire exactly once except ``corrupt@sample``, which models a
-persistently bad shard and fires on every access.  Injection is fully
-deterministic: no randomness, no timers beyond the explicit sleeps.
+Serving-plane kinds (docs/fault_tolerance.md "Serving-plane fault
+grammar"; armed at runtime over ``POST /debug/faults`` by the chaos
+controller in loadgen/chaos.py, or from the env at server start)::
+
+    slow_replica@request=N:SECS   the next N engine dispatches each
+                          sleep SECS before touching the device — a
+                          replica that is alive but slow (the hedged-
+                          request trigger).  Hook: ``dispatch_delay``
+                          (serve/engine.py).
+    blackhole_backend@t_ms=OFF:SECS  starting OFF ms after arming, for
+                          SECS, the backend accepts connections but
+                          does not respond until the window closes —
+                          probes time out, the router's circuit breaker
+                          opens.  Hooks: ``blackhole_until`` /
+                          ``blackhole_hold`` (serve/httpbase.py).
+    flap_probe@backend=N  the next N ``/healthz`` replies LIE
+                          (``ready: false`` on a ready server) — probe
+                          flapping without any real fault.  Hook:
+                          ``healthz_lie`` (serve/server.py).
+    corrupt_frame@request=N  the next N binary RSWF frames relayed by
+                          the router get one payload byte bit-flipped
+                          mid-stream — wire-plane corruption between
+                          hops.  Hook: ``corrupt_stream``
+                          (serve/cluster/router.py).
+    evict_sessions@t_ms=OFF  OFF ms after arming, evict every live
+                          streaming session (session-store pressure;
+                          the next frame of each stream re-anchors
+                          cold).  Hook: ``evict_due``
+                          (serve/server.py -> dispatcher/runner).
+
+Count-valued kinds (``slow_replica``/``flap_probe``/``corrupt_frame``)
+use the INT as a fire budget: the entry fires on each hook consult
+until N firings are spent.  Time-valued kinds (``@t_ms=``) measure
+offsets from ARMING (``FaultPlan.arm`` / ``extend``), so one plan
+string can be scheduled against trace time by the chaos controller.
+
+All training faults fire exactly once except ``corrupt@sample``, which
+models a persistently bad shard and fires on every access.  Injection
+is fully deterministic: no randomness, no timers beyond the explicit
+sleeps and declared windows.
 """
 
 from __future__ import annotations
@@ -43,6 +80,7 @@ import dataclasses
 import logging
 import os
 import signal
+import threading
 import time
 from typing import List, Optional, Set
 
@@ -60,7 +98,23 @@ _KINDS = {
     "hang": (("worker", "sample"), True, False),
     "corrupt_ckpt": (("step",), False, False),
     "kill_backend": (("request",), False, False),
+    "slow_replica": (("request",), True, False),
+    "blackhole_backend": (("t_ms",), True, False),
+    "flap_probe": (("backend",), False, False),
+    "corrupt_frame": (("request",), False, False),
+    "evict_sessions": (("t_ms",), False, False),
 }
+
+# Kinds whose INT is a fire budget (remaining = value), not an index.
+_COUNT_KINDS = frozenset({"slow_replica", "flap_probe", "corrupt_frame"})
+# Kinds whose INT is a millisecond offset from arming.
+_TIMED_KINDS = frozenset({"blackhole_backend", "evict_sessions"})
+
+# Serving hooks fire from many handler threads at once; the training
+# hooks are single-threaded by construction.  One coarse module lock
+# keeps ``remaining`` decrements exact without making FaultPlan
+# unpicklable (it crosses into spawned data workers).
+_HOOK_LOCK = threading.Lock()
 
 
 class InjectedFault(RuntimeError):
@@ -92,6 +146,10 @@ class Fault:
     seconds: Optional[float] = None
     # -1 = unlimited (persistent faults); otherwise remaining fire count.
     remaining: int = 1
+    # Monotonic arming time (``FaultPlan.arm``) — the zero point for
+    # ``@t_ms=`` offsets.  None until armed; time-windowed hooks are
+    # inert while unarmed.
+    armed_at: Optional[float] = None
 
     def spec(self) -> str:
         dur = "" if self.seconds is None else f":{self.seconds:g}s"
@@ -137,13 +195,51 @@ class FaultPlan:
             if needs_dur and seconds is None:
                 raise ValueError(f"fault {kind!r} needs a duration "
                                  f"(e.g. {kind}@{dim}={value}:10s)")
+            if kind in _COUNT_KINDS and value < 1:
+                raise ValueError(f"fault {kind!r} wants a fire budget "
+                                 f">= 1, got {value} in {entry!r}")
+            if kind in _TIMED_KINDS and value < 0:
+                raise ValueError(f"fault {kind!r} wants a millisecond "
+                                 f"offset >= 0, got {value} in {entry!r}")
+            remaining = (-1 if persistent
+                         else value if kind in _COUNT_KINDS else 1)
             faults.append(Fault(kind, dim, value, seconds,
-                                remaining=-1 if persistent else 1))
+                                remaining=remaining))
         return cls(faults)
 
     @classmethod
     def from_env(cls, env_var: str = ENV_VAR) -> "FaultPlan":
         return cls.parse(os.environ.get(env_var))
+
+    # -- arming (serving plans) ---------------------------------------------
+
+    def arm(self, now: Optional[float] = None) -> "FaultPlan":
+        """Stamp the arming time on every not-yet-armed fault: the zero
+        point for ``@t_ms=`` offsets.  Idempotent per fault — re-arming
+        a plan never rewinds a running window."""
+        now = time.monotonic() if now is None else now
+        with _HOOK_LOCK:
+            for f in self.faults:
+                if f.armed_at is None:
+                    f.armed_at = now
+        return self
+
+    def extend(self, spec: str, now: Optional[float] = None
+               ) -> List[Fault]:
+        """Parse ``spec`` and append its faults, armed at ``now`` — the
+        runtime arming seam behind ``POST /debug/faults`` (the chaos
+        controller schedules plan entries against trace time with it).
+        Raises ValueError on a bad spec without touching the plan."""
+        new = FaultPlan.parse(spec).faults
+        now = time.monotonic() if now is None else now
+        with _HOOK_LOCK:
+            for f in new:
+                f.armed_at = now
+                self.faults.append(f)
+        if new:
+            logger.warning("fault injection: armed %s",
+                           ",".join(f.spec() for f in new))
+        return new
 
     # -- matching -----------------------------------------------------------
 
@@ -158,10 +254,25 @@ class FaultPlan:
         return None
 
     def _take(self, kind: str, dim: str, value: int) -> Optional[Fault]:
-        f = self.peek(kind, dim, value)
-        if f is not None:
-            if f.remaining > 0:
+        with _HOOK_LOCK:
+            f = self.peek(kind, dim, value)
+            if f is not None and f.remaining > 0:
                 f.remaining -= 1
+        if f is not None:
+            logger.warning("fault injection: firing %s", f.spec())
+        return f
+
+    def _take_any(self, kind: str) -> Optional[Fault]:
+        """Consume one firing of the first non-exhausted fault of
+        ``kind`` regardless of its value — the consult path for
+        count-budget kinds (``slow_replica@request=N`` means "the next
+        N consults fire", not "the N-th consult")."""
+        with _HOOK_LOCK:
+            f = next((f for f in self.faults
+                      if f.kind == kind and f.remaining != 0), None)
+            if f is not None and f.remaining > 0:
+                f.remaining -= 1
+        if f is not None:
             logger.warning("fault injection: firing %s", f.spec())
         return f
 
@@ -218,6 +329,78 @@ class FaultPlan:
             return False
         corrupt_tree(path)
         return True
+
+    # -- serving hooks ------------------------------------------------------
+
+    def dispatch_delay(self) -> float:
+        """Engine hook (serve/engine.py ``_dispatch``): seconds to sleep
+        before the next device dispatch, 0.0 when no ``slow_replica``
+        fault has budget left."""
+        f = self._take_any("slow_replica")
+        return f.seconds if f is not None else 0.0
+
+    def healthz_lie(self) -> bool:
+        """Server hook (/healthz): True when this reply should LIE
+        ``ready: false`` on a ready server (``flap_probe@backend=N``)."""
+        return self._take_any("flap_probe") is not None
+
+    def corrupt_stream(self) -> bool:
+        """Router hook (route_predict_stream): True when the next
+        relayed binary frame should get one payload byte bit-flipped
+        mid-pump (``corrupt_frame@request=N``)."""
+        return self._take_any("corrupt_frame") is not None
+
+    def blackhole_until(self, now: Optional[float] = None
+                        ) -> Optional[float]:
+        """Monotonic end time of an ACTIVE blackhole window (armed
+        ``blackhole_backend@t_ms=OFF:SECS`` with
+        ``armed+OFF <= now < armed+OFF+SECS``), else None."""
+        now = time.monotonic() if now is None else now
+        with _HOOK_LOCK:
+            for f in self.faults:
+                if f.kind != "blackhole_backend" or f.armed_at is None:
+                    continue
+                start = f.armed_at + f.value / 1e3
+                end = start + f.seconds
+                if start <= now < end:
+                    return end
+        return None
+
+    def blackhole_hold(self, clock=time.monotonic,
+                       sleep=time.sleep) -> float:
+        """HTTP-handler hook (serve/httpbase.py): while a blackhole
+        window is active, hold the request — the connection is accepted
+        but nothing is answered until the window closes.  Returns the
+        seconds held (0.0 outside any window).  Injected ``clock`` /
+        ``sleep`` keep the unit tests wall-clock-free."""
+        held = 0.0
+        while True:
+            now = clock()
+            end = self.blackhole_until(now)
+            if end is None:
+                return held
+            if held == 0.0:
+                logger.warning(
+                    "fault injection: blackhole holding request %.0f ms",
+                    (end - now) * 1e3)
+            sleep(max(end - now, 0.0))
+            held += max(end - now, 0.0)
+
+    def evict_due(self, now: Optional[float] = None) -> bool:
+        """Server hook: True exactly once when an armed
+        ``evict_sessions@t_ms=OFF`` offset has elapsed — the caller
+        evicts every live streaming session."""
+        now = time.monotonic() if now is None else now
+        with _HOOK_LOCK:
+            f = next((f for f in self.faults
+                      if f.kind == "evict_sessions" and f.remaining != 0
+                      and f.armed_at is not None
+                      and now >= f.armed_at + f.value / 1e3), None)
+            if f is not None and f.remaining > 0:
+                f.remaining -= 1
+        if f is not None:
+            logger.warning("fault injection: firing %s", f.spec())
+        return f is not None
 
 
 def corrupt_tree(path: str) -> int:

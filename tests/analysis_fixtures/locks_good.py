@@ -71,3 +71,58 @@ class Migrator:
                 return False
             self._pins[sid] = dst
             return True
+
+
+class Breaker:
+    """Circuit-breaker shapes (PR 17): a small state machine whose every
+    field is guarded, with a listener deliberately notified OUTSIDE the
+    lock (callbacks must never run under policy locks)."""
+
+    def __init__(self, listener=None):
+        self._lock = threading.Lock()
+        self._state = "closed"          # guarded_by: _lock
+        self._failures = 0              # guarded_by: _lock
+        self._trial_inflight = False    # guarded_by: _lock
+        self._listener = listener
+
+    def allow(self):
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "half_open" and not self._trial_inflight:
+                self._trial_inflight = True
+                return True
+            return False
+
+    def settle(self, ok):
+        with self._lock:
+            self._trial_inflight = False
+            if ok:
+                self._state = "closed"
+                self._failures = 0
+            else:
+                self._failures += 1
+                self._state = "open"
+            state = self._state
+        if self._listener is not None:
+            self._listener(state)
+
+
+class Hedger:
+    """Hedged-request bookkeeping: the contender set and outcome are
+    written by racing worker threads, so both live under one lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._contenders = []           # guarded_by: _lock
+        self._winner = None             # guarded_by: _lock
+
+    def enter(self, name):
+        with self._lock:
+            self._contenders.append(name)
+
+    def settle(self, name):
+        with self._lock:
+            if self._winner is None:
+                self._winner = name
+            return self._winner == name

@@ -106,3 +106,24 @@ def test_slo_mode_contract():
     assert r["whatif"]["users_served"] >= 1
     # Server-side cross-check of the client-observed request count.
     assert r["metric_deltas"]["cluster_dispatch_total"] == r["trace_events"]
+
+
+@pytest.mark.slow
+def test_chaos_mode_contract():
+    """bench --chaos: trace replay against a 2-backend router cluster
+    while a ChaosPlan blackholes one backend mid-replay; the degraded
+    verdict plus breaker activity ride out on the one JSON line."""
+    r = _run(["--chaos", "--quick"])
+    assert r["unit"] == "pairs/sec" and r["value"] > 0
+    assert {"trace_events", "slo_pass", "checks", "windows", "chaos",
+            "breaker_transitions", "metric_deltas", "wall_s"} <= set(r)
+    assert r["slo_pass"] is True
+    assert all(c["pass"] for c in r["checks"])
+    # The plan armed (and only) its declared action, cleanly.
+    assert r["chaos"] == {"actions": 1, "armed": 1, "failed": 0}
+    # The declared window saw traffic, and so did the recovery slice.
+    labels = [k for k in r["windows"] if k.endswith("blackhole_b0")]
+    assert labels and r["windows"][labels[0]]["count"] > 0
+    # The fault was real enough to trip the breaker at least once.
+    assert r["breaker_transitions"] >= 1
+    assert r["metric_deltas"]["cluster_dispatch_total"] >= r["trace_events"]

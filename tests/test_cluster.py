@@ -42,6 +42,7 @@ import pytest
 
 import jax
 
+from raftstereo_tpu import wire
 from raftstereo_tpu.config import (ClusterConfig, RAFTStereoConfig,
                                    RouterConfig, SchedConfig, ServeConfig,
                                    StreamConfig)
@@ -56,7 +57,8 @@ from raftstereo_tpu.serve.batcher import Future, ServeResult
 from raftstereo_tpu.serve.client import run_load
 from raftstereo_tpu.serve.cluster.pins import PinTable
 from raftstereo_tpu.serve.cluster.replica import Replica
-from raftstereo_tpu.serve.cluster.router import Backend
+from raftstereo_tpu.serve.cluster.router import (Backend, CircuitBreaker,
+                                                 _ProbeSchedule)
 from raftstereo_tpu.serve.server import snapshot_to_wire, wire_to_snapshot
 from raftstereo_tpu.stream.session import STATE_VERSION, SessionStore
 from raftstereo_tpu.utils.faults import FaultPlan
@@ -1392,6 +1394,401 @@ class TestRouter:
             router.close()
             rt.join(5)
             lt.join(5)
+
+
+# ------------------------------------------------- chaos: breaker policy
+
+class TestCircuitBreaker:
+    """Pure breaker policy — injected clock, no sockets
+    (docs/fault_tolerance.md "Per-backend circuit breaker")."""
+
+    def _breaker(self, threshold=2, reset_s=5.0):
+        clock = [0.0]
+        seen = []
+        br = CircuitBreaker(threshold, reset_s, clock=lambda: clock[0],
+                            listener=seen.append)
+        return br, clock, seen
+
+    def test_full_cycle_closed_open_half_open_closed(self):
+        br, clock, seen = self._breaker()
+        assert br.current() == "closed" and br.allow_request()
+        br.record_failure()
+        assert br.current() == "closed"  # below threshold
+        br.record_failure()
+        assert br.current() == "open"
+        assert not br.allow_request()  # reset window not elapsed
+        clock[0] = 5.0
+        assert br.allow_request()  # admits THE trial
+        assert br.current() == "half_open"
+        br.record_success()
+        assert br.current() == "closed"
+        assert seen == ["open", "half_open", "closed"]
+
+    def test_half_open_admits_exactly_one_trial(self):
+        br, clock, _ = self._breaker()
+        br.record_failure()
+        br.record_failure()
+        clock[0] = 5.0
+        assert br.allow_request()
+        # exclusivity: no second trial until the verdict lands
+        assert not br.allow_request()
+        br.record_failure()  # trial failed -> open, FRESH window
+        assert br.current() == "open"
+        assert not br.allow_request()  # window restarted at t=5
+        clock[0] = 10.0
+        assert br.allow_request()
+
+    def test_open_window_keeps_aging_under_repeated_failures(self):
+        # Failures while already open must NOT refresh _opened_at —
+        # a steady trickle of failed picks would otherwise push the
+        # recovery trial out forever.
+        br, clock, _ = self._breaker()
+        br.record_failure()
+        br.record_failure()  # open at t=0
+        clock[0] = 2.0
+        br.record_failure()
+        clock[0] = 4.0
+        br.record_failure()
+        clock[0] = 5.0
+        assert br.allow_request()  # reset_s measured from t=0
+
+    def test_probe_recovery_is_two_step(self):
+        # One lucky probe mid-flap never slams the breaker shut: the
+        # first healthy probe after the window only reaches half_open.
+        br, clock, seen = self._breaker()
+        br.record_failure()
+        br.record_failure()  # open at t=0
+        clock[0] = 1.0
+        br.on_probe(True)
+        assert br.current() == "open"  # window not elapsed yet
+        clock[0] = 5.0
+        br.on_probe(True)
+        assert br.current() == "half_open"  # step one
+        br.on_probe(True)
+        assert br.current() == "closed"  # step two
+        assert seen == ["open", "half_open", "closed"]
+        br.on_probe(False)  # a failed probe counts like a failure
+        assert br.current() == "closed"
+        br.on_probe(False)
+        assert br.current() == "open"
+
+    def test_success_resets_consecutive_count(self):
+        br, _, seen = self._breaker(threshold=2)
+        br.record_failure()
+        br.record_success()  # any HTTP reply = responsive
+        br.record_failure()
+        assert br.current() == "closed" and seen == []
+
+
+class TestProbeSchedule:
+    """Thundering-herd jitter policy — explicit ``now``, no sleeps."""
+
+    def test_phase_and_period_decorrelate(self):
+        names = [f"b{i}" for i in range(4)]
+        sched = _ProbeSchedule(names, 10.0, now=0.0)
+        periods = [sched.period_s(n) for n in names]
+        assert all(10.0 <= p <= 15.0 for p in periods)
+        assert len({round(p, 6) for p in periods}) == len(names)
+        phases = list(sched._next.values())
+        assert all(0.0 <= t < 10.0 for t in phases)
+        assert len({round(t, 6) for t in phases}) == len(names)
+
+    def test_schedule_is_identical_across_restarts(self):
+        a = _ProbeSchedule(["b0", "b1"], 3.0, now=0.0)
+        b = _ProbeSchedule(["b0", "b1"], 3.0, now=0.0)
+        assert a._next == b._next and a._period == b._period
+
+    def test_due_advances_past_now_without_catch_up_burst(self):
+        sched = _ProbeSchedule(["b0", "b1"], 1.0, now=0.0)
+        assert sorted(sched.due(2.0)) == ["b0", "b1"]
+        assert sched.due(2.0) == []  # advanced PAST now
+        # a very late round (stalled host) still probes each backend
+        # at most once — missed periods are skipped, not replayed
+        assert sorted(sched.due(100.0)) == ["b0", "b1"]
+        assert sched.due(100.0) == []
+
+    def test_next_wake_is_nonnegative_and_bounded(self):
+        sched = _ProbeSchedule(["b0"], 1.0, now=0.0)
+        assert sched.next_wake(50.0) == 0.0  # overdue -> wake now
+        sched.due(50.0)
+        assert 0.0 < sched.next_wake(50.0) <= 1.5  # one period max
+
+
+# ------------------------------------------ chaos: router fault handling
+
+def _stub_backend(delay_s=0.0, capture=None, decode_wire=False):
+    """Model-free backend stub for router policy tests: /healthz says
+    ready; /predict replies canned JSON after ``delay_s`` (request
+    headers appended to ``capture``).  With ``decode_wire`` the body
+    must frame-decode as a binary request and a ``WireError`` is
+    answered as the backend's documented clean 400."""
+    import http.server
+
+    class Stub(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            raw = self.rfile.read(int(self.headers.get("Content-Length",
+                                                       0) or 0))
+            if capture is not None:
+                capture.append(dict(self.headers))
+            if delay_s:
+                time.sleep(delay_s)
+            status, payload = 200, {"ok": True}
+            if decode_wire:
+                try:
+                    wire.decode_request(raw)
+                except wire.WireError as e:
+                    status, payload = 400, {"error": str(e)}
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Request-Id",
+                             self.headers.get("X-Request-Id", ""))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            body = json.dumps({"live": True, "ready": True,
+                               "queue_depth": 0}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t
+
+
+def _stop_stub(srv, t):
+    srv.shutdown()
+    srv.server_close()
+    t.join(5)
+
+
+class TestBreakerRouting:
+    def _router(self, stubs, **kw):
+        cfg = dict(port=0,
+                   backends=tuple(("127.0.0.1", s.server_address[1])
+                                  for s in stubs),
+                   probe_interval_s=30.0, retries=2, retry_backoff_ms=5.0,
+                   request_timeout_s=5.0)
+        cfg.update(kw)
+        router = build_router(RouterConfig(**cfg))
+        rt = threading.Thread(target=router.serve_forever, daemon=True)
+        rt.start()
+        return router, rt
+
+    def test_breaker_open_spills_cold_and_sessions_bypass(self):
+        s0, t0 = _stub_backend()
+        s1, t1 = _stub_backend()
+        router, rt = self._router([s0, s1], fail_after=2,
+                                  breaker_reset_s=60.0)
+        try:
+            assert router._hedge_delay_s() is None  # hedging is opt-in
+            b0 = router.backends[0]
+            b0.breaker.record_failure()
+            b0.breaker.record_failure()
+            assert b0.breaker.current() == "open"
+            # Cold request: b0 is still routable (probes pass — the
+            # breaker opened on forward failures) but its breaker
+            # refuses, so the pick SPILLS to b1.
+            status, _, _, headers = router.route_predict(b"{}", None,
+                                                         "rid-s1")
+            assert status == 200 and headers["X-Backend"] == "b1"
+            fam = {lv: c.value
+                   for lv, c in router.cluster_metrics.dispatch.series()}
+            assert fam[("b0", "breaker_open")] == 1
+            assert fam[("b1", "ok")] == 1
+            # Session frames bypass the breaker: stickiness beats
+            # breaker pessimism (docs/fault_tolerance.md).
+            raw = json.dumps({"session_id": "sess-bypass"}).encode()
+            status, _, _, headers = router.route_predict(
+                raw, "sess-bypass", "rid-s2")
+            assert status == 200 and headers["X-Backend"] == "b0"
+            # Exported gauge + transition counter saw the open.
+            router.refresh_gauges()
+            gauge = {lv: g.value for lv, g in
+                     router.cluster_metrics.breaker_state.series()}
+            assert gauge[("b0",)] == 1 and gauge[("b1",)] == 0
+            trans = {lv: c.value for lv, c in
+                     router.cluster_metrics.breaker_transitions.series()}
+            assert trans[("b0", "open")] == 1
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+            _stop_stub(s1, t1)
+
+    def test_deadline_exhausted_at_router_hop(self):
+        caps = []
+        s0, t0 = _stub_backend(capture=caps)
+        router, rt = self._router([s0])
+        try:
+            status, body, ctype, headers = router.route_predict(
+                b"{}", None, "rid-d0", deadline_ms=0.0)
+            assert status == 504 and ctype == "application/json"
+            obj = json.loads(body)
+            assert obj["error"] == "timeout"
+            assert "router hop" in obj["detail"]
+            assert headers["X-Request-Id"] == "rid-d0"
+            assert caps == []  # no backend slot burned
+            # A live budget forwards decremented, never grown.
+            status, _, _, _ = router.route_predict(
+                b"{}", None, "rid-d1", deadline_ms=10000.0)
+            assert status == 200
+            fwd = float(caps[0]["X-Deadline-Ms"])
+            assert 0.0 < fwd <= 10000.0
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+
+    def test_debug_faults_arms_and_rejects_over_http(self):
+        import http.client
+
+        s0, t0 = _stub_backend()
+        router, rt = self._router([s0])
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                              timeout=10)
+            conn.request("POST", "/debug/faults", body=json.dumps(
+                {"faults": "flap_probe@backend=2"}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            armed = json.loads(resp.read())["armed"]
+            assert resp.status == 200
+            assert len(armed) == 1
+            assert armed[0].startswith("flap_probe@backend=2")
+            # training-only dims are rejected on the serving plane
+            conn.request("POST", "/debug/faults", body=json.dumps(
+                {"faults": "slow_replica@step=2:0.5"}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            err = json.loads(resp.read())
+            assert resp.status == 400
+            assert "bad fault spec" in err["error"]
+            conn.close()
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+
+
+class TestHedgedRequests:
+    def test_hedge_fires_and_wins_on_slow_primary(self):
+        s0, t0 = _stub_backend(delay_s=1.0)  # tail-slow primary
+        s1, t1 = _stub_backend()
+        router, rt = TestBreakerRouting()._router(
+            [s0, s1], hedge_floor_ms=150.0, hedge_min_samples=10 ** 6,
+            retries=0)
+        try:
+            t_start = time.perf_counter()
+            status, _, _, headers = router.route_predict(b"{}", None,
+                                                         "rid-h0")
+            wall = time.perf_counter() - t_start
+            # b0 (least bid) was primary; the hedge fired at the floor
+            # and b1's reply won long before b0's 1s sleep ended.
+            assert status == 200 and headers["X-Backend"] == "b1"
+            assert wall < 0.8
+            hedges = {lv: c.value for lv, c in
+                      router.cluster_metrics.hedges.series()}
+            assert hedges[("fired",)] == 1
+            assert hedges[("won",)] == 1
+            assert ("lost",) not in hedges
+            # Session frames NEVER hedge (ordering): the pinned slow
+            # backend is waited out and the counters stay put.
+            raw = json.dumps({"session_id": "sess-h"}).encode()
+            status, _, _, _ = router.route_predict(raw, "sess-h",
+                                                   "rid-h1")
+            assert status == 200
+            hedges2 = {lv: c.value for lv, c in
+                       router.cluster_metrics.hedges.series()}
+            assert hedges2 == hedges
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+            _stop_stub(s1, t1)
+
+
+class TestCorruptFrameRelay:
+    def _post_wire(self, port, body, rid):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("POST", "/predict", body=body, headers={
+                "Content-Type": wire.WIRE_CONTENT_TYPE,
+                "X-Request-Id": rid})
+            resp = conn.getresponse()
+            return resp.status, resp.read(), dict(resp.headers)
+        finally:
+            conn.close()
+
+    def test_corrupt_frame_budget_then_healthy_relay(self):
+        import http.client
+
+        s0, t0 = _stub_backend(decode_wire=True)
+        router, rt = TestBreakerRouting()._router([s0])
+        try:
+            # Arm over the wire — the chaos controller's seam.
+            conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                              timeout=10)
+            conn.request("POST", "/debug/faults", body=json.dumps(
+                {"faults": "corrupt_frame@request=1"}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            conn.close()
+            rng = np.random.default_rng(0)
+            left = rng.standard_normal((16, 24, 3)).astype(np.float32)
+            right = rng.standard_normal((16, 24, 3)).astype(np.float32)
+            buf = wire.encode_request(left, right, {"iters": 2},
+                                      compress=True)
+            # The router bit-flips one relayed payload byte; the
+            # backend's decoder must answer a clean 400 that relays
+            # back with the request id — never a hung socket.
+            status, body, headers = self._post_wire(router.port, buf,
+                                                    "rid-c0")
+            assert status == 400
+            assert headers.get("X-Request-Id") == "rid-c0"
+            assert json.loads(body)["error"]
+            # Budget consumed: the identical frame now relays bitwise.
+            status, body, headers = self._post_wire(router.port, buf,
+                                                    "rid-c1")
+            assert status == 200 and json.loads(body) == {"ok": True}
+            assert headers.get("X-Backend") == "b0"
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+
+    def test_truncated_and_garbage_wire_bodies_clean_400(self):
+        s0, t0 = _stub_backend(decode_wire=True)
+        router, rt = TestBreakerRouting()._router([s0])
+        try:
+            # Shorter than a frame header: rejected before any relay.
+            status, body, headers = self._post_wire(router.port,
+                                                    b"RSWF", "rid-t0")
+            assert status == 400
+            assert headers.get("X-Request-Id") == "rid-t0"
+            assert "wire frame" in json.loads(body)["error"]
+            # A full-size header of garbage: bad magic, same contract.
+            status, body, headers = self._post_wire(
+                router.port, b"\x00" * wire.HEADER_SIZE, "rid-t1")
+            assert status == 400
+            assert headers.get("X-Request-Id") == "rid-t1"
+            json.loads(body)  # always JSON, never a hung socket
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
 
 
 # ----------------------------------------------------------- client retries

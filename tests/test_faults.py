@@ -82,6 +82,79 @@ def test_plan_from_env(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# Serving-plane grammar (PR 17)
+# ---------------------------------------------------------------------------
+
+def test_serving_count_kinds_are_budgets():
+    """``slow_replica@request=N`` fires on the next N consults — a
+    budget, not an N-th-request trigger; same for flap/corrupt."""
+    plan = FaultPlan.parse("slow_replica@request=2:0.25,"
+                           "flap_probe@backend=1,"
+                           "corrupt_frame@request=1").arm(now=0.0)
+    assert plan.dispatch_delay() == 0.25
+    assert plan.dispatch_delay() == 0.25
+    assert plan.dispatch_delay() == 0.0       # budget of 2 exhausted
+    assert plan.healthz_lie() is True
+    assert plan.healthz_lie() is False
+    assert plan.corrupt_stream() is True
+    assert plan.corrupt_stream() is False
+
+
+def test_serving_grammar_rejects_malformed():
+    for bad in ("slow_replica@request=2",     # missing required duration
+                "slow_replica@step=2:1s",     # wrong dimension
+                "blackhole_backend@t_ms=100",  # missing window length
+                "corrupt_frame@request=0",    # count must be >= 1
+                "evict_sessions@t_ms=-5"):    # offset must be >= 0
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+def test_blackhole_window_measures_from_arming():
+    plan = FaultPlan.parse("blackhole_backend@t_ms=100:0.5").arm(now=10.0)
+    assert plan.blackhole_until(now=10.05) is None     # before the window
+    assert plan.blackhole_until(now=10.1) == pytest.approx(10.6)
+    assert plan.blackhole_until(now=10.59) == pytest.approx(10.6)
+    assert plan.blackhole_until(now=10.6) is None      # window closed
+
+
+def test_blackhole_hold_sleeps_to_window_end():
+    plan = FaultPlan.parse("blackhole_backend@t_ms=0:0.5").arm(now=0.0)
+    clock = [0.1]
+    slept = []
+
+    def fake_sleep(s):
+        slept.append(s)
+        clock[0] += s
+
+    held = plan.blackhole_hold(clock=lambda: clock[0], sleep=fake_sleep)
+    assert held == pytest.approx(0.4) and slept == [pytest.approx(0.4)]
+    # Outside the window the hook is free.
+    assert plan.blackhole_hold(clock=lambda: clock[0],
+                               sleep=fake_sleep) == 0.0
+
+
+def test_evict_due_fires_once_after_offset():
+    plan = FaultPlan.parse("evict_sessions@t_ms=200").arm(now=0.0)
+    assert plan.evict_due(now=0.1) is False
+    assert plan.evict_due(now=0.25) is True
+    assert plan.evict_due(now=0.3) is False            # one-shot
+
+
+def test_extend_arms_at_extend_time_not_parse_time():
+    """Runtime arming (the /debug/faults seam): a spec extended at t=5
+    measures its offsets from t=5, and a bad spec changes nothing."""
+    plan = FaultPlan.parse("").arm(now=0.0)
+    armed = plan.extend("blackhole_backend@t_ms=0:1.0", now=5.0)
+    assert [f.kind for f in armed] == ["blackhole_backend"]
+    assert plan.blackhole_until(now=4.5) is None
+    assert plan.blackhole_until(now=5.5) == pytest.approx(6.0)
+    with pytest.raises(ValueError):
+        plan.extend("bogus@request=1", now=6.0)
+    assert len(plan.faults) == 1
+
+
+# ---------------------------------------------------------------------------
 # Self-healing data loader
 # ---------------------------------------------------------------------------
 

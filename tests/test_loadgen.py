@@ -40,8 +40,12 @@ from raftstereo_tpu.loadgen import trace as lg_trace
 from raftstereo_tpu.loadgen.metrics import LoadgenMetrics
 from raftstereo_tpu.loadgen.records import (Recorder, RequestRow,
                                             percentile, summarize)
+from raftstereo_tpu.loadgen.chaos import (ChaosAction, ChaosController,
+                                          ChaosPlan)
 from raftstereo_tpu.loadgen.replay import ReplayConfig, pair_provider, replay
-from raftstereo_tpu.serve import ServeClient, build_router, build_server
+from raftstereo_tpu.obs import parse_text
+from raftstereo_tpu.serve import (ServeClient, ServeError, build_router,
+                                  build_server)
 
 # ----------------------------------------------------------------- helpers
 
@@ -715,6 +719,332 @@ class TestSLOHarnessEndToEnd:
             assert bvars["latency"]["count"] > 0
             assert bvars["latency"]["p99_ms"] >= \
                 bvars["latency"]["p50_ms"] > 0
+        finally:
+            client.close()
+            router.close()
+            rt.join(10)
+            for srv, th in ((b0, t0), (b1, t1)):
+                srv.close()
+                th.join(10)
+
+
+# ------------------------------------------------------------- chaos mode
+
+class TestChaosPlan:
+    def test_plan_roundtrip_and_validation(self, tmp_path):
+        plan = ChaosPlan(
+            actions=(ChaosAction(t_ms=800.0, target="b0",
+                                 faults="blackhole_backend@t_ms=0:0.8"),
+                     ChaosAction(t_ms=100.0, target="router",
+                                 faults="corrupt_frame@request=1")),
+            windows=(lg_slo.DegradedWindow(
+                t_start_ms=800.0, t_end_ms=2200.0, label="bh",
+                max_error_rate=0.5, recover_by_ms=300.0,
+                recovery_max_error_rate=0.0),))
+        path = str(tmp_path / "plan.json")
+        plan.save(path)
+        loaded = ChaosPlan.load(path)
+        assert loaded.to_json() == plan.to_json()
+        # actions serialize sorted by t_ms — the schedule is the artifact
+        assert [a["t_ms"] for a in loaded.to_json()["actions"]] == \
+            [100.0, 800.0]
+        assert loaded.degraded_windows()[0].label == "bh"
+        # a typo'd fault spec fails at plan BUILD time, not mid-replay
+        with pytest.raises(ValueError):
+            ChaosAction(t_ms=0.0, target="b0", faults="slow_replica@step=1")
+        with pytest.raises(ValueError):
+            ChaosAction(t_ms=-1.0, target="b0",
+                        faults="flap_probe@backend=1")
+        with pytest.raises(ValueError, match="not a chaos plan"):
+            ChaosPlan.from_json({"chaos_plan": "nope", "version": 1})
+        with pytest.raises(ValueError, match="version"):
+            ChaosPlan.from_json({"chaos_plan": "raftstereo_tpu.chaos",
+                                 "version": 99})
+
+    def test_controller_requires_mapped_targets(self):
+        plan = ChaosPlan(actions=(
+            ChaosAction(t_ms=0.0, target="b7",
+                        faults="flap_probe@backend=1"),))
+        with pytest.raises(ValueError, match="b7"):
+            ChaosController(plan, targets={"b0": ("127.0.0.1", 1)})
+
+    def test_controller_counts_failed_armings_never_raises(self):
+        # Arming lands on a dead port: logged + counted, the replay
+        # itself must never die because a fault target did.
+        metrics = LoadgenMetrics()
+        plan = ChaosPlan(actions=(
+            ChaosAction(t_ms=0.0, target="b0",
+                        faults="flap_probe@backend=1"),))
+        ctl = ChaosController(plan, targets={"b0": ("127.0.0.1", 9)},
+                              timeout_s=0.5, metrics=metrics)
+        ctl.start(time.perf_counter())
+        ctl.join(30.0)
+        s = ctl.summary()
+        assert s == {"actions": 1, "armed": 0, "failed": 1,
+                     "results": s["results"]}
+        assert s["results"][0]["outcome"] == "failed"
+        fam = {lv: c.value for lv, c in metrics.chaos_actions.series()}
+        assert fam[("flap_probe", "failed")] == 1
+
+
+class TestDegradedWindows:
+    def _rows(self):
+        # steady 0..500 ok | window 800..2100 mixed | recovery 2600.. ok
+        rows = [_row(i, t_send_ms=float(i) * 100.0, latency_ms=50.0)
+                for i in range(5)]
+        rows += [_row(10, t_send_ms=900.0, outcome="error",
+                      latency_ms=math.nan),
+                 _row(11, t_send_ms=1200.0, latency_ms=900.0),
+                 _row(12, t_send_ms=2000.0, latency_ms=700.0)]
+        rows += [_row(20 + i, t_send_ms=2600.0 + i * 100.0,
+                      latency_ms=60.0) for i in range(3)]
+        return rows
+
+    def _spec(self, **kw):
+        base = dict(t_start_ms=800.0, t_end_ms=2200.0, label="fault",
+                    max_error_rate=0.5, recover_by_ms=300.0,
+                    recovery_max_error_rate=0.0)
+        base.update(kw)
+        return lg_slo.SLOSpec(
+            classes=(lg_slo.SLOClass(max_error_rate=0.0),),
+            windows=(lg_slo.DegradedWindow(**base),))
+
+    def test_rows_partition_steady_window_recovery(self):
+        verdict = lg_slo.evaluate(self._spec(), self._rows(), wall_s=3.0)
+        assert verdict["pass"], json.dumps(verdict, indent=2)
+        by = {(c["cls"], c["metric"]): c for c in verdict["checks"]}
+        # steady rows exclude the in-window error: class bound holds
+        assert by[("tier=*,priority=*", "error_rate")]["value"] == 0.0
+        win = by[("window[0]:fault", "error_rate")]
+        assert win["value"] == pytest.approx(1 / 3, abs=1e-3)
+        assert win["pass"]
+        rec = by[("window[0]:fault", "recovery_error_rate")]
+        assert rec["value"] == 0.0 and rec["pass"]
+        assert verdict["windows"]["window[0]:fault"]["count"] == 3
+        assert verdict["windows"]["window[0]:fault:recovery"]["count"] == 3
+
+    def test_without_windows_class_bounds_cover_everything(self):
+        spec = lg_slo.SLOSpec(
+            classes=(lg_slo.SLOClass(max_error_rate=0.0),))
+        verdict = lg_slo.evaluate(spec, self._rows(), wall_s=3.0)
+        assert not verdict["pass"]  # the injected error now counts
+        assert "windows" not in verdict
+
+    def test_unexercised_window_fails(self):
+        spec = self._spec(t_start_ms=5000.0, t_end_ms=6000.0,
+                          recover_by_ms=0.0)
+        verdict = lg_slo.evaluate(spec, self._rows(), wall_s=3.0)
+        by = {(c["cls"], c["metric"]): c for c in verdict["checks"]}
+        assert not by[("window[0]:fault", "count")]["pass"]
+        assert not verdict["pass"]
+
+    def test_recovery_without_traffic_fails(self):
+        rows = [r for r in self._rows() if r.t_send_ms < 2500.0]
+        verdict = lg_slo.evaluate(self._spec(), rows, wall_s=2.5)
+        by = {(c["cls"], c["metric"]): c for c in verdict["checks"]}
+        assert not by[("window[0]:fault", "recovery_count")]["pass"]
+        assert not verdict["pass"]
+
+    def test_degraded_p99_and_shed_bounds(self):
+        spec = self._spec(p99_ms=500.0, max_shed_rate=0.0,
+                          recover_by_ms=0.0,
+                          recovery_max_error_rate=1.0)
+        verdict = lg_slo.evaluate(spec, self._rows(), wall_s=3.0)
+        by = {(c["cls"], c["metric"]): c for c in verdict["checks"]}
+        assert not by[("window[0]:fault", "p99_ms")]["pass"]  # 900ms
+        assert by[("window[0]:fault", "shed_rate")]["value"] == 0.0
+
+    def test_window_validation(self):
+        with pytest.raises(ValueError, match="t_end_ms"):
+            lg_slo.DegradedWindow(t_start_ms=5.0, t_end_ms=5.0)
+        with pytest.raises(ValueError, match="recover_by_ms"):
+            lg_slo.DegradedWindow(t_start_ms=0.0, t_end_ms=1.0,
+                                  recover_by_ms=-1.0)
+
+
+class TestChaosCertificationEndToEnd:
+    """The chaos acceptance gate: a seeded trace replayed against a
+    REAL 2-backend cluster behind the router while a ChaosPlan injects
+    a slow replica, a backend blackhole and one corrupt relayed frame.
+    The degraded-mode verdict must pass with zero lost accepted cold
+    requests; the blackholed backend's breaker must open and
+    half-open-recover (visible in ``cluster_breaker_*``); hedges must
+    fire and win at least once; the corrupt frame must surface as a
+    clean 400 with a request id; the scrape stays validator-clean and
+    warm steady state compiles nothing."""
+
+    def _backend(self, slo_model):
+        model, variables = slo_model
+        cfg = ServeConfig(port=0, bucket_multiple=32, buckets=((64, 96),),
+                          warmup=True, max_batch_size=2, max_wait_ms=5.0,
+                          queue_limit=64, request_timeout_ms=60000.0,
+                          iters=2, degraded_iters=2,
+                          degrade_queue_depth=10 ** 6)
+        srv = build_server(model, variables, cfg)
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        return srv, th
+
+    def test_chaos_replay_passes_degraded_verdict(self, slo_model,
+                                                  retrace_guard,
+                                                  tmp_path):
+        b0, t0 = self._backend(slo_model)
+        b1, t1 = self._backend(slo_model)
+        router = build_router(RouterConfig(
+            port=0, backends=(("127.0.0.1", b0.port),
+                              ("127.0.0.1", b1.port)),
+            probe_interval_s=0.15, probe_timeout_s=0.25, fail_after=1,
+            breaker_reset_s=0.3, hedge_floor_ms=150.0,
+            hedge_min_samples=10 ** 6, retries=2, retry_backoff_ms=20.0,
+            request_timeout_s=60.0))
+        rt = threading.Thread(target=router.serve_forever, daemon=True)
+        rt.start()
+        # JSON dialect: hedging is a cold-JSON-only policy, and the
+        # binary corrupt-frame path is exercised separately below.
+        client = ServeClient("127.0.0.1", router.port, timeout=120,
+                             wire_format="json")
+        try:
+            deadline = time.perf_counter() + 60
+            while time.perf_counter() < deadline:
+                h = client.healthz()
+                if h["ready"] and all(b["state"] == "ready"
+                                      for b in h["backends"].values()):
+                    break
+                time.sleep(0.1)
+            assert all(b["state"] == "ready"
+                       for b in client.healthz()["backends"].values())
+
+            # The certification artifact: slow replica + one corrupt
+            # relayed frame at 600ms, a 1.2s blackhole at 1500ms, and
+            # the degraded windows those faults justify.
+            plan = ChaosPlan(
+                actions=(
+                    ChaosAction(t_ms=600.0, target="b0",
+                                faults="slow_replica@request=2:0.5"),
+                    ChaosAction(t_ms=600.0, target="router",
+                                faults="corrupt_frame@request=1"),
+                    ChaosAction(t_ms=1500.0, target="b1",
+                                faults="blackhole_backend@t_ms=0:1.2"),
+                ),
+                windows=(
+                    lg_slo.DegradedWindow(
+                        t_start_ms=550.0, t_end_ms=1500.0,
+                        label="slow_b0", max_error_rate=0.0),
+                    lg_slo.DegradedWindow(
+                        t_start_ms=1500.0, t_end_ms=2750.0,
+                        label="blackhole_b1", max_error_rate=0.5,
+                        recover_by_ms=350.0,
+                        recovery_max_error_rate=0.0),
+                ))
+            ppath = str(tmp_path / "chaos.json")
+            plan.save(ppath)
+            plan = ChaosPlan.load(ppath)  # replay the ARTIFACT
+            controller = ChaosController(plan, targets={
+                "router": ("127.0.0.1", router.port),
+                "b0": ("127.0.0.1", b0.port),
+                "b1": ("127.0.0.1", b1.port)})
+
+            events = lg_trace.generate(lg_trace.TraceSpec(
+                seed=13, requests=30, duration_s=4.0, shape="poisson",
+                resolutions=((64, 96),)))
+            cfg = ReplayConfig(host="127.0.0.1", port=router.port,
+                               concurrency=4, timeout_s=120.0,
+                               wire_format="json")
+            make_pair = pair_provider(cfg.pair_seed, cfg.pool_size)
+            pl, pr = make_pair(events[0])
+            for _ in range(2):  # residual first-touch, outside the guard
+                client.predict(pl, pr)
+            # A FULL batch pays its one-off host-side staging executables
+            # (concat/slice at batch=2) here, not inside the guard — the
+            # chaos backlog makes coalesced batches, the steady priming
+            # above never does.
+            z = np.zeros((64, 96, 3), np.float32)
+            for srv in (b0, b1):
+                srv._engine.infer_batch([(z, z), (z, z)], iters=2)
+
+            before = client.metrics_text()
+            with retrace_guard(0, what="chaos replay at warm steady "
+                                       "state compiles nothing"):
+                wall0 = time.perf_counter()
+                rec = replay(events, cfg, chaos=controller)
+                wall_s = time.perf_counter() - wall0
+
+            # Let the probe-driven breaker recovery land before the
+            # after-scrape (closed arrives one probe after half_open).
+            deadline = time.perf_counter() + 15
+            while time.perf_counter() < deadline:
+                scrape = parse_text(client.metrics_text())
+                if scrape.value("cluster_breaker_transitions_total",
+                                backend="b1", to="closed") >= 1.0:
+                    break
+                time.sleep(0.1)
+            after = client.metrics_text()
+
+            rows = rec.rows()
+            assert len(rows) == len(events)
+            # Zero lost accepted cold requests: every row replied OK —
+            # blackholed in-flight requests are HELD (late), never
+            # dropped, and hedges cover the slow replica.
+            assert {r.outcome for r in rows} == {"ok"}
+
+            # Every arming landed (the summary is the report's "chaos"
+            # block on the CLI).
+            s = controller.summary()
+            assert s["actions"] == 3 and s["armed"] == 3
+            assert s["failed"] == 0, s
+
+            # The degraded-mode verdict: steady bounds outside the
+            # declared windows, relaxed bounds inside, recovery green.
+            slo_spec = lg_slo.SLOSpec(
+                classes=(lg_slo.SLOClass(max_error_rate=0.0,
+                                         max_shed_rate=0.0),),
+                windows=plan.degraded_windows())
+            verdict = lg_slo.evaluate(slo_spec, rows, wall_s=wall_s,
+                                      metrics_before=before,
+                                      metrics_after=after, retraces=0)
+            assert verdict["pass"], json.dumps(verdict, indent=2)
+            assert verdict["metrics"]["validator_errors"] == []
+            assert verdict["metrics"]["deltas"][
+                "cluster_dispatch_total"] >= len(events)
+            by = {(c["cls"], c["metric"]): c for c in verdict["checks"]}
+            assert by[("window[1]:blackhole_b1",
+                       "recovery_error_rate")]["value"] == 0.0
+            # Both declared windows saw traffic (their stats rode along).
+            assert verdict["windows"]["window[0]:slow_b0"]["count"] > 0
+            assert verdict["windows"][
+                "window[1]:blackhole_b1"]["count"] > 0
+
+            # Breaker lifecycle, visible in the cluster families: b1
+            # opened under the blackhole and probe-recovered through
+            # half_open back to closed.
+            scrape = parse_text(after)
+            for to in ("open", "half_open", "closed"):
+                assert scrape.value("cluster_breaker_transitions_total",
+                                    backend="b1", to=to) >= 1.0, to
+            assert scrape.value("cluster_breaker_state",
+                                backend="b1") == 0.0  # closed again
+            # Hedges fired on the slow replica and won on the fast one.
+            assert scrape.value("cluster_hedges_total",
+                                outcome="fired") >= 1.0
+            assert scrape.value("cluster_hedges_total",
+                                outcome="won") >= 1.0
+
+            # The corrupt-frame budget armed on the router is still
+            # unspent (the replay ran the JSON dialect): one binary
+            # frame relays corrupted and must come back as a clean 400
+            # WITH a request id — then the budget is gone and the very
+            # next frame relays bitwise.
+            bclient = ServeClient("127.0.0.1", router.port, timeout=60,
+                                  wire_format="binary")
+            try:
+                with pytest.raises(ServeError) as ei:
+                    bclient.predict(pl, pr)
+                assert ei.value.status == 400
+                assert ei.value.request_id
+                disparity, meta = bclient.predict(pl, pr)
+                assert disparity.shape == pl.shape[:2]
+            finally:
+                bclient.close()
         finally:
             client.close()
             router.close()

@@ -248,6 +248,54 @@ class TestMalformedPayload:
         with pytest.raises(wire.WireError, match="meta"):
             wire.decode_response(bytes(buf))
 
+    def test_fuzz_truncations_never_complete_or_hang(self):
+        # Chaos-plane contract: a frame cut at ANY byte boundary either
+        # raises WireError (oversized claims, header damage) or leaves
+        # the streaming decoder waiting for more bytes — it must never
+        # report done on a prefix, which is what keeps a half-relayed
+        # body from being handed to the engine as a frame.
+        rng = np.random.default_rng(20260806)
+        left = rng.standard_normal((12, 18, 3)).astype(np.float32)
+        right = rng.standard_normal((12, 18, 3)).astype(np.float32)
+        buf = wire.encode_request(left, right, {"iters": 4},
+                                  compress=True)
+        for cut in range(0, len(buf), 7):
+            dec = wire.FrameDecoder(expect=wire.FRAME_REQUEST)
+            try:
+                dec.feed(buf[:cut])
+            except wire.WireError:
+                continue
+            assert not dec.done, f"prefix of {cut} bytes decoded"
+            with pytest.raises(wire.WireError, match="truncated"):
+                wire.decode_request(buf[:cut])
+
+    def test_fuzz_bitflips_raise_wire_error_or_decode(self):
+        # Seeded single-bit corruption anywhere in the frame (the
+        # router's corrupt_frame chaos hook does exactly this between
+        # hops): the decoder must either raise WireError — the clean
+        # 400 the serving stack relies on — or return a materializable
+        # request.  Any other exception type would surface as a 500.
+        rng = np.random.default_rng(20260806)
+        left = rng.standard_normal((12, 18, 3)).astype(np.float32)
+        right = rng.standard_normal((12, 18, 3)).astype(np.float32)
+        buf = wire.encode_request(left, right, {"iters": 4},
+                                  compress=True)
+        rejected = 0
+        for _ in range(120):
+            i = int(rng.integers(0, len(buf)))
+            mutated = bytearray(buf)
+            mutated[i] ^= 1 << int(rng.integers(0, 8))
+            try:
+                req = wire.decode_request(bytes(mutated))
+            except wire.WireError:
+                rejected += 1
+                continue
+            req.left.tobytes()
+            req.right.tobytes()
+        # compressed payloads are checksummed: the vast majority of
+        # flips must be caught, not silently decoded
+        assert rejected > 60
+
     def test_meta_survives_json_round_trip(self):
         # frames embed meta as compact JSON — any JSON-legal fields ride
         fields = {"iters": None, "spatial": {"mode": "auto"},
