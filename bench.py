@@ -918,6 +918,182 @@ def bench_chaos(height: int, width: int, iters: int, requests: int,
     }
 
 
+def bench_sessions(height: int, width: int, iters: int, sessions: int,
+                   frames_per_session: int, corr: str, compute_dtype: str,
+                   quick: bool):
+    """Durable-session smoke (docs/streaming.md "Durable sessions"): a
+    churny many-session trace through a real 2-backend router fleet
+    wired to a real in-process session tier, with the busier backend
+    SIGKILLed mid-replay.  Reports the warm-rate (cold frames only at
+    sequence heads — the kill costs zero thanks to the tier's
+    write-behind snapshots), the zero-lost-session outcome, and the
+    int8 snapshot wire-byte reduction against the bitwise f32 form.
+    Refuses a dirty analysis baseline like every other smoke mode."""
+    import collections as _collections
+    import threading
+    import time as _time
+
+    from raftstereo_tpu.config import (RAFTStereoConfig, RouterConfig,
+                                       ServeConfig, StreamConfig,
+                                       TierConfig)
+    from raftstereo_tpu.data.synthetic import StereoVideoSequence
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.serve import build_server
+    from raftstereo_tpu.serve.client import ServeClient
+    from raftstereo_tpu.serve.cluster import build_router
+    from raftstereo_tpu.serve.server import snapshot_to_wire
+    from raftstereo_tpu.stream.tier import build_session_tier
+
+    import jax
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype, **model_kw)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+    iters = max(iters, 2)
+    tier = build_session_tier(TierConfig(port=0))
+    tier_thread = threading.Thread(target=tier.serve_forever, daemon=True)
+    tier_thread.start()
+    serve_cfg = ServeConfig(
+        port=0, buckets=((height, width),), max_batch_size=2,
+        max_wait_ms=5.0, queue_limit=64, iters=iters,
+        degraded_iters=iters, degrade_queue_depth=64, warmup=True,
+        stream=StreamConfig(ladder=(iters, max(1, iters // 2)),
+                            demote_threshold=0.0, promote_threshold=1e6,
+                            cold_reset_threshold=2e6,
+                            tier=("127.0.0.1", tier.port),
+                            tier_timeout_s=2.0, tier_backoff_ms=20.0),
+        stream_warmup=True)
+    # A temporally coherent sequence (realistic ~d0-px disparities, not
+    # random-noise garbage planes): what a streaming fleet actually
+    # serves, and what the int8 snapshot codec is bounded for.
+    seq_frames = StereoVideoSequence(n_frames=frames_per_session,
+                                     hw=(height, width), d0=4.0,
+                                     drift=0.25, pan=1)
+    frames = [(left, right) for left, right, _flow in seq_frames]
+    servers, threads = [], []
+    router = None
+    warm = cold = errors = 0
+    try:
+        for _ in range(2):
+            srv = build_server(model, variables, serve_cfg)
+            th = threading.Thread(target=srv.serve_forever, daemon=True)
+            th.start()
+            servers.append(srv)
+            threads.append(th)
+        router = build_router(RouterConfig(
+            port=0, backends=tuple(("127.0.0.1", s.port) for s in servers),
+            probe_interval_s=0.1, probe_timeout_s=0.5, fail_after=1,
+            retries=2, retry_backoff_ms=20.0, request_timeout_s=120.0,
+            session_tier=("127.0.0.1", tier.port)))
+        rt = threading.Thread(target=router.serve_forever, daemon=True)
+        rt.start()
+        threads.append(rt)
+        client = ServeClient("127.0.0.1", router.port, timeout=120,
+                             retries=2)
+        names = {i: f"b{i}" for i in range(len(servers))}
+        sids = [f"cam{i}" for i in range(sessions)]
+        homes = {}  # sid -> serving backend name (sticky until killed)
+        t0 = _time.perf_counter()
+
+        def run_round(seq: int):
+            nonlocal warm, cold, errors
+            left, right = frames[seq % len(frames)]
+            for sid in sids:  # interleaved round-robin: churny, sticky
+                try:
+                    _, meta = client.predict(left, right,
+                                             session_id=sid, seq_no=seq)
+                    homes[sid] = meta["backend"]
+                    if meta["warm"]:
+                        warm += 1
+                    else:
+                        cold += 1
+                except Exception:
+                    errors += 1
+
+        half = max(1, frames_per_session // 2)
+        for seq in range(half):
+            run_round(seq)
+        # SIGKILL the busier backend once its write-behind pushes have
+        # landed (flush only bounds the wait; frames never did).
+        counts = _collections.Counter(homes.values())
+        victim_name = counts.most_common(1)[0][0]
+        victim = servers[int(victim_name[1:])]
+        migrated = [s for s, h in homes.items() if h == victim_name]
+        if victim.tier_publisher is not None:
+            victim.tier_publisher.flush(timeout_s=60)
+        victim.close()  # no drain, no handoff sweep
+        for seq in range(half, frames_per_session):
+            run_round(seq)
+        wall_s = _time.perf_counter() - t0
+
+        survivor = next(s for s in servers if s is not victim)
+        # int8 snapshot reduction, measured on a REAL live session's
+        # exported state (what the publisher would push).
+        snap = None
+        for sid in sids:
+            snap = survivor.export_session(sid)
+            if snap is not None:
+                break
+        reduction = None
+        if snap is not None:
+            import numpy as np
+
+            raw_b = len(json.dumps(snapshot_to_wire(snap)))
+            # The quick smoke serves an UNTRAINED model whose outputs
+            # have arbitrary dynamic range, so the production bound
+            # (0.05 px) would correctly force the bitwise fallback.
+            # Scale the measurement bound to 1% of the plane's range so
+            # the codec itself is what gets measured; the bound used is
+            # reported alongside.
+            amax = float(np.max(np.abs(np.asarray(
+                snap["prev_disp_low"], np.float32))))
+            bound = max(0.05, amax / 100.0)
+            int8_b = len(json.dumps(snapshot_to_wire(
+                snap, compress="int8", compress_bound=bound)))
+            reduction = {"f32_bytes": raw_b, "int8_bytes": int8_b,
+                         "reduction_x": round(raw_b / max(int8_b, 1), 2),
+                         "bound_px": round(bound, 4)}
+        client.close()
+    finally:
+        if router is not None:
+            router.close()
+        tier.close()
+        tier_thread.join(10)
+        for srv in servers:
+            try:
+                srv.close()
+            except Exception:
+                pass
+        for th in threads:
+            th.join(10)
+    total = warm + cold
+    # Cold frames belong at sequence heads ONLY: the mid-replay kill is
+    # invisible because every migrated session resumed warm from the
+    # tier's snapshot.
+    expected_cold = len(sids)
+    return {
+        "sessions": len(sids),
+        "frames": total,
+        "warm_rate": round(warm / max(total - expected_cold, 1), 4),
+        "cold_frames": cold,
+        "expected_cold_frames": expected_cold,
+        "killed_backend": victim_name,
+        "migrated_sessions": len(migrated),
+        "lost_sessions": errors,
+        "tier_sessions": len(tier.store),
+        "tier_bytes": tier.store.total_bytes(),
+        "snapshot": reduction,
+        "pairs_per_sec": round(total / max(wall_s, 1e-9), 4),
+        "wall_s": round(wall_s, 3),
+    }
+
+
 def bench_stream(height: int, width: int, frames: int, iters: int,
                  corr: str, compute_dtype: str, quick: bool):
     """Streaming smoke benchmark (mirrors --serve): replay an N-frame
@@ -1420,6 +1596,14 @@ def main() -> None:
                         "ChaosPlan blackholes one backend; emits the "
                         "degraded-mode SLO verdict JSON (--reps = "
                         "request count)")
+    p.add_argument("--sessions", action="store_true",
+                   help="run the durable-session smoke (docs/streaming.md "
+                        "\"Durable sessions\"): churny many-session trace "
+                        "over a 2-backend router fleet wired to a real "
+                        "session tier, busier backend SIGKILLed "
+                        "mid-replay; emits warm-rate, zero-lost-session "
+                        "and int8 snapshot-byte-reduction JSON (--reps = "
+                        "session count)")
     p.add_argument("--stream", action="store_true",
                    help="benchmark the temporal warm-start streaming "
                         "subsystem: N-frame synthetic video sequence, "
@@ -1458,7 +1642,7 @@ def main() -> None:
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
     if args.quick or args.serve or args.stream or args.sched \
             or args.cluster or args.gru or args.quant or args.sl \
-            or args.spatial or args.slo or args.chaos:
+            or args.spatial or args.slo or args.chaos or args.sessions:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -1586,6 +1770,34 @@ def main() -> None:
         record = {
             "metric": f"chaos-mode pairs/sec @{w}x{h}, 2 backends behind "
                       f"the router, one blackhole window mid-replay",
+            "value": summary["pairs_per_sec"],
+            "unit": "pairs/sec",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
+
+    if args.sessions:
+        h, w = args.height, args.width
+        n_sessions = args.reps
+        frames_per_session = 6
+        if args.quick:
+            # Tiny model + shape; still crosses router + tier + kill +
+            # warm tier resume over real HTTP.
+            if not explicit_hw:
+                h, w = 64, 96
+            n_sessions = max(4, min(args.reps, 8))
+            if not explicit_iters:
+                args.iters = min(args.iters, 2)
+        summary = bench_sessions(h, w, args.iters, n_sessions,
+                                 frames_per_session, args.corr,
+                                 args.compute_dtype, quick=args.quick)
+        record = {
+            "metric": f"durable-session pairs/sec @{w}x{h}, "
+                      f"{summary['sessions']} churny sessions over 2 "
+                      f"backends + session tier, busier backend killed "
+                      f"mid-replay",
             "value": summary["pairs_per_sec"],
             "unit": "pairs/sec",
             "vs_baseline": 0.0,

@@ -31,6 +31,7 @@ __all__ = ["run_metrics_lint"]
 _SERVE_PATH = "raftstereo_tpu/serve/metrics.py"
 _TRAIN_PATH = "raftstereo_tpu/train/telemetry.py"
 _LOADGEN_PATH = "raftstereo_tpu/loadgen/metrics.py"
+_TIER_PATH = "raftstereo_tpu/stream/tier.py"
 
 
 def run_metrics_lint() -> List[Finding]:
@@ -39,6 +40,7 @@ def run_metrics_lint() -> List[Finding]:
     from ..obs import lint_registry, validate_prometheus
     from ..serve.metrics import (ClusterMetrics, MetricsRegistry,
                                  ServeMetrics)
+    from ..stream.tier import TierMetrics
     from ..train.telemetry import TrainMetrics
 
     findings: List[Finding] = []
@@ -53,6 +55,9 @@ def run_metrics_lint() -> List[Finding]:
         # Harness-side families (loadgen_*/slo_*): a soak rig may mount
         # them next to a scrape of any other bundle.
         loadgen = LoadgenMetrics(registry)
+        # The durable session tier's families (tier_*): its own process
+        # normally, but they must stay collision-free with the rest.
+        tier = TierMetrics(registry)
     except ValueError as e:  # duplicate registration across bundles
         return [Finding("RSA503", _TRAIN_PATH, 1,
                         f"bundle collision: {e}", "metrics")]
@@ -61,6 +66,7 @@ def run_metrics_lint() -> List[Finding]:
         path = _TRAIN_PATH if name.startswith("train") \
             else _LOADGEN_PATH \
             if name.startswith(("loadgen", "slo", "chaos")) \
+            else _TIER_PATH if name.startswith("tier") \
             else _SERVE_PATH
         findings.append(Finding("RSA501", path, 1, msg, "metrics"))
 
@@ -73,6 +79,7 @@ def run_metrics_lint() -> List[Finding]:
     serve.compile_hits.labels(bucket="64x96", iters="8",
                               mode="stream", tier="bf16").inc()
     serve.stream_cold_frames.labels(reason="new").inc()
+    serve.stream_tier_pushes.labels(outcome="ok").inc()
     serve.wire_bytes.labels(direction="in", format="binary").inc(1024)
     serve.wire_negotiations.labels(request="binary",
                                    response="json").inc()
@@ -98,6 +105,7 @@ def run_metrics_lint() -> List[Finding]:
     loadgen.latency.observe(0.01)
     loadgen.slo_checks.labels(status="pass").inc()
     loadgen.slo_pass.set(1)
+    tier.requests.labels(op="put", outcome="ok").inc()
     for msg in validate_prometheus(registry.render()):
         findings.append(Finding("RSA502", _SERVE_PATH, 1, msg, "metrics"))
     return findings

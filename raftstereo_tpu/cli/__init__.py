@@ -18,4 +18,7 @@
   manifest (docs/serving.md "Accuracy tiers")
 * ``python -m raftstereo_tpu.cli.loadgen``   — trace-driven SLO harness:
   gen / replay / fit / whatif (docs/slo_harness.md)
+* ``python -m raftstereo_tpu.cli.sessiontier`` — model-free durable
+  session tier: any replica resumes any stream warm (docs/streaming.md
+  "Durable sessions")
 """

@@ -1,0 +1,71 @@
+"""Durable session tier: shared external store for warm-start state.
+
+Start the tier, then point backends (write-behind pushes) and the
+router (lost-home warm resume) at it:
+
+    python -m raftstereo_tpu.cli.sessiontier --port 8082 &
+    python -m raftstereo_tpu.cli.serve --port 8080 \
+        --stream --session_tier 127.0.0.1:8082 ... &
+    python -m raftstereo_tpu.cli.router --port 8000 \
+        --backends 127.0.0.1:8080 127.0.0.1:8090 \
+        --session_tier 127.0.0.1:8082
+
+Backends push each session's latest snapshot AFTER the frame is
+answered (write-behind — the tier is never on a request path); when a
+session's home backend is lost, the router resumes it WARM on a
+survivor from the tier's latest snapshot instead of the cold_lost
+fallback.  A tier outage degrades cleanly to backend-local sessions —
+counted, never an error.  Semantics: docs/streaming.md "Durable
+sessions"; chaos grammar (``tier_outage``/``tier_slow``):
+docs/fault_tolerance.md.
+
+Like the router, the tier is model-free: it never imports the
+engine/model stack, stores snapshots as the verbatim wire JSON the
+backends exchange, and starts in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from ..config import add_tier_args, tier_config_from_args
+from .common import setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_tier_args(p)
+    return p
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    cfg = tier_config_from_args(args)
+
+    from ..stream.tier import build_session_tier
+
+    tier = build_session_tier(cfg)
+    print(json.dumps({
+        "tier": f"http://{cfg.host}:{tier.port}",
+        "session_limit": cfg.session_limit,
+        "budget_mb": cfg.budget_mb,
+        "endpoints": ["/healthz", "/metrics", "/debug/sessions",
+                      "/debug/faults"],
+    }), flush=True)
+    try:
+        tier.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+    finally:
+        tier.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -251,10 +251,50 @@ class StreamConfig:
     # error).
     session_limit: int = 256
     session_ttl_s: float = 300.0
+    # Byte budget for the in-replica session store (docs/streaming.md
+    # "Durable sessions"): LRU-evict while the byte-accurate state total
+    # (disparity nbytes + controller overhead) exceeds it.  0 keeps the
+    # historical count-only bound; the count cap stays as a secondary
+    # limit either way.
+    session_budget_mb: float = 0.0
+    # Snapshot wire compression for exports + write-behind tier pushes:
+    # "off" ships raw f32 planes (bitwise); "int8" rides ops/quant.py's
+    # per-row symmetric int8 with a per-snapshot exactness manifest and
+    # a bitwise f32 fallback when the manifest bound would be violated.
+    snapshot_compress: str = "off"
+    # Quantization-error bound (low-res px) the int8 manifest must
+    # certify; a snapshot whose max |dequant - f32| exceeds it ships raw.
+    snapshot_compress_bound: float = 0.05
+    # External durable session tier (stream/tier.py, cli.sessiontier):
+    # when set, every completed frame's snapshot is pushed write-behind
+    # (bounded coalescing queue, never on the request path) so any
+    # replica resumes any stream warm.  None = local-pin-only (PR 13).
+    tier: Optional[Tuple[str, int]] = None
+    # Write-behind robustness: per-call socket timeout, bounded
+    # retry/backoff (utils/backoff.py), coalescing-queue bound, and the
+    # re-probe cadence while degraded (tier unreachable -> local-pin
+    # behavior, never an error).
+    tier_timeout_s: float = 2.0
+    tier_retries: int = 2
+    tier_backoff_ms: float = 50.0
+    tier_queue_limit: int = 1024
+    tier_reprobe_s: float = 1.0
 
     def __post_init__(self):
         if isinstance(self.ladder, list):
             object.__setattr__(self, "ladder", tuple(self.ladder))
+        if isinstance(self.tier, list):
+            object.__setattr__(self, "tier", tuple(self.tier))
+        assert self.snapshot_compress in ("off", "int8"), \
+            self.snapshot_compress
+        assert self.snapshot_compress_bound >= 0, \
+            self.snapshot_compress_bound
+        assert self.session_budget_mb >= 0, self.session_budget_mb
+        assert self.tier_timeout_s > 0, self.tier_timeout_s
+        assert self.tier_retries >= 0, self.tier_retries
+        assert self.tier_backoff_ms >= 0, self.tier_backoff_ms
+        assert self.tier_queue_limit >= 1, self.tier_queue_limit
+        assert self.tier_reprobe_s > 0, self.tier_reprobe_s
         assert len(self.ladder) >= 2, (
             f"ladder {self.ladder} needs a cold level and at least one "
             f"warm level")
@@ -406,6 +446,11 @@ class RouterConfig:
     # breaker_reset_s it admits ONE half-open trial, whose outcome
     # closes or re-opens it.
     breaker_reset_s: float = 5.0
+    # (host, port) of a durable session tier (stream/tier.py,
+    # ``python -m raftstereo_tpu.cli.sessiontier``): when set, a session
+    # whose home backend is lost is resumed WARM from the tier's latest
+    # snapshot instead of the PR 13 ``cold_lost`` fallback.
+    session_tier: Optional[Tuple[str, int]] = None
     # Hedged requests for idempotent cold JSON /predict forwards:
     # 0 disables hedging (default).  When > 0, a hedge to the next
     # ready backend fires after max(hedge_floor_ms, live forward p99)
@@ -418,6 +463,9 @@ class RouterConfig:
         if isinstance(self.backends, list):
             object.__setattr__(
                 self, "backends", tuple(tuple(b) for b in self.backends))
+        if isinstance(self.session_tier, list):
+            object.__setattr__(
+                self, "session_tier", tuple(self.session_tier))
         assert self.probe_interval_s > 0, self.probe_interval_s
         assert self.probe_timeout_s > 0, self.probe_timeout_s
         assert self.fail_after >= 1, self.fail_after
@@ -431,6 +479,36 @@ class RouterConfig:
         assert self.breaker_reset_s > 0, self.breaker_reset_s
         assert self.hedge_floor_ms >= 0, self.hedge_floor_ms
         assert self.hedge_min_samples >= 1, self.hedge_min_samples
+
+
+@dataclasses.dataclass(frozen=True)
+class TierConfig:
+    """Durable session tier (stream/tier.py,
+    ``python -m raftstereo_tpu.cli.sessiontier``).
+
+    The tier is model-free: it stores each session's latest snapshot as
+    the verbatim wire JSON the backends already exchange over
+    ``/debug/sessions`` (docs/serving.md "Session migration"), never
+    decoding the arrays — so it starts in milliseconds, like the
+    router, and any schema the backends agree on rides through it
+    untouched."""
+
+    host: str = "127.0.0.1"
+    port: int = 8082  # 0 = ephemeral (tests bind a free port)
+    # Count cap on stored sessions (LRU beyond it — an evicted
+    # session's next resume falls back cold, never an error).
+    session_limit: int = 65536
+    # Byte budget over the stored wire bodies; LRU eviction while over
+    # it (0 disables the byte bound; the count cap stays either way).
+    budget_mb: float = 256.0
+    # Snapshot bodies are small (a low-res disparity plane), so the
+    # body cap is far below the serving default.
+    max_body_mb: float = 16.0
+
+    def __post_init__(self):
+        assert self.session_limit >= 1, self.session_limit
+        assert self.budget_mb >= 0, self.budget_mb
+        assert self.max_body_mb > 0, self.max_body_mb
 
 
 @dataclasses.dataclass(frozen=True)
@@ -799,6 +877,11 @@ def add_router_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--target_rps", type=float, default=d.target_rps,
                    help="planned aggregate request rate the capacity "
                         "model sizes the backend fleet for")
+    g.add_argument("--session_tier", type=_parse_backend, default=None,
+                   metavar="HOST:PORT",
+                   help="durable session tier (cli.sessiontier): resume "
+                        "a session warm from it when its home backend "
+                        "is lost (docs/streaming.md \"Durable sessions\")")
     g.add_argument("--breaker_reset_s", type=float,
                    default=d.breaker_reset_s,
                    help="seconds an open circuit breaker waits before "
@@ -829,9 +912,36 @@ def router_config_from_args(args: argparse.Namespace) -> RouterConfig:
         session_pin_limit=args.session_pin_limit,
         capacity_model=args.capacity_model,
         target_rps=args.target_rps,
+        session_tier=(tuple(args.session_tier)
+                      if args.session_tier is not None else None),
         breaker_reset_s=args.breaker_reset_s,
         hedge_floor_ms=args.hedge_floor_ms,
         hedge_min_samples=args.hedge_min_samples,
+    )
+
+
+def add_tier_args(parser: argparse.ArgumentParser) -> None:
+    d = TierConfig()
+    g = parser.add_argument_group("session tier")
+    g.add_argument("--host", default=d.host)
+    g.add_argument("--port", type=int, default=d.port,
+                   help="0 binds an ephemeral port")
+    g.add_argument("--session_limit", type=int, default=d.session_limit,
+                   help="max stored sessions (LRU beyond it; an evicted "
+                        "session's next resume falls back cold)")
+    g.add_argument("--budget_mb", type=float, default=d.budget_mb,
+                   help="byte budget over stored snapshot bodies (LRU "
+                        "eviction while over it; 0 = count-bounded only)")
+    g.add_argument("--max_body_mb", type=float, default=d.max_body_mb)
+
+
+def tier_config_from_args(args: argparse.Namespace) -> TierConfig:
+    return TierConfig(
+        host=args.host,
+        port=args.port,
+        session_limit=args.session_limit,
+        budget_mb=args.budget_mb,
+        max_body_mb=args.max_body_mb,
     )
 
 
@@ -864,6 +974,21 @@ def add_stream_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--session_ttl_s", type=float, default=d.session_ttl_s,
                    help="idle seconds after which a session expires (its "
                         "next frame re-runs cold, never an error)")
+    g.add_argument("--session_budget_mb", type=float,
+                   default=d.session_budget_mb,
+                   help="byte budget for in-replica session state (LRU "
+                        "eviction while over it; 0 = count-bounded only)")
+    g.add_argument("--snapshot_compress", choices=["off", "int8"],
+                   default=d.snapshot_compress,
+                   help="snapshot wire compression for exports + tier "
+                        "pushes: int8 = per-row symmetric quantization "
+                        "with an exactness manifest and a bitwise f32 "
+                        "fallback (docs/streaming.md)")
+    g.add_argument("--session_tier", type=_parse_backend, default=None,
+                   metavar="HOST:PORT",
+                   help="durable session tier (cli.sessiontier) to push "
+                        "completed-frame snapshots to, write-behind; "
+                        "unset = local-pin-only sessions")
 
 
 def stream_config_from_args(args: argparse.Namespace) -> StreamConfig:
@@ -875,6 +1000,10 @@ def stream_config_from_args(args: argparse.Namespace) -> StreamConfig:
         cold_reset_threshold=args.cold_reset_threshold,
         session_limit=args.session_limit,
         session_ttl_s=args.session_ttl_s,
+        session_budget_mb=args.session_budget_mb,
+        snapshot_compress=args.snapshot_compress,
+        tier=(tuple(args.session_tier)
+              if args.session_tier is not None else None),
     )
 
 

@@ -58,6 +58,11 @@ class AutoscalePolicy:
     # Scheduler-mode occupancy (sched_occupancy) that signals the
     # running batches themselves are saturated.
     high_occupancy: float = 0.85
+    # Session-state memory pressure (accounted stream_session_bytes over
+    # the fleet's configured byte budget, stream/session.py) above which
+    # the fleet is about to pay budget evictions — each one turns a live
+    # stream's next frame cold, so scale out BEFORE the budget trips.
+    high_memory_pressure: float = 0.9
     # Never recommend scaling below this many replicas.
     min_replicas: int = 1
     # Largest single-step recommendation in either direction.
@@ -68,7 +73,8 @@ class AutoscalePolicy:
 
 def recommend(policy: AutoscalePolicy, *, ready: int, utilization: float,
               occupancy: Optional[float] = None,
-              shed_delta: float = 0.0) -> Tuple[int, str]:
+              shed_delta: float = 0.0,
+              memory_pressure: float = 0.0) -> Tuple[int, str]:
     """Classify ONE observation into ``(direction, reason)`` with
     direction in {-1, 0, +1}.  Pure — the stateful hysteresis/shed-rate
     tracking lives in :class:`Autoscaler`."""
@@ -83,6 +89,10 @@ def recommend(policy: AutoscalePolicy, *, ready: int, utilization: float,
     if occupancy is not None and occupancy >= policy.high_occupancy:
         return 1, (f"sched occupancy {occupancy:.2f} >= "
                    f"{policy.high_occupancy:.2f}")
+    if memory_pressure >= policy.high_memory_pressure:
+        return 1, (f"session memory pressure {memory_pressure:.2f} >= "
+                   f"{policy.high_memory_pressure:.2f} — budget "
+                   "evictions imminent")
     if utilization <= policy.low_utilization and \
             ready > policy.min_replicas:
         return -1, (f"utilization {utilization:.2f} <= "
@@ -138,7 +148,8 @@ class Autoscaler:
 
     def observe(self, *, ready: int, utilization: float,
                 occupancy: Optional[float] = None,
-                shed_total: float = 0.0) -> Dict[str, object]:
+                shed_total: float = 0.0,
+                memory_pressure: float = 0.0) -> Dict[str, object]:
         """Fold one observation in; returns the advice dict surfaced in
         ``/debug/vars`` (``delta`` is what the gauge exports)."""
         policy = self.policy
@@ -147,7 +158,8 @@ class Autoscaler:
             self._last_shed = max(self._last_shed, shed_total)
             direction, reason = recommend(
                 policy, ready=ready, utilization=utilization,
-                occupancy=occupancy, shed_delta=shed_delta)
+                occupancy=occupancy, shed_delta=shed_delta,
+                memory_pressure=memory_pressure)
             if direction == self._streak_dir:
                 self._streak += 1
             else:
@@ -170,6 +182,7 @@ class Autoscaler:
                 "occupancy": (round(occupancy, 4)
                               if occupancy is not None else None),
                 "shed_delta": shed_delta,
+                "memory_pressure": round(memory_pressure, 4),
             },
         }
         cap = self.capacity_advice(ready)

@@ -77,6 +77,21 @@ class _StoreView:
         return sum(len(r.stream.store) for r in self._replicas
                    if r.stream is not None)
 
+    def total_bytes(self) -> int:
+        """Accounted session-state bytes across every replica's store
+        (the cluster-wide ``stream_session_bytes`` value)."""
+        return sum(r.stream.store.total_bytes() for r in self._replicas
+                   if r.stream is not None)
+
+    def session_ids(self):
+        """Live session ids across every replica (the tier publisher's
+        re-attach resync sweep iterates this)."""
+        sids = []
+        for r in self._replicas:
+            if r.stream is not None:
+                sids.extend(r.stream.store.session_ids())
+        return sids
+
 
 class ClusterDispatcher:
     """Thread-safe placement layer over a ReplicaSet."""
@@ -184,11 +199,23 @@ class ClusterDispatcher:
         # the cluster_autoscale_recommendation gauge.
         shed = sum(child.value for labels, child in cm.dispatch.series()
                    if labels[1] == "shed")
+        # Session-memory pressure: accounted state bytes over the
+        # fleet's configured byte budget (stream/session.py).  0.0 when
+        # streaming is off or no budget is set — the scale signal only
+        # engages where eviction pressure is a real possibility.
+        memory_pressure = 0.0
+        scfg = self.cfg.stream
+        if scfg is not None and scfg.session_budget_mb > 0:
+            stores = [r for r in self.rset.replicas if r.stream is not None]
+            if stores:
+                budget = scfg.session_budget_mb * 2 ** 20 * len(stores)
+                memory_pressure = round(
+                    self.store.total_bytes() / budget, 4)
         advice = self._autoscaler.observe(
             ready=len(ready), utilization=cm.utilization.value,
             occupancy=(sm.sched_occupancy.value
                        if self.cfg.sched is not None else None),
-            shed_total=shed)
+            shed_total=shed, memory_pressure=memory_pressure)
         cm.autoscale_recommendation.set(advice["delta"])
         cap = advice.get("capacity")
         # 0.0 without a model: the gauge renders from startup either

@@ -227,6 +227,8 @@ class Backend:
         self._queue_depth = 0  # guarded_by: _lock
         self._probe_failures = 0  # guarded_by: _lock
         self.inflight = 0  # guarded_by: _lock
+        self._session_bytes = 0  # guarded_by: _lock
+        self._session_budget_mb = 0.0  # guarded_by: _lock
 
     def routable(self) -> bool:
         with self._lock:
@@ -235,6 +237,13 @@ class Backend:
     def outstanding(self) -> int:
         with self._lock:
             return self.inflight + self._queue_depth
+
+    def session_memory(self) -> Tuple[int, float]:
+        """(accounted session bytes, configured budget MiB) from the
+        last successful probe — (0, 0.0) for a backend without
+        streaming or a byte budget."""
+        with self._lock:
+            return self._session_bytes, self._session_budget_mb
 
     def begin(self) -> None:
         with self._lock:
@@ -279,6 +288,13 @@ class Backend:
                 self.draining = bool(health["draining"])
             self.drained = bool(health.get("drained", False))
             self._queue_depth = int(health.get("queue_depth", 0) or 0)
+            # Session-memory signals from the backend's stream block
+            # (stream/session.py byte accounting) — the router's
+            # memory-pressure autoscale input.
+            stream = health.get("stream") or {}
+            self._session_bytes = int(stream.get("session_bytes", 0) or 0)
+            self._session_budget_mb = float(
+                stream.get("session_budget_mb", 0.0) or 0.0)
 
     def state(self) -> str:
         with self._lock:
@@ -824,9 +840,13 @@ class StereoRouter(ThreadingHTTPServer):
                          dst: Backend) -> str:
         """GET the snapshot off ``src``, POST it into ``dst`` (bodies
         relayed verbatim — the router never decodes the disparity, so
-        the move stays bitwise).  Every failure mode is the documented
-        cold_lost fallback: a killed backend refuses the GET, a
-        never-warm session 404s, and the next frame simply runs cold."""
+        the move stays bitwise).  When the direct move fails AND a
+        durable session tier is configured, resume from the tier's
+        latest write-behind snapshot instead — a SIGKILLed home backend
+        no longer costs the session its warmth.  Only with no tier (or
+        the tier also missing/unreachable) does the failure remain the
+        documented cold_lost fallback: the next frame simply runs
+        cold."""
         outcome = "cold_lost"
         if src is not None and src.bid != dst.bid:
             try:
@@ -844,9 +864,37 @@ class StereoRouter(ThreadingHTTPServer):
                         outcome = str(reply.get("outcome", "cold_lost"))
             except (OSError, ValueError):
                 outcome = "cold_lost"
+        if outcome == "cold_lost" and self.config.session_tier is not None:
+            outcome = self._resume_from_tier(session_id, dst)
         self.cluster_metrics.session_handoffs.labels(
             outcome=outcome).inc()
         return outcome
+
+    def _resume_from_tier(self, session_id: str, dst: Backend) -> str:
+        """Pull the tier's latest snapshot for ``session_id`` into
+        ``dst`` (same verbatim relay as the direct path — the tier
+        stores exactly the wire body the backends exchange).  A miss or
+        an unreachable tier is the cold_lost fallback, never an error;
+        a ``cold_schema`` reply from ``dst`` passes through (mixed
+        fleets refuse a foreign codec cleanly, docs/streaming.md)."""
+        host, port = self.config.session_tier
+        try:
+            status, snapshot = _http_json(
+                host, port, "GET",
+                "/debug/sessions/" + quote(session_id, safe=""),
+                timeout=self.config.probe_timeout_s)
+            if status != 200 or not snapshot:
+                return "cold_lost"
+            status2, reply = _http_json(
+                dst.host, dst.port, "POST", "/debug/sessions",
+                timeout=self.config.probe_timeout_s,
+                body=json.dumps(snapshot).encode(),
+                headers={"Content-Type": "application/json"})
+            if status2 == 200:
+                return str(reply.get("outcome", "cold_lost"))
+        except (OSError, ValueError):
+            pass
+        return "cold_lost"
 
     def migrate_all_from(self, backend: Backend) -> Dict[str, str]:
         """Move every session pinned to ``backend`` to the next ready
@@ -919,9 +967,18 @@ class StereoRouter(ThreadingHTTPServer):
         # in /debug/vars and the cluster_autoscale_recommendation gauge.
         shed = sum(child.value for labels, child in cm.dispatch.series()
                    if labels[1] == "shed")
+        # Session-memory pressure aggregated from the backends' probe
+        # reports (stream.session_bytes / session_budget_mb on
+        # /healthz): fleet bytes over fleet budget, among backends that
+        # configured a budget.  0.0 when none did.
+        mem = [b.session_memory() for b in ready]
+        budget = sum(m[1] for m in mem) * 2 ** 20
+        memory_pressure = (round(sum(m[0] for m in mem
+                                     if m[1] > 0) / budget, 4)
+                           if budget > 0 else 0.0)
         advice = self._autoscaler.observe(
             ready=len(ready), utilization=cm.utilization.value,
-            shed_total=shed)
+            shed_total=shed, memory_pressure=memory_pressure)
         cm.autoscale_recommendation.set(advice["delta"])
         cap = advice.get("capacity")
         # 0.0 without a model (same convention as the dispatcher).

@@ -275,6 +275,34 @@ class ServeMetrics:
             "stream_frame_latency_seconds",
             "per-frame wall-clock (warp + forward + host fetch), "
             "compile-free frames only")
+        # Durable session tier (stream/tier.py, docs/streaming.md
+        # "Durable sessions").
+        self.stream_session_bytes = r.gauge(
+            "stream_session_bytes",
+            "byte-accurate total of all live session state in the "
+            "in-replica store (disparity plane nbytes + fixed controller "
+            "overhead per session) — the value the session_budget_mb "
+            "byte-budget eviction bounds")
+        self.stream_tier_pushes = r.counter(
+            "stream_tier_pushes_total",
+            "write-behind snapshot pushes to the session tier by outcome: "
+            "ok (stored), stale (tier already held fresher state — "
+            "harmless), degraded (suppressed while detached from an "
+            "unreachable tier), dropped (coalescing queue overflowed; "
+            "oldest pending SID discarded, its next frame re-enqueues), "
+            "skipped (no exportable state at send time), error (push "
+            "failed after retries; the publisher detached)",
+            labels=("outcome",))
+        self.stream_tier_degraded = r.counter(
+            "stream_tier_degraded_total",
+            "pushes suppressed or failed because the session tier was "
+            "unreachable/slow — graceful degradation to local-pin "
+            "behaviour, never an error; the publisher re-probes every "
+            "tier_reprobe_s and re-attaches")
+        self.stream_tier_attached = r.gauge(
+            "stream_tier_attached",
+            "1 while the write-behind publisher considers the session "
+            "tier reachable, 0 while degraded to local-pin behaviour")
         # Iteration-level continuous batching (serve/sched/,
         # docs/serving.md).
         self.sched_slots_active = r.gauge(

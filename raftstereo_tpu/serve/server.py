@@ -65,7 +65,7 @@ import logging
 import threading
 import time
 from http.server import ThreadingHTTPServer
-from typing import Dict, Optional, Union
+from typing import Dict, List, Optional, Union
 from urllib.parse import unquote, urlparse
 
 import numpy as np
@@ -85,8 +85,9 @@ from .spatial import (SPATIAL_ENDPOINT, admit_spatial, route_spatial,
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["StereoServer", "build_server", "decode_array", "encode_array",
-           "snapshot_to_wire", "wire_to_snapshot"]
+__all__ = ["StereoServer", "UnsupportedSnapshotCodec", "build_server",
+           "decode_array", "encode_array", "snapshot_to_wire",
+           "wire_to_snapshot"]
 
 
 def encode_array(a: np.ndarray) -> Dict:
@@ -105,25 +106,92 @@ def decode_array(obj: Union[Dict, list]) -> np.ndarray:
     return a.reshape(obj["shape"]).astype(np.float32, copy=False)
 
 
-def snapshot_to_wire(snapshot: Dict) -> Dict:
-    """JSON form of a ``SessionStore.export_state`` snapshot.  The
-    disparity is encoded as raw base64 bytes so the round trip is
-    bitwise (the warm-handoff parity assertion depends on it); the
-    router relays these bodies verbatim without decoding."""
+class UnsupportedSnapshotCodec(ValueError):
+    """A snapshot wire form carries a disparity codec this build cannot
+    decode.  Mixed-fleet contract (docs/streaming.md "Durable
+    sessions"): the importer answers the documented ``cold_schema``
+    fallback — never garbage state, never a hard error."""
+
+
+def _quantize_plane_int8(x: np.ndarray):
+    """Host-side numpy mirror of ``ops/quant.quantize_rows`` (per-row
+    symmetric int8 over the last axis, zero-amax rows pinned to scale
+    1.0).  Returns ``(q, scale, max_abs_err)``; the dequant
+    ``q.astype(f32) * scale`` is the EXACT array a decoder reproduces
+    (same single multiply, so encoder-measured error is decoder truth
+    — the per-snapshot exactness manifest rides on it)."""
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    amax = np.max(np.abs(x), axis=-1)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(x / scale[..., None]), -127, 127).astype(np.int8)
+    deq = q.astype(np.float32) * scale[..., None]
+    return q, scale, float(np.max(np.abs(deq - x)))
+
+
+def snapshot_to_wire(snapshot: Dict, compress: str = "off",
+                     compress_bound: float = 0.05) -> Dict:
+    """JSON form of a ``SessionStore.export_state`` snapshot.
+
+    ``compress="off"`` encodes the disparity as raw f32 base64 bytes so
+    the round trip is bitwise (the warm-handoff parity assertion
+    depends on it).  ``compress="int8"`` rides the ops/quant.py per-row
+    symmetric int8 scheme (~4x fewer snapshot bytes) and carries a
+    per-snapshot exactness manifest ``{max_abs_err, bound}``; a plane
+    whose quantization error would exceed ``compress_bound`` (low-res
+    px) falls back to the bitwise raw form — compression never costs
+    more warmth than the manifest certifies.  The schema fingerprint
+    grows a ``snapshot_codec`` field when int8 is actually used, so a
+    peer that cannot decode it refuses cleanly (``cold_schema``).  The
+    router and the session tier relay these bodies verbatim without
+    decoding."""
     wire = dict(snapshot)
-    wire["prev_disp_low"] = encode_array(snapshot["prev_disp_low"])
+    plane = np.ascontiguousarray(snapshot["prev_disp_low"], np.float32)
+    wire["prev_disp_low"] = encode_array(plane)
+    if compress == "int8":
+        q, scale, err = _quantize_plane_int8(plane)
+        if err <= compress_bound:
+            wire["prev_disp_low"] = {
+                "codec": "int8",
+                "shape": list(plane.shape),
+                "q_b64": base64.b64encode(q.tobytes()).decode("ascii"),
+                "scale_b64": base64.b64encode(
+                    scale.tobytes()).decode("ascii"),
+                "manifest": {"max_abs_err": err,
+                             "bound": float(compress_bound)},
+            }
+            wire["schema"] = dict(snapshot.get("schema") or {},
+                                  snapshot_codec="int8-v1")
     if snapshot.get("bucket_hw"):
         wire["bucket_hw"] = list(snapshot["bucket_hw"])
     return wire
 
 
+def _decode_plane(prev) -> np.ndarray:
+    """Decode a wire disparity plane: raw f32 (``decode_array`` form),
+    nested lists, or the int8 codec.  Unknown codecs raise
+    :class:`UnsupportedSnapshotCodec` (mixed fleets fall back
+    ``cold_schema``, never garbage)."""
+    if isinstance(prev, dict) and "codec" in prev:
+        if prev["codec"] != "int8":
+            raise UnsupportedSnapshotCodec(
+                f"unknown snapshot codec {prev['codec']!r}")
+        shape = tuple(int(s) for s in prev["shape"])
+        q = np.frombuffer(base64.b64decode(prev["q_b64"]),
+                          dtype=np.int8).reshape(shape)
+        scale = np.frombuffer(base64.b64decode(prev["scale_b64"]),
+                              dtype=np.float32).reshape(shape[:-1])
+        return q.astype(np.float32) * scale[..., None]
+    return decode_array(prev)
+
+
 def wire_to_snapshot(obj: Dict) -> Dict:
     """Inverse of ``snapshot_to_wire`` (tolerates nested-list arrays —
-    same contract as ``decode_array``)."""
+    same contract as ``decode_array``; int8-codec planes are exactly
+    dequantized here)."""
     snap = dict(obj)
     prev = obj.get("prev_disp_low")
     if isinstance(prev, (dict, list)):
-        snap["prev_disp_low"] = decode_array(prev)
+        snap["prev_disp_low"] = _decode_plane(prev)
     if obj.get("bucket_hw"):
         snap["bucket_hw"] = tuple(int(x) for x in obj["bucket_hw"])
     return snap
@@ -287,7 +355,12 @@ class _Handler(JsonRequestHandler):
                     "ladder": list(srv.config.stream.ladder),
                     "sessions_active": len(srv.stream.store),
                     "session_limit": srv.config.stream.session_limit,
+                    "session_bytes": int(srv.stream.store.total_bytes()),
+                    "session_budget_mb":
+                        srv.config.stream.session_budget_mb,
                 }
+                if srv.tier_publisher is not None:
+                    health["stream"]["tier"] = srv.tier_publisher.state()
             if getattr(srv.engine, "spatial_shards", 1) > 1:
                 # Capability negotiation (serve/spatial/): a client
                 # reads this block to learn whether — and at which
@@ -317,7 +390,10 @@ class _Handler(JsonRequestHandler):
                 self._json(404, {"error": "no exportable state for "
                                           f"session {sid!r}"})
             else:
-                self._json(200, snapshot_to_wire(snapshot))
+                scfg = srv.config.stream
+                self._json(200, snapshot_to_wire(
+                    snapshot, compress=scfg.snapshot_compress,
+                    compress_bound=scfg.snapshot_compress_bound))
         elif url.path == "/debug/vars":
             lat = srv.metrics.latency
             self._json(200, {
@@ -434,8 +510,16 @@ class _Handler(JsonRequestHandler):
                                           "server"})
                 return
             try:
-                snapshot = wire_to_snapshot(json.loads(raw))
-                sid = str(snapshot["session_id"])
+                obj = json.loads(raw)
+                sid = str(obj.get("session_id", ""))
+                snapshot = wire_to_snapshot(obj)
+            except UnsupportedSnapshotCodec:
+                # Mixed-fleet contract: a codec this build cannot
+                # decode is the documented cold fallback, not an error
+                # — the session re-anchors cold here, never garbage.
+                self._json(200, {"session_id": sid,
+                                 "outcome": "cold_schema"})
+                return
             except Exception as e:
                 self._json(400, {"error": f"bad snapshot: {e}"})
                 return
@@ -997,6 +1081,10 @@ class StereoServer(ThreadingHTTPServer):
         # POST arms every hook in the process.
         self.fault_plan = (fault_plan if fault_plan is not None
                            else FaultPlan.from_env()).arm()
+        # Write-behind publisher to the durable session tier
+        # (stream/tier.TierPublisher); build_server wires it when
+        # ``config.stream.tier`` is set.  None = local-pin-only.
+        self.tier_publisher = None
         self.profiler = OnDemandProfiler(log_dir="runs/serve/profile")
         # Readiness (live vs ready on /healthz): set once warmup
         # finishes.  build_server passes start_ready=False and owns the
@@ -1160,6 +1248,8 @@ class StereoServer(ThreadingHTTPServer):
         """Stop accepting, drain the queue, release the socket."""
         self.shutdown()
         self.server_close()
+        if self.tier_publisher is not None:
+            self.tier_publisher.close()
         if self.batcher is not None:
             self.batcher.stop(drain=True)
         if self.scheduler is not None:
@@ -1286,6 +1376,40 @@ def build_server(model, variables, config: ServeConfig,
                           cluster=cluster, start_ready=False,
                           tiers=tiers, tier_reasons=tier_reasons,
                           fault_plan=fault_plan)
+    if config.stream is not None and config.stream.tier is not None:
+        from ..stream.tier import TierClient, TierPublisher
+
+        scfg = config.stream
+        runners = ([r.stream for r in cluster.rset.replicas
+                    if r.stream is not None]
+                   if cluster is not None else [stream])
+
+        def _live_sids() -> List[str]:
+            sids: List[str] = []
+            for rnr in runners:
+                sids.extend(rnr.store.session_ids())
+            return sids
+
+        publisher = TierPublisher(
+            TierClient(scfg.tier[0], scfg.tier[1],
+                       timeout_s=scfg.tier_timeout_s),
+            export_fn=server.export_session,
+            to_wire=lambda snap: snapshot_to_wire(
+                snap, compress=scfg.snapshot_compress,
+                compress_bound=scfg.snapshot_compress_bound),
+            metrics=metrics,
+            queue_limit=scfg.tier_queue_limit,
+            retries=scfg.tier_retries,
+            backoff_ms=scfg.tier_backoff_ms,
+            reprobe_s=scfg.tier_reprobe_s,
+            resync_fn=_live_sids,
+        ).start()
+        server.tier_publisher = publisher
+        # Hand the publisher to every runner: StreamRunner.step enqueues
+        # the SID after each completed frame (write-behind — the frame's
+        # request path never touches the tier).
+        for rnr in runners:
+            rnr.publisher = publisher
 
     def warm_then_ready():
         try:

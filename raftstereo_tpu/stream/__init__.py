@@ -18,6 +18,12 @@ accuracy.  Layers, bottom-up:
                    offline ``run_sequence``/``compare_warm_cold`` harness
                    shared by ``cli/stream.py``, ``bench.py --stream`` and
                    the acceptance tests.
+* ``tier``       — durable session tier: a model-free shared store for
+                   session snapshots (``cli.sessiontier`` service +
+                   ``TierClient`` + the backends' write-behind
+                   ``TierPublisher``), so any replica resumes any
+                   stream warm even after its home backend is gone
+                   (docs/streaming.md "Durable sessions").
 
 Entry points: ``python -m raftstereo_tpu.cli.stream`` (offline sequence
 runner), session-aware ``/predict`` (``session_id``/``seq_no``) on
@@ -34,3 +40,10 @@ from .runner import (  # noqa: F401
     run_sequence,
 )
 from .session import Session, SessionStore  # noqa: F401
+from .tier import (  # noqa: F401
+    SessionTier,
+    TierClient,
+    TierMetrics,
+    TierPublisher,
+    build_session_tier,
+)

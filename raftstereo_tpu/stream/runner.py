@@ -64,7 +64,7 @@ class StreamRunner:
 
     def __init__(self, engine, cfg: StreamConfig, metrics=None,
                  store: Optional[SessionStore] = None, tracer=None,
-                 scheduler=None):
+                 scheduler=None, publisher=None):
         self.engine = engine
         self.cfg = cfg
         self.metrics = metrics
@@ -74,9 +74,15 @@ class StreamRunner:
         # scheduler instead of dispatching batch-size-1 on the engine —
         # so a long plain request never head-of-line blocks a stream.
         self.scheduler = scheduler
+        # Write-behind publisher to the durable session tier
+        # (stream/tier.TierPublisher): completed frames enqueue their
+        # session id, never block on the tier (docs/streaming.md
+        # "Durable sessions").
+        self.publisher = publisher
         self.controller = AdaptiveIterController(cfg)
-        self.store = store or SessionStore(cfg.session_limit,
-                                           cfg.session_ttl_s, metrics)
+        self.store = store or SessionStore(
+            cfg.session_limit, cfg.session_ttl_s, metrics,
+            budget_mb=cfg.session_budget_mb)
 
     # ---------------------------------------------- migration (PR 13)
     #
@@ -207,7 +213,16 @@ class StreamRunner:
             frame_idx = sess.frame_idx
             sess.frame_idx += 1
             ema = sess.ema
+            # Byte-accurate store accounting: the plane just changed
+            # (session lock held; the store lock nests strictly inside).
+            self.store.account(sess)
             latency = time.perf_counter() - t0
+        if self.publisher is not None:
+            # Write-behind durability: enqueue the SID only — the
+            # publisher's worker exports the freshest snapshot at send
+            # time (natural per-session coalescing), so the frame's
+            # request path never touches the tier.
+            self.publisher.enqueue(session_id)
         if self.metrics is not None:
             if warm:
                 self.metrics.stream_warm_frames.inc()

@@ -41,6 +41,11 @@ STATE_VERSION = 1
 # itself rides along informationally, not as a hard gate).
 _SCHEMA_KEYS = ("factor", "input_mode", "gru_backend")
 
+# Fixed accounted overhead of one session beyond the disparity plane:
+# the controller scalars carried across frames (next_seq, frame_idx,
+# ema, level, force_cold, warm/cold frame counters) at 8 bytes each.
+_SESSION_OVERHEAD = 56
+
 
 @dataclasses.dataclass
 class Session:
@@ -77,20 +82,82 @@ class SessionStore:
     """
 
     def __init__(self, limit: int, ttl_s: float, metrics=None,
-                 now_fn=time.monotonic):
+                 now_fn=time.monotonic, budget_mb: float = 0.0):
         assert limit >= 1, limit
+        assert budget_mb >= 0, budget_mb
         self.limit = limit
         self.ttl_s = ttl_s
+        # Byte budget over the accounted state total; 0 disables the
+        # byte bound (count cap stays either way).
+        self.budget_bytes = int(budget_mb * 2 ** 20)
         self.metrics = metrics
         self._now = now_fn
         self._lock = threading.Lock()
         # guarded_by: _lock
         self._sessions: "collections.OrderedDict[str, Session]" = \
             collections.OrderedDict()
+        self._bytes: Dict[str, int] = {}    # guarded_by: _lock
+        self._total_bytes = 0               # guarded_by: _lock
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._sessions)
+
+    def total_bytes(self) -> int:
+        """Accounted bytes of all live session state (the value of the
+        ``stream_session_bytes`` gauge)."""
+        with self._lock:
+            return self._total_bytes
+
+    @staticmethod
+    def _state_bytes(sess: Session) -> int:
+        """Exact accounted bytes of one session's warm-start state: the
+        disparity plane's nbytes plus the fixed controller overhead and
+        the key.  Caller holds ``sess.lock`` (the plane is mutated
+        under it)."""
+        n = _SESSION_OVERHEAD + len(sess.sid.encode())
+        if sess.prev_disp_low is not None:
+            n += int(sess.prev_disp_low.nbytes)
+        return n
+
+    def account(self, sess: Session) -> None:
+        """Re-account one session's state bytes after its plane changed
+        (``StreamRunner.step`` / ``import_state`` call this right after
+        writing ``prev_disp_low``).  Caller holds ``sess.lock``; the
+        store lock is only ever taken after a session lock, never the
+        reverse, so the order is deadlock-free.  May byte-budget-evict
+        LRU sessions (never the one being accounted — it was just
+        touched, so it is most-recent)."""
+        n = self._state_bytes(sess)
+        with self._lock:
+            if sess.sid not in self._sessions:
+                return  # evicted while its frame ran; nothing to track
+            self._total_bytes += n - self._bytes.get(sess.sid, 0)
+            self._bytes[sess.sid] = n
+            self._evict_over_limits()
+            self._refresh_bytes_gauge()
+
+    def _forget_bytes(self, sid: str) -> None:  # guarded_by: _lock
+        self._total_bytes -= self._bytes.pop(sid, 0)
+
+    def _evict_over_limits(self) -> None:  # guarded_by: _lock
+        """LRU-evict while over the count cap OR the byte budget.  The
+        byte bound never evicts the last live session: a single
+        over-budget stream is served (and surfaced on the gauge), not
+        erroneously dropped mid-use."""
+        while (len(self._sessions) > self.limit
+               or (self.budget_bytes > 0
+                   and self._total_bytes > self.budget_bytes
+                   and len(self._sessions) > 1)):
+            sid, _ = self._sessions.popitem(last=False)
+            self._forget_bytes(sid)
+            if self.metrics is not None:
+                self.metrics.stream_evicted.inc()
+                self.metrics.stream_active.add(-1)
+
+    def _refresh_bytes_gauge(self) -> None:  # guarded_by: _lock
+        if self.metrics is not None:
+            self.metrics.stream_session_bytes.set(float(self._total_bytes))
 
     def get_or_create(self, sid: str) -> Tuple[Session, bool]:
         """Return ``(session, created)``, touching LRU order.
@@ -105,6 +172,7 @@ class SessionStore:
             if sess is not None:
                 if now - sess.last_used > self.ttl_s:
                     del self._sessions[sid]
+                    self._forget_bytes(sid)
                     if self.metrics is not None:
                         self.metrics.stream_expired.inc()
                         self.metrics.stream_active.add(-1)
@@ -120,17 +188,16 @@ class SessionStore:
                 # expire sessions in parallel, and an unlocked
                 # read-modify-write would lose counts.
                 self.metrics.stream_active.add(1)
-            while len(self._sessions) > self.limit:
-                self._sessions.popitem(last=False)
-                if self.metrics is not None:
-                    self.metrics.stream_evicted.inc()
-                    self.metrics.stream_active.add(-1)
+            self._evict_over_limits()
+            self._refresh_bytes_gauge()
             return sess, True
 
     def drop(self, sid: str) -> bool:
         """Explicitly end a session; True if it existed."""
         with self._lock:
             existed = self._sessions.pop(sid, None) is not None
+            self._forget_bytes(sid)
+            self._refresh_bytes_gauge()
             if existed and self.metrics is not None:
                 self.metrics.stream_active.add(-1)
             return existed
@@ -217,11 +284,7 @@ class SessionStore:
                 self._sessions[sid] = sess
                 if self.metrics is not None:
                     self.metrics.stream_active.add(1)
-                while len(self._sessions) > self.limit:
-                    self._sessions.popitem(last=False)
-                    if self.metrics is not None:
-                        self.metrics.stream_evicted.inc()
-                        self.metrics.stream_active.add(-1)
+                self._evict_over_limits()
             else:
                 sess.last_used = now
                 self._sessions.move_to_end(sid)
@@ -241,4 +304,5 @@ class SessionStore:
             sess.force_cold = bool(snapshot["force_cold"])
             sess.warm_frames = int(snapshot["warm_frames"])
             sess.cold_frames = int(snapshot["cold_frames"])
+            self.account(sess)
         return "warm"

@@ -1,0 +1,577 @@
+"""Durable session tier: a shared external store for warm-start state
+(docs/streaming.md "Durable sessions").
+
+The PR 13 snapshot seam made session state portable — any backend can
+export/import a session over ``/debug/sessions``.  This module makes it
+DURABLE: a model-free, stdlib-HTTP service
+(``python -m raftstereo_tpu.cli.sessiontier``) that holds each
+session's latest snapshot, so any replica resumes any stream WARM even
+after its home backend is gone, without the router pinning state to a
+process lifetime.  Three parts:
+
+* ``SessionTier``  — the service.  Stores each session's snapshot as
+                     the VERBATIM wire JSON the backends already
+                     exchange (never decodes the arrays — it is
+                     model-free, starts in milliseconds like the
+                     router) behind a byte-accounted LRU with a byte
+                     budget; refuses stale writes by sequence number.
+* ``TierClient``   — bounded-timeout stdlib HTTP client for both the
+                     backends' write path and the router's resume path.
+* ``TierPublisher``— write-behind durability on the backend side: after
+                     each completed frame the StreamRunner enqueues the
+                     session id (never the snapshot — the worker exports
+                     the FRESHEST state at send time, which coalesces a
+                     burst of frames into one push), and a single worker
+                     thread pushes outside the request path with bounded
+                     retry/backoff.  A tier outage degrades cleanly to
+                     the PR 13 local-pin behaviour: pushes are counted
+                     ``stream_tier_degraded_total`` and suppressed,
+                     never surfaced as request errors, and the publisher
+                     re-probes every ``tier_reprobe_s`` and re-attaches
+                     (re-enqueuing every live session so the tier
+                     catches back up).
+
+Chaos hooks (utils/faults.py, armable over ``POST /debug/faults`` on
+the tier): ``tier_outage@t_ms=OFF:SECS`` holds every reply for the
+window (connections accepted, nothing answered — clients time out
+against their own budgets), ``tier_slow@request=N:SECS`` delays the
+next N replies by SECS each.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import logging
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..config import TierConfig
+from ..serve.httpbase import JsonRequestHandler
+from ..serve.metrics import MetricsRegistry
+from ..utils.backoff import backoff_delay
+from ..utils.faults import FaultPlan
+
+__all__ = ["SessionTier", "TierClient", "TierMetrics", "TierPublisher",
+           "build_session_tier"]
+
+logger = logging.getLogger(__name__)
+
+
+class TierMetrics:
+    """The session tier's own instrument bundle (the tier process has no
+    serve bundle — it is model-free, like the router)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self.sessions_active = r.gauge(
+            "tier_sessions_active",
+            "sessions currently stored in the durable session tier")
+        self.session_bytes = r.gauge(
+            "tier_session_bytes",
+            "byte-accurate total of stored snapshot bodies (the value "
+            "the budget_mb byte-budget eviction bounds)")
+        self.requests = r.counter(
+            "tier_requests_total",
+            "tier requests by op (get/put/healthz/faults) and outcome "
+            "(ok/miss/stale/bad_request)",
+            labels=("op", "outcome"))
+        self.evictions = r.counter(
+            "tier_evictions_total",
+            "stored sessions LRU-evicted because the tier hit "
+            "session_limit or its byte budget — the evicted session's "
+            "next resume falls back cold, never an error")
+
+    def render(self) -> str:
+        return self.registry.render()
+
+
+class _TierStore:
+    """Byte-accounted LRU map of ``session_id -> latest wire body``.
+
+    Bodies are the verbatim serialized JSON the backends POST — the
+    tier never decodes the arrays inside, so accounting is exact:
+    ``len(body)``.  Stale writes (a snapshot whose ``next_seq`` is not
+    newer than what is stored) are refused with outcome ``"stale"`` —
+    the same monotonic guard SessionStore.import_state applies, moved
+    to the shared tier so two replicas racing pushes for one session
+    can never rewind its durable state.
+    """
+
+    def __init__(self, limit: int, budget_mb: float,
+                 metrics: Optional[TierMetrics] = None):
+        assert limit >= 1, limit
+        self.limit = limit
+        self.budget_bytes = int(budget_mb * 2 ** 20)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # sid -> (wire body bytes, next_seq)  # guarded_by: _lock
+        self._sessions: "collections.OrderedDict[str, Tuple[bytes, int]]" \
+            = collections.OrderedDict()
+        self._total_bytes = 0  # guarded_by: _lock
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._total_bytes
+
+    def get(self, sid: str) -> Optional[bytes]:
+        """Latest stored body for ``sid`` (touches LRU order), or None."""
+        with self._lock:
+            entry = self._sessions.get(sid)
+            if entry is None:
+                return None
+            self._sessions.move_to_end(sid)
+            return entry[0]
+
+    def put(self, sid: str, body: bytes, next_seq: int) -> str:
+        """Store ``body`` as the session's latest snapshot; returns
+        ``"stored"``, or ``"stale"`` when the stored snapshot is already
+        at least as fresh (nothing is overwritten — a stale push is
+        harmless, never an error)."""
+        with self._lock:
+            entry = self._sessions.get(sid)
+            if entry is not None and entry[1] >= next_seq:
+                self._sessions.move_to_end(sid)
+                return "stale"
+            if entry is not None:
+                self._total_bytes -= len(entry[0])
+            self._sessions[sid] = (body, next_seq)
+            self._sessions.move_to_end(sid)
+            self._total_bytes += len(body)
+            self._evict_over_limits()
+            self._refresh_gauges()
+            return "stored"
+
+    def _evict_over_limits(self) -> None:  # guarded_by: _lock
+        """LRU-evict while over the count cap OR the byte budget; the
+        byte bound never evicts the last stored session (mirrors
+        SessionStore)."""
+        while (len(self._sessions) > self.limit
+               or (self.budget_bytes > 0
+                   and self._total_bytes > self.budget_bytes
+                   and len(self._sessions) > 1)):
+            _, (body, _) = self._sessions.popitem(last=False)
+            self._total_bytes -= len(body)
+            if self.metrics is not None:
+                self.metrics.evictions.inc()
+
+    def _refresh_gauges(self) -> None:  # guarded_by: _lock
+        if self.metrics is not None:
+            self.metrics.sessions_active.set(float(len(self._sessions)))
+            self.metrics.session_bytes.set(float(self._total_bytes))
+
+
+class _TierHandler(JsonRequestHandler):
+    """The tier's HTTP dialect — the server side of the PR 13 snapshot
+    seam (``GET/POST /debug/sessions``), plus /healthz, /metrics and
+    the chaos arming endpoint."""
+
+    server_version = "raftstereo-sessiontier/1"
+    _log = logger
+
+    def _chaos_gate(self) -> None:
+        """tier_outage / tier_slow chaos seams: hold this reply for an
+        active outage window, then apply any armed per-request delay."""
+        srv: "SessionTier" = self.server
+        srv.fault_plan.tier_outage_hold()
+        delay = srv.fault_plan.tier_slow_delay()
+        if delay:
+            time.sleep(delay)
+
+    def do_GET(self):
+        srv: "SessionTier" = self.server
+        self._chaos_gate()
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            store = srv.store
+            self._json(200, {
+                "status": "ok",
+                "live": True,
+                "ready": True,
+                "sessions": len(store),
+                "session_bytes": store.total_bytes(),
+                "session_limit": store.limit,
+                "budget_mb": srv.config.budget_mb,
+            })
+        elif path == "/metrics":
+            self._send(200, srv.metrics.render().encode(),
+                       "text/plain; version=0.0.4")
+        elif path.startswith("/debug/sessions/"):
+            from urllib.parse import unquote
+
+            sid = unquote(path[len("/debug/sessions/"):])
+            body = srv.store.get(sid)
+            if body is None:
+                srv.metrics.requests.labels(op="get", outcome="miss").inc()
+                self._json(404, {"error": f"no snapshot for session "
+                                          f"{sid!r}"})
+            else:
+                srv.metrics.requests.labels(op="get", outcome="ok").inc()
+                self._send(200, body, "application/json")
+        else:
+            self._json(404, {"error": f"unknown path {path!r}"})
+
+    def do_POST(self):
+        srv: "SessionTier" = self.server
+        self._chaos_gate()
+        path = self.path.split("?", 1)[0]
+        if path == "/debug/sessions":
+            raw = self._read_body(srv.config.max_body_mb)
+            if raw is None:
+                return
+            try:
+                obj = json.loads(raw)
+                sid = str(obj["session_id"])
+                next_seq = int(obj["next_seq"])
+            except Exception:
+                srv.metrics.requests.labels(
+                    op="put", outcome="bad_request").inc()
+                self._json(400, {"error": "bad snapshot: session_id and "
+                                          "next_seq required"})
+                return
+            outcome = srv.store.put(sid, raw, next_seq)
+            srv.metrics.requests.labels(
+                op="put",
+                outcome="ok" if outcome == "stored" else outcome).inc()
+            self._json(200, {"session_id": sid, "outcome": outcome})
+        elif path == "/debug/faults":
+            raw = self._read_body(srv.config.max_body_mb)
+            if raw is None:
+                return
+            try:
+                spec = json.loads(raw or b"{}").get("faults", "")
+                armed = srv.fault_plan.extend(str(spec or ""))
+            except ValueError as e:
+                self._json(400, {"error": f"bad fault spec: {e}"})
+                return
+            self._json(200, {"armed": [f.spec() for f in armed]})
+        else:
+            self._json(404, {"error": f"unknown path {path!r}"})
+
+
+class SessionTier(ThreadingHTTPServer):
+    """The durable session tier service (one per fleet, like the
+    router).  ``build_session_tier`` assembles it; the caller drives
+    ``serve_forever()`` and ``close()``."""
+
+    daemon_threads = True
+
+    def __init__(self, config: TierConfig,
+                 metrics: Optional[TierMetrics] = None,
+                 fault_plan: Optional[FaultPlan] = None):
+        self.config = config
+        self.metrics = metrics or TierMetrics()
+        self.fault_plan = (fault_plan if fault_plan is not None
+                           else FaultPlan.from_env()).arm()
+        self.store = _TierStore(config.session_limit, config.budget_mb,
+                                self.metrics)
+        super().__init__((config.host, config.port), _TierHandler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def close(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+def build_session_tier(config: TierConfig,
+                       metrics: Optional[TierMetrics] = None
+                       ) -> SessionTier:
+    tier = SessionTier(config, metrics=metrics)
+    logger.info("session tier on %s:%d (limit=%d, budget=%.1f MiB)",
+                config.host, tier.port, config.session_limit,
+                config.budget_mb)
+    return tier
+
+
+class TierClient:
+    """Bounded-timeout stdlib HTTP client for the tier's dialect.
+
+    One fresh connection per call (no pooling): callers are the
+    write-behind publisher (one worker, low rate) and the router's
+    lost-home resume path (rare) — correctness under tier restarts
+    beats connection reuse here.  Every method raises ``OSError``-family
+    exceptions on failure; the CALLER owns degradation policy."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 2.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+
+    def _request(self, method: str, path: str,
+                 body: Optional[bytes] = None) -> Tuple[int, bytes]:
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+        try:
+            headers = {"Content-Type": "application/json"} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def healthz(self) -> bool:
+        """True when the tier answers /healthz ok within the timeout."""
+        try:
+            status, _ = self._request("GET", "/healthz")
+            return status == 200
+        except OSError:
+            return False
+
+    def get_session(self, sid: str) -> Optional[Dict]:
+        """Latest stored snapshot wire dict for ``sid``, or None when
+        the tier has nothing (404).  Raises on transport failure."""
+        from urllib.parse import quote
+
+        status, body = self._request(
+            "GET", f"/debug/sessions/{quote(sid, safe='')}")
+        if status == 404:
+            return None
+        if status != 200:
+            raise OSError(f"tier GET {sid!r} -> {status}")
+        return json.loads(body)
+
+    def put_wire(self, wire_obj: Dict) -> Dict:
+        """POST one snapshot wire dict; returns the tier's reply
+        (``{"session_id", "outcome": "stored"|"stale"}``).  Raises on
+        transport failure or a non-200."""
+        status, body = self._request("POST", "/debug/sessions",
+                                     json.dumps(wire_obj).encode())
+        if status != 200:
+            raise OSError(f"tier PUT -> {status}")
+        return json.loads(body)
+
+
+class TierPublisher:
+    """Write-behind snapshot publisher: backend-side durability without
+    ever touching the frame request path.
+
+    ``StreamRunner.step`` calls ``enqueue(sid)`` after each completed
+    frame; a single worker thread drains the queue, exporting the
+    FRESHEST snapshot at send time (so N queued frames of one session
+    collapse into one push — natural coalescing) and POSTing it to the
+    tier with bounded retry/backoff (utils/backoff.py).  Failure
+    degrades to local-pin behaviour: the publisher detaches, counts
+    ``stream_tier_degraded_total``, suppresses pushes, and re-probes
+    the tier every ``reprobe_s`` — on re-attach it re-enqueues every
+    live session (``resync_fn``) so the tier catches back up.  Nothing
+    here ever raises at a frame.
+
+    ``export_fn``/``to_wire`` are injected callables (the server wires
+    ``StereoServer.export_session`` and ``snapshot_to_wire``) so this
+    module never imports the engine stack and stays model-free
+    importable — the tier service itself lives in the same file.
+    ``clock``/``sleep`` are injectable so retry/reprobe tests never
+    sleep for real.
+    """
+
+    def __init__(self, client: TierClient,
+                 export_fn: Callable[[str], Optional[Dict]],
+                 to_wire: Callable[[Dict], Dict],
+                 metrics=None, *,
+                 queue_limit: int = 1024,
+                 retries: int = 2,
+                 backoff_ms: float = 50.0,
+                 reprobe_s: float = 1.0,
+                 resync_fn: Optional[Callable[[], List[str]]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        assert queue_limit >= 1, queue_limit
+        self.client = client
+        self._export = export_fn
+        self._to_wire = to_wire
+        self.metrics = metrics
+        self.queue_limit = queue_limit
+        self.retries = retries
+        self.backoff_ms = backoff_ms
+        self.reprobe_s = reprobe_s
+        self._resync = resync_fn
+        self._clock = clock
+        self._sleep = sleep
+        self._cv = threading.Condition()
+        # Pending session ids, oldest first; values unused (OrderedDict
+        # as an ordered set, so re-enqueueing a queued sid coalesces by
+        # moving it to the back).  # guarded_by: _cv
+        self._pending: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+        self._closed = False     # guarded_by: _cv
+        self._inflight = False   # worker mid-push  # guarded_by: _cv
+        self._attached = True    # guarded_by: _cv
+        self._next_probe = 0.0   # guarded_by: _cv
+        self._thread: Optional[threading.Thread] = None
+        self._set_attached_gauge(True)
+
+    # ------------------------------------------------------------- public
+
+    def start(self) -> "TierPublisher":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tier-publisher")
+        self._thread.start()
+        return self
+
+    def enqueue(self, sid: str) -> None:
+        """Queue one session for a write-behind push (coalescing:
+        re-enqueueing a queued sid just refreshes its position).  Over
+        ``queue_limit`` the OLDEST pending sid is dropped and counted
+        — its state is not lost, only its push is deferred to its next
+        completed frame.  Never blocks beyond the lock."""
+        with self._cv:
+            if self._closed:
+                return
+            self._pending[sid] = None
+            self._pending.move_to_end(sid)
+            if len(self._pending) > self.queue_limit:
+                self._pending.popitem(last=False)
+                self._count_push("dropped")
+            self._cv.notify()
+
+    def attached(self) -> bool:
+        with self._cv:
+            return self._attached
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def state(self) -> Dict:
+        """One-line publisher state for /healthz's stream block."""
+        with self._cv:
+            return {
+                "host": self.client.host,
+                "port": self.client.port,
+                "attached": self._attached,
+                "pending": len(self._pending),
+            }
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Block until the queue is drained and no push is in flight
+        (tests and drain paths); False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._pending or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                sid, _ = self._pending.popitem(last=False)
+                self._inflight = True
+                attached = self._attached
+                probe_due = (not attached
+                             and self._clock() >= self._next_probe)
+            try:
+                if not attached and not probe_due:
+                    # Degraded (tier unreachable, re-probe not due):
+                    # suppress the push — local-pin behaviour, the
+                    # session stays perfectly servable on this backend.
+                    self._count_push("degraded")
+                    self._count_degraded()
+                    continue
+                if not attached:
+                    if not self._probe():
+                        self._count_push("degraded")
+                        self._count_degraded()
+                        continue
+                self._push(sid)
+            except Exception:
+                # The worker must survive anything (an export racing a
+                # drop, a codec surprise) — durability is best-effort,
+                # frames never depend on it.
+                logger.exception("tier push failed unexpectedly (sid=%s)",
+                                 sid)
+                self._count_push("error")
+            finally:
+                with self._cv:
+                    self._inflight = False
+                    self._cv.notify_all()
+
+    def _probe(self) -> bool:
+        """Re-probe a detached tier; on success re-attach and re-enqueue
+        every live session so the tier catches up on what it missed."""
+        if not self.client.healthz():
+            with self._cv:
+                self._next_probe = self._clock() + self.reprobe_s
+            return False
+        with self._cv:
+            self._attached = True
+        self._set_attached_gauge(True)
+        logger.info("session tier reattached (%s:%d)",
+                    self.client.host, self.client.port)
+        if self._resync is not None:
+            for sid in self._resync():
+                self.enqueue(sid)
+        return True
+
+    def _detach(self) -> None:
+        with self._cv:
+            self._attached = False
+            self._next_probe = self._clock() + self.reprobe_s
+        self._set_attached_gauge(False)
+        self._count_degraded()
+        logger.warning("session tier unreachable; degrading to "
+                       "local-pin sessions (re-probe in %.1fs)",
+                       self.reprobe_s)
+
+    def _push(self, sid: str) -> None:
+        snapshot = self._export(sid)
+        if snapshot is None:
+            # Session dropped/expired between frame and push, or no
+            # completed frame yet — nothing durable to write.
+            self._count_push("skipped")
+            return
+        wire_obj = self._to_wire(snapshot)
+        for attempt in range(self.retries + 1):
+            try:
+                reply = self.client.put_wire(wire_obj)
+                outcome = str(reply.get("outcome", "stored"))
+                self._count_push("stale" if outcome == "stale" else "ok")
+                return
+            except (OSError, ValueError):
+                if attempt < self.retries:
+                    self._sleep(backoff_delay(self.backoff_ms, attempt))
+        self._count_push("error")
+        self._detach()
+        # The missed push is re-covered by the next completed frame's
+        # enqueue or the re-attach resync — no local retry queue to
+        # grow unboundedly during an outage.
+
+    # ------------------------------------------------------------ metrics
+
+    def _count_push(self, outcome: str) -> None:
+        if self.metrics is not None:
+            self.metrics.stream_tier_pushes.labels(outcome=outcome).inc()
+
+    def _count_degraded(self) -> None:
+        if self.metrics is not None:
+            self.metrics.stream_tier_degraded.inc()
+
+    def _set_attached_gauge(self, attached: bool) -> None:
+        if self.metrics is not None:
+            self.metrics.stream_tier_attached.set(1.0 if attached else 0.0)

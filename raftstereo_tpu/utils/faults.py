@@ -61,8 +61,21 @@ controller in loadgen/chaos.py, or from the env at server start)::
                           the next frame of each stream re-anchors
                           cold).  Hook: ``evict_due``
                           (serve/server.py -> dispatcher/runner).
+    tier_outage@t_ms=OFF:SECS  starting OFF ms after arming, for SECS,
+                          the session tier accepts connections but does
+                          not respond until the window closes — backend
+                          publishers time out and degrade to local-pin
+                          behavior, re-attaching when the window ends.
+                          Hooks: ``tier_outage_until`` /
+                          ``tier_outage_hold`` (stream/tier.py).
+    tier_slow@request=N:SECS  the next N session-tier requests each
+                          sleep SECS before being served — a tier that
+                          is alive but slow (the write-behind timeout /
+                          degraded-mode trigger).  Hook:
+                          ``tier_slow_delay`` (stream/tier.py).
 
-Count-valued kinds (``slow_replica``/``flap_probe``/``corrupt_frame``)
+Count-valued kinds (``slow_replica``/``flap_probe``/``corrupt_frame``/
+``tier_slow``)
 use the INT as a fire budget: the entry fires on each hook consult
 until N firings are spent.  Time-valued kinds (``@t_ms=``) measure
 offsets from ARMING (``FaultPlan.arm`` / ``extend``), so one plan
@@ -103,12 +116,16 @@ _KINDS = {
     "flap_probe": (("backend",), False, False),
     "corrupt_frame": (("request",), False, False),
     "evict_sessions": (("t_ms",), False, False),
+    "tier_outage": (("t_ms",), True, False),
+    "tier_slow": (("request",), True, False),
 }
 
 # Kinds whose INT is a fire budget (remaining = value), not an index.
-_COUNT_KINDS = frozenset({"slow_replica", "flap_probe", "corrupt_frame"})
+_COUNT_KINDS = frozenset(
+    {"slow_replica", "flap_probe", "corrupt_frame", "tier_slow"})
 # Kinds whose INT is a millisecond offset from arming.
-_TIMED_KINDS = frozenset({"blackhole_backend", "evict_sessions"})
+_TIMED_KINDS = frozenset(
+    {"blackhole_backend", "evict_sessions", "tier_outage"})
 
 # Serving hooks fire from many handler threads at once; the training
 # hooks are single-threaded by construction.  One coarse module lock
@@ -350,21 +367,40 @@ class FaultPlan:
         mid-pump (``corrupt_frame@request=N``)."""
         return self._take_any("corrupt_frame") is not None
 
-    def blackhole_until(self, now: Optional[float] = None
-                        ) -> Optional[float]:
-        """Monotonic end time of an ACTIVE blackhole window (armed
-        ``blackhole_backend@t_ms=OFF:SECS`` with
-        ``armed+OFF <= now < armed+OFF+SECS``), else None."""
-        now = time.monotonic() if now is None else now
+    def _window_until(self, kind: str, now: float) -> Optional[float]:
+        """Monotonic end time of an ACTIVE armed ``KIND@t_ms=OFF:SECS``
+        window (``armed+OFF <= now < armed+OFF+SECS``), else None."""
         with _HOOK_LOCK:
             for f in self.faults:
-                if f.kind != "blackhole_backend" or f.armed_at is None:
+                if f.kind != kind or f.armed_at is None:
                     continue
                 start = f.armed_at + f.value / 1e3
                 end = start + f.seconds
                 if start <= now < end:
                     return end
         return None
+
+    def _window_hold(self, kind: str, clock, sleep) -> float:
+        held = 0.0
+        while True:
+            now = clock()
+            end = self._window_until(kind, now)
+            if end is None:
+                return held
+            if held == 0.0:
+                logger.warning(
+                    "fault injection: %s holding request %.0f ms",
+                    kind, (end - now) * 1e3)
+            sleep(max(end - now, 0.0))
+            held += max(end - now, 0.0)
+
+    def blackhole_until(self, now: Optional[float] = None
+                        ) -> Optional[float]:
+        """Monotonic end time of an ACTIVE blackhole window (armed
+        ``blackhole_backend@t_ms=OFF:SECS`` with
+        ``armed+OFF <= now < armed+OFF+SECS``), else None."""
+        now = time.monotonic() if now is None else now
+        return self._window_until("blackhole_backend", now)
 
     def blackhole_hold(self, clock=time.monotonic,
                        sleep=time.sleep) -> float:
@@ -373,18 +409,30 @@ class FaultPlan:
         but nothing is answered until the window closes.  Returns the
         seconds held (0.0 outside any window).  Injected ``clock`` /
         ``sleep`` keep the unit tests wall-clock-free."""
-        held = 0.0
-        while True:
-            now = clock()
-            end = self.blackhole_until(now)
-            if end is None:
-                return held
-            if held == 0.0:
-                logger.warning(
-                    "fault injection: blackhole holding request %.0f ms",
-                    (end - now) * 1e3)
-            sleep(max(end - now, 0.0))
-            held += max(end - now, 0.0)
+        return self._window_hold("blackhole_backend", clock, sleep)
+
+    def tier_outage_until(self, now: Optional[float] = None
+                          ) -> Optional[float]:
+        """Monotonic end time of an ACTIVE session-tier outage window
+        (armed ``tier_outage@t_ms=OFF:SECS``), else None."""
+        now = time.monotonic() if now is None else now
+        return self._window_until("tier_outage", now)
+
+    def tier_outage_hold(self, clock=time.monotonic,
+                         sleep=time.sleep) -> float:
+        """Session-tier handler hook (stream/tier.py): while an outage
+        window is active, hold the request — the tier accepts the
+        connection but answers nothing until the window closes, so
+        backend publishers time out and degrade.  Returns the seconds
+        held (0.0 outside any window)."""
+        return self._window_hold("tier_outage", clock, sleep)
+
+    def tier_slow_delay(self) -> float:
+        """Session-tier handler hook (stream/tier.py): seconds to sleep
+        before serving the next tier request, 0.0 when no ``tier_slow``
+        fault has budget left."""
+        f = self._take_any("tier_slow")
+        return f.seconds if f is not None else 0.0
 
     def evict_due(self, now: Optional[float] = None) -> bool:
         """Server hook: True exactly once when an armed

@@ -126,3 +126,67 @@ class Hedger:
             if self._winner is None:
                 self._winner = name
             return self._winner == name
+
+
+class Publisher:
+    """Write-behind publisher shapes (PR 18): a condition-guarded
+    pending queue drained by one worker, with attach/detach state
+    flipped from both the worker and close()."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._pending = {}              # guarded_by: _cv
+        self._closed = False            # guarded_by: _cv
+        self._attached = True           # guarded_by: _cv
+        self._next_probe = 0.0          # guarded_by: _cv
+
+    def enqueue(self, sid):
+        with self._cv:
+            if self._closed:
+                return
+            self._pending[sid] = None
+            self._cv.notify()
+
+    def take(self):
+        with self._cv:
+            while not self._pending and not self._closed:
+                self._cv.wait()
+            if self._closed:
+                return None
+            sid = next(iter(self._pending))
+            del self._pending[sid]
+            return sid
+
+    def detach(self, now):
+        with self._cv:
+            self._attached = False
+            self._next_probe = now + 1.0
+
+
+class TierStore:
+    """Durable-tier store shapes (PR 18): byte accounting updated in
+    the same critical section as the map it mirrors."""
+
+    def __init__(self, limit):
+        self.limit = limit
+        self._lock = threading.Lock()
+        self._sessions = {}             # guarded_by: _lock
+        self._total_bytes = 0           # guarded_by: _lock
+
+    def put(self, sid, body, seq):
+        with self._lock:
+            entry = self._sessions.get(sid)
+            if entry is not None and entry[1] >= seq:
+                return "stale"
+            if entry is not None:
+                self._total_bytes -= len(entry[0])
+            self._sessions[sid] = (body, seq)
+            self._total_bytes += len(body)
+            self._shrink()
+            return "stored"
+
+    def _shrink(self):  # guarded_by: _lock
+        while len(self._sessions) > self.limit:
+            sid = next(iter(self._sessions))
+            body, _ = self._sessions.pop(sid)
+            self._total_bytes -= len(body)

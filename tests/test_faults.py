@@ -155,6 +155,61 @@ def test_extend_arms_at_extend_time_not_parse_time():
 
 
 # ---------------------------------------------------------------------------
+# Session-tier grammar (PR 18)
+# ---------------------------------------------------------------------------
+
+def test_tier_grammar_rejects_malformed():
+    for bad in ("tier_outage@t_ms=100",      # missing window length
+                "tier_outage@request=1:1s",  # wrong dimension
+                "tier_slow@request=2",       # missing required duration
+                "tier_slow@t_ms=100:1s",     # wrong dimension
+                "tier_slow@request=0:1s",    # count must be >= 1
+                "tier_outage@t_ms=-5:1s"):   # offset must be >= 0
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+def test_tier_outage_window_measures_from_arming():
+    plan = FaultPlan.parse("tier_outage@t_ms=100:0.5").arm(now=10.0)
+    assert plan.tier_outage_until(now=10.05) is None   # before the window
+    assert plan.tier_outage_until(now=10.1) == pytest.approx(10.6)
+    assert plan.tier_outage_until(now=10.59) == pytest.approx(10.6)
+    assert plan.tier_outage_until(now=10.6) is None    # window closed
+
+
+def test_tier_outage_hold_sleeps_to_window_end():
+    plan = FaultPlan.parse("tier_outage@t_ms=0:0.5").arm(now=0.0)
+    clock = [0.1]
+    slept = []
+
+    def fake_sleep(s):
+        slept.append(s)
+        clock[0] += s
+
+    held = plan.tier_outage_hold(clock=lambda: clock[0], sleep=fake_sleep)
+    assert held == pytest.approx(0.4) and slept == [pytest.approx(0.4)]
+    assert plan.tier_outage_hold(clock=lambda: clock[0],
+                                 sleep=fake_sleep) == 0.0
+
+
+def test_tier_outage_does_not_hold_blackhole_and_vice_versa():
+    """The two window kinds are independent hooks: a tier outage must
+    not stall backend replies, and a backend blackhole must not stall
+    the tier."""
+    plan = FaultPlan.parse("tier_outage@t_ms=0:1.0").arm(now=0.0)
+    assert plan.blackhole_until(now=0.5) is None
+    plan2 = FaultPlan.parse("blackhole_backend@t_ms=0:1.0").arm(now=0.0)
+    assert plan2.tier_outage_until(now=0.5) is None
+
+
+def test_tier_slow_is_a_count_budget():
+    plan = FaultPlan.parse("tier_slow@request=2:0.25").arm(now=0.0)
+    assert plan.tier_slow_delay() == 0.25
+    assert plan.tier_slow_delay() == 0.25
+    assert plan.tier_slow_delay() == 0.0              # budget exhausted
+
+
+# ---------------------------------------------------------------------------
 # Self-healing data loader
 # ---------------------------------------------------------------------------
 
