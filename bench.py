@@ -1288,6 +1288,104 @@ def bench_sched(height: int, width: int, long_iters: int, max_batch: int,
     }
 
 
+def bench_cascade(height: int, width: int, schedule: str, max_batch: int,
+                  corr: str, compute_dtype: str, quick: bool):
+    """Speculative-tier-cascade A/B smoke (serve/cascade/,
+    docs/serving.md "Tier cascade"): the SAME weights and engine answer
+    synthetic exact-GT pairs twice through the iteration scheduler — as
+    cascade requests on ``schedule`` and as monolithic default-precision
+    requests at the same TOTAL iteration count — reporting the
+    fp32-iteration fraction, the masked-EPE gap and per-path latency.
+    The cascade's pitch is "most iterations drafted on the cheap tier,
+    certified answer": the fraction quantifies the cost side, the EPE
+    gap the accuracy side.  Committed negative (docs/perf_notes_r08.md):
+    on CPU the int8 leg dequantizes per step, so wall-clock parity — not
+    speedup — is the expected latency_ratio here; the fraction is the
+    TPU-facing cost metric."""
+    import time as _time
+
+    import numpy as np
+
+    from raftstereo_tpu.config import (RAFTStereoConfig, SchedConfig,
+                                       ServeConfig)
+    from raftstereo_tpu.data.synthetic import ShiftStereoDataset
+    from raftstereo_tpu.models.raft_stereo import RAFTStereo
+    from raftstereo_tpu.serve import (BatchEngine, IterationScheduler,
+                                      ServeMetrics)
+    from raftstereo_tpu.serve.cascade import parse_schedule
+
+    import jax
+
+    corr = resolve_corr(corr)
+    model_kw = {}
+    if quick:
+        # CPU-feasible model, same shrink as the test suite's tiny configs.
+        model_kw = dict(n_gru_layers=2, hidden_dims=(32, 32), corr_levels=2,
+                        corr_radius=2)
+    sched = parse_schedule(schedule)
+    cfg = RAFTStereoConfig(corr_implementation=corr,
+                           compute_dtype=compute_dtype, **model_kw)
+    model = RAFTStereo(cfg)
+    variables = model.init(jax.random.key(0), (64, 96))
+    serve_cfg = ServeConfig(
+        port=0, buckets=((height, width),), max_batch_size=max_batch,
+        max_wait_ms=2.0, queue_limit=max(4 * max_batch, 16),
+        iters=sched.total_iters,
+        sched=SchedConfig(iters_per_step=1,
+                          max_iters=max(64, sched.total_iters)),
+        cascades=(sched.schedule,))
+    metrics = ServeMetrics()
+    engine = BatchEngine(model, variables, serve_cfg, metrics)
+    # Warm both paths so neither measurement charges an XLA compile: the
+    # monolithic comparison rides the default mode's phase executables;
+    # warmup_cascade warms both tiers' phases, the four cascade
+    # executables AND the handoff transition pair.
+    engine.warmup_sched()
+    engine.warmup_cascade(iters_per_step=1, schedules=[sched])
+
+    n_pairs = 4 if quick else 8
+    ds = ShiftStereoDataset(n=n_pairs, hw=(height, width), seed=0)
+    pairs = [(ds[i][1], ds[i][2]) for i in range(n_pairs)]
+    gts = np.stack([ds[i][3] for i in range(n_pairs)])
+    valid = np.stack([np.asarray(ds[i][4], np.float32)[..., None]
+                      for i in range(n_pairs)])
+    n_valid = max(float(valid.sum()), 1.0)
+
+    def run(submit):
+        """Serve every pair; masked EPE + per-request latency."""
+        lat, preds = [], []
+        t0 = _time.perf_counter()
+        for left, right in pairs:
+            t = _time.perf_counter()
+            res = submit(left, right).result(timeout=600)
+            lat.append((_time.perf_counter() - t) * 1e3)
+            preds.append(np.asarray(res.disparity, np.float32))
+        wall = _time.perf_counter() - t0
+        pred = np.stack(preds)[..., None]
+        epe = float((np.abs(pred - gts) * valid).sum() / n_valid)
+        return {
+            "epe": round(epe, 6),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "wall_s": round(wall, 3),
+            "pairs_per_sec": round(n_pairs / wall, 3),
+        }
+
+    with IterationScheduler(engine, serve_cfg, metrics) as scheduler:
+        casc = run(lambda a, b: scheduler.submit(a, b, cascade=sched))
+        mono = run(lambda a, b: scheduler.submit(a, b,
+                                                 iters=sched.total_iters))
+    return {
+        "schedule": sched.schedule,
+        "total_iters": sched.total_iters,
+        "fp32_iter_fraction": round(sched.fp32_fraction, 4),
+        "n_pairs": n_pairs,
+        "cascade": casc, "mono_fp32": mono,
+        "epe_gap": round(casc["epe"] - mono["epe"], 6),
+        "latency_ratio": round(casc["p50_ms"] / max(mono["p50_ms"], 1e-9),
+                               3),
+    }
+
+
 def bench_gru(height: int, width: int, batch: int, iters: int, corr: str,
               compute_dtype: str, reps: int, quick: bool):
     """GRU-backend A/B smoke (mirrors --serve/--sched's shape policy):
@@ -1551,6 +1649,15 @@ def main() -> None:
                         "vs the monolithic micro-batcher path, reporting "
                         "short-job p50/p99 both ways (the head-of-line "
                         "blocking gap)")
+    p.add_argument("--cascade", action="store_true",
+                   help="benchmark the speculative tier cascade: cascade "
+                        "requests vs monolithic default-precision requests "
+                        "through the scheduler at equal total iterations, "
+                        "reporting fp32-iteration fraction, masked-EPE gap "
+                        "and latency (serve/cascade/, docs/serving.md)")
+    p.add_argument("--cascade_schedule", default=None, metavar="SCHEDULE",
+                   help="cascade schedule for --cascade (default: "
+                        "int8:24+fp32:8; int8:6+fp32:2 under --quick)")
     p.add_argument("--gru", action="store_true",
                    help="A/B the GRU step backends: the same weights "
                         "through the test-mode forward with gru_backend "
@@ -1642,7 +1749,8 @@ def main() -> None:
     # (python -m raftstereo_tpu.analysis; docs/static_analysis.md).
     if args.quick or args.serve or args.stream or args.sched \
             or args.cluster or args.gru or args.quant or args.sl \
-            or args.spatial or args.slo or args.chaos or args.sessions:
+            or args.spatial or args.slo or args.chaos or args.sessions \
+            or args.cascade:
         from raftstereo_tpu.analysis import (baseline_entries,
                                              default_baseline_path)
         try:
@@ -1664,9 +1772,9 @@ def main() -> None:
     if args.reps is None:
         args.reps = 20
     if args.batch is None and not args.serve and not args.sched \
-            and not args.cluster and not args.slo:
-        args.batch = 1  # --serve/--sched/--cluster resolve their own
-        # default (8; 4 or 2 in --quick)
+            and not args.cluster and not args.slo and not args.cascade:
+        args.batch = 1  # --serve/--sched/--cluster/--cascade resolve
+        # their own default (8; 4 or 2 in --quick)
     # Defaults keyed on the mode, resolved only when the flag was NOT
     # given — an explicit --height/--width always wins (also under --tiled,
     # also with --quick).
@@ -1892,6 +2000,36 @@ def main() -> None:
                       f"iter workload, iteration-level continuous batching",
             "value": summary["sched"]["short_p99_ms"],
             "unit": "ms",
+            "vs_baseline": 0.0,
+        }
+        record.update(summary)
+        print(json.dumps(record))
+        return
+
+    if args.cascade:
+        h, w = args.height, args.width
+        batch = args.batch if args.batch is not None else 8
+        schedule = args.cascade_schedule
+        if args.quick:
+            # Tiny model + shape; still runs the full cascade-vs-
+            # monolithic comparison with a real handoff per request.  An
+            # explicitly given flag wins, same contract as --height.
+            if not explicit_hw:
+                h, w = 64, 96
+            batch = args.batch if args.batch is not None else 4
+            if schedule is None:
+                schedule = "int8:6+fp32:2"
+        if schedule is None:
+            schedule = "int8:24+fp32:8"
+        summary = bench_cascade(h, w, schedule, batch, args.corr,
+                                args.compute_dtype, quick=args.quick)
+        record = {
+            "metric": f"cascade masked-EPE gap @{w}x{h}, "
+                      f"{summary['schedule']} vs monolithic at "
+                      f"{summary['total_iters']} total iters, "
+                      f"iteration-level scheduler",
+            "value": summary["epe_gap"],
+            "unit": "px",
             "vs_baseline": 0.0,
         }
         record.update(summary)

@@ -18,14 +18,25 @@ method reaches the key:
 
 * key-relevant = the parameter name contains ``iters``, ``mode``,
   ``precision``, ``dtype``, ``backend``, ``accuracy``, ``tier``,
-  ``quant`` or ``shards`` — the inputs that select a distinct executable
-  (shape inputs are carried by the bucket, which every key already
-  starts from; ``backend`` covers kernel-backend selectors like the
-  fused-GRU ``gru_backend``, ``accuracy``/``tier``/``quant`` the
-  per-request accuracy tiers whose precision mode joins every serving
-  key, serve/engine.py + ops/quant.py, and ``shards`` the spatial mesh
-  width — a 2-shard and a 4-shard program at the same bucket are
-  different executables, parallel/spatial.py).
+  ``quant``, ``shards``, ``cascade`` or ``schedule`` — the inputs that
+  select a distinct executable (shape inputs are carried by the bucket,
+  which every key already starts from; ``backend`` covers
+  kernel-backend selectors like the fused-GRU ``gru_backend``,
+  ``accuracy``/``tier``/``quant`` the per-request accuracy tiers whose
+  precision mode joins every serving key, serve/engine.py +
+  ops/quant.py, ``shards`` the spatial mesh width — a 2-shard and a
+  4-shard program at the same bucket are different executables,
+  parallel/spatial.py — and ``cascade``/``schedule`` the tier-cascade
+  selectors, serve/cascade/: a cascade executable is keyed by BOTH its
+  precision modes, and a resolver keyed by the canonical schedule
+  string must carry it).
+
+Note the dual-mode cascade shape (serve/engine.py ``infer_cascade_*``):
+``cheap_mode`` and ``cert_mode`` are two *independent* key-relevant
+parameters — a key carrying only one of them hits the wrong
+(cheap, certified) pair's handoff program, which silently casts into
+the wrong dtype tree.  The token match is per-parameter, so both are
+demanded individually; no cascade-specific logic is needed.
 
 Codes:
 
@@ -46,7 +57,8 @@ __all__ = ["check"]
 
 _METHOD_RE = re.compile(r"^(infer|warmup)_")
 _KEY_TOKENS = ("iters", "mode", "precision", "dtype", "backend",
-               "accuracy", "tier", "quant", "input_mode", "shards")
+               "accuracy", "tier", "quant", "input_mode", "shards",
+               "cascade", "schedule")
 _CACHE_ATTR_RE = re.compile(r"compiled|cache", re.IGNORECASE)
 _DISPATCH_RE = re.compile(r"dispatch", re.IGNORECASE)
 

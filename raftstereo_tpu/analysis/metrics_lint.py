@@ -83,6 +83,9 @@ def run_metrics_lint() -> List[Finding]:
     serve.wire_bytes.labels(direction="in", format="binary").inc(1024)
     serve.wire_negotiations.labels(request="binary",
                                    response="json").inc()
+    serve.cascade_schedules.labels(schedule="int8:24+fp32:8").inc()
+    serve.cascade_promotions.labels(kind="scheduled").inc()
+    serve.cascade_iterations.labels(phase="certified").inc(8)
     serve.latency.observe(0.01)
     cluster.set_states({"ready": 1})
     cluster.queue_depth.labels(replica="r0").set(0)

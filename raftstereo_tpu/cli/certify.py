@@ -9,6 +9,15 @@ server validates at startup (``cli.serve --tiers ... --cert_manifest``)
 before advertising a tier on ``/predict``.  Exits non-zero when any
 requested tier measures over its bound — wire it as the CI gate between
 "quantized kernels changed" and "tier deployed".
+
+The ``cascade`` verb certifies speculative tier-cascade schedules
+(serve/cascade/, docs/serving.md "Tier cascade") the same way — masked
+EPE delta vs the fp32 monolithic reference at equal total iterations —
+and can merge the results into an existing tier manifest:
+
+    python -m raftstereo_tpu.cli.certify cascade \
+        --restore_ckpt models/sf.pth --schedules int8:24+fp32:8 \
+        --base certification.json --out certification.json
 """
 
 from __future__ import annotations
@@ -66,8 +75,99 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_cascade_bound(text: str):
+    try:
+        schedule, px = text.rsplit("=", 1)
+        return schedule, float(px)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"bound {text!r} is not SCHEDULE=PX "
+            "(e.g. int8:24+fp32:8=0.5)")
+
+
+def build_cascade_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m raftstereo_tpu.cli.certify cascade",
+        description="Certify speculative tier-cascade schedules: masked "
+                    "EPE delta vs the fp32 monolithic reference at equal "
+                    "total iterations (docs/serving.md \"Tier cascade\")")
+    p.add_argument("--restore_ckpt", default=None,
+                   help=".pth or Orbax weights to certify (default: "
+                        "random weights — smoke/dev only)")
+    p.add_argument("--schedules", nargs="+", required=True,
+                   metavar="SCHEDULE",
+                   help="cascade schedules to measure, e.g. "
+                        "int8:24+fp32:8 (the iteration budget is the "
+                        "schedule's — there is no --cert_iters)")
+    p.add_argument("--out", default="certification.json",
+                   help="manifest path the server's --cert_manifest reads")
+    p.add_argument("--base", default=None,
+                   help="existing manifest to merge the cascades table "
+                        "into (same architecture + platform required); "
+                        "omit to write a standalone cascade manifest")
+    p.add_argument("--cert_height", type=int, default=256)
+    p.add_argument("--cert_width", type=int, default=320)
+    p.add_argument("--cert_pairs", type=int, default=4,
+                   help="synthetic pairs in the certification set")
+    p.add_argument("--cert_seed", type=int, default=0)
+    p.add_argument("--cascade_bound", type=_parse_cascade_bound,
+                   nargs="+", default=[], metavar="SCHEDULE=PX",
+                   help="override a schedule's mean-EPE-delta bound in px "
+                        "(default: eval/certify.DEFAULT_CASCADE_BOUND)")
+    add_model_args(p)
+    return p
+
+
+def _cascade_main(argv) -> int:
+    args = build_cascade_parser().parse_args(argv)
+    config = model_config_from_args(args)
+
+    import jax
+
+    from ..eval.certify import (certify_cascades, load_manifest,
+                                write_manifest)
+    from ..models import RAFTStereo
+    from ..serve.cascade.schedule import parse_schedule
+
+    # Parse up front so a grammar typo fails before any model work.
+    canon = [parse_schedule(s).schedule for s in args.schedules]
+    model = RAFTStereo(config)
+    if args.restore_ckpt:
+        variables = load_variables(args.restore_ckpt, config, model)
+        logger.info("Loaded checkpoint %s", args.restore_ckpt)
+    else:
+        variables = model.init(jax.random.key(0),
+                               (args.cert_height, args.cert_width))
+        logger.warning("No --restore_ckpt: certifying RANDOM weights "
+                       "(smoke/dev only — the manifest fingerprints the "
+                       "architecture, not the weights)")
+    base = load_manifest(args.base) if args.base else None
+    bounds = {parse_schedule(s).schedule: px
+              for s, px in args.cascade_bound}
+    manifest = certify_cascades(
+        config, variables, canon,
+        hw=(args.cert_height, args.cert_width), n_pairs=args.cert_pairs,
+        seed=args.cert_seed, bounds=bounds or None, base=base)
+    write_manifest(manifest, args.out)
+    summary = {s: {k: e[k] for k in ("epe_delta", "bound", "certified")}
+               for s, e in manifest["cascades"].items()}
+    print(json.dumps({"manifest": args.out, "cascades": summary}))
+    uncertified = [s for s in canon
+                   if not manifest["cascades"][s]["certified"]]
+    if uncertified:
+        logger.error("cascades over bound: %s", uncertified)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     setup_logging()
+    if argv is None:
+        argv = sys.argv[1:]
+    # Verb-style dispatch rides in front of the historical flag-only
+    # parser, so every existing invocation is byte-compatible.
+    if list(argv[:1]) == ["cascade"]:
+        return _cascade_main(list(argv[1:]))
     args = build_parser().parse_args(argv)
     config = model_config_from_args(args)
 

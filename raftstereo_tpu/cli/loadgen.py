@@ -51,12 +51,20 @@ def _parse_hw(text: str):
 
 
 def _parse_weight(text: str):
-    name, _, weight = text.partition(":")
+    if "=" in text:
+        # The unambiguous form — required for cascade tiers, whose
+        # schedule grammar owns the colons (cascade:int8:24+fp32:8=2).
+        name, _, weight = text.rpartition("=")
+    elif text.startswith("cascade:"):
+        return text, 1.0
+    else:
+        name, _, weight = text.partition(":")
     try:
         return name, float(weight or 1.0)
     except ValueError:
         raise argparse.ArgumentTypeError(
-            f"{text!r} is not NAME[:WEIGHT] (e.g. fast:2)")
+            f"{text!r} is not NAME[:WEIGHT] or NAME=WEIGHT "
+            f"(e.g. fast:2, cascade:int8:24+fp32:8=2)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -82,7 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="frames per synthetic session")
     g.add_argument("--tiers", nargs="+", type=_parse_weight,
                    default=[("default", 1.0)], metavar="TIER[:W]",
-                   help="accuracy-tier mix (default/certified/fast/turbo)")
+                   help="accuracy-tier mix (default/certified/fast/turbo, "
+                        "or cascade:<schedule> for speculative tier "
+                        "cascades — weight via =W there, e.g. "
+                        "cascade:int8:24+fp32:8=2)")
     g.add_argument("--priorities", nargs="+", type=_parse_weight,
                    default=[("normal", 1.0)], metavar="PRIO[:W]")
     g.add_argument("--deadline", nargs="+", type=_parse_weight,

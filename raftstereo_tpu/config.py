@@ -611,6 +611,20 @@ class ServeConfig:
     tiers: Tuple[str, ...] = ()
     cert_manifest: Optional[str] = None
 
+    # Speculative tier cascades (serve/cascade/, docs/serving.md "Tier
+    # cascade"): schedule strings like "int8:24+fp32:8" — most GRU
+    # iterations drafted on a cheap precision tier, the last K run on
+    # the certified fp32 executables.  Requires ``sched`` (the handoff
+    # is an iteration-boundary leave+join) and a ``cert_manifest``
+    # certifying each schedule's EPE delta (cli.certify cascade);
+    # uncertified schedules are refused at startup, never served.
+    # ``cascade_divergence`` arms the early-promotion trigger: when the
+    # EMA of a drafting slot's per-step low-res disparity delta (px)
+    # exceeds it, the slot promotes to the certified tier before its
+    # scheduled boundary.  0 = scheduled handoffs only.
+    cascades: Tuple[str, ...] = ()
+    cascade_divergence: float = 0.0
+
     # Observability (obs/, docs/observability.md): capacity of the span
     # ring buffer behind /debug/trace.  Spans are a few hundred bytes; the
     # ring bounds memory no matter the traffic.
@@ -638,6 +652,34 @@ class ServeConfig:
         assert not bad_tiers, (
             f"unknown accuracy tiers {bad_tiers}; choose from "
             f"{list(_known_tiers)}")
+        if isinstance(self.cascades, list):
+            object.__setattr__(self, "cascades", tuple(self.cascades))
+        assert self.cascade_divergence >= 0, self.cascade_divergence
+        if self.cascades or self.cascade_divergence > 0:
+            assert self.sched is not None, (
+                "cascades require --sched: the tier handoff is an "
+                "iteration-boundary leave+join on the scheduler's "
+                "running batches (docs/serving.md \"Tier cascade\")")
+            assert self.cascades or self.cascade_divergence == 0, (
+                "--cascade_divergence without --cascades arms a trigger "
+                "nothing can fire")
+            # Parse + canonicalize each schedule against the grammar and
+            # the scheduler's granularity, fail-fast at config time (the
+            # grammar module is jax-free, so this costs no import
+            # weight in client-side processes).
+            from .serve.cascade.schedule import (parse_schedule,
+                                                 validate_schedule)
+            canon = []
+            for text in self.cascades:
+                s = validate_schedule(
+                    parse_schedule(text),
+                    iters_per_step=self.sched.iters_per_step,
+                    max_iters=self.sched.max_iters)
+                canon.append(s.schedule)
+            assert len(set(canon)) == len(canon), (
+                f"duplicate cascade schedules in {list(self.cascades)} "
+                f"(canonical: {canon})")
+            object.__setattr__(self, "cascades", tuple(canon))
         # Degradation can only reduce work: a degraded_iters above iters
         # (e.g. the default 16 with --serve_iters 8) clamps down rather
         # than rejecting the config.
@@ -761,6 +803,21 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
                    help="certification manifest written by "
                         "'python -m raftstereo_tpu.cli.certify'; "
                         "validated at startup before a tier is advertised")
+    g.add_argument("--cascades", nargs="+", default=list(d.cascades),
+                   metavar="SCHEDULE",
+                   help="speculative tier-cascade schedules to offer, "
+                        "e.g. int8:24+fp32:8 (draft on the cheap tier, "
+                        "certify on fp32); requires --sched and a "
+                        "--cert_manifest certifying each schedule "
+                        "('cli.certify cascade'; docs/serving.md "
+                        "\"Tier cascade\")")
+    g.add_argument("--cascade_divergence", type=float,
+                   default=d.cascade_divergence,
+                   help="early-promotion trigger: EMA of a drafting "
+                        "slot's per-step low-res disparity delta (px) "
+                        "above which it hands off to the certified tier "
+                        "before its scheduled boundary; 0 = scheduled "
+                        "handoffs only")
 
 
 def add_sched_args(parser: argparse.ArgumentParser) -> None:
@@ -1039,6 +1096,8 @@ def serve_config_from_args(args: argparse.Namespace,
         trace_buffer=args.trace_buffer,
         tiers=tuple(args.tiers),
         cert_manifest=args.cert_manifest,
+        cascades=tuple(args.cascades),
+        cascade_divergence=args.cascade_divergence,
     )
 
 

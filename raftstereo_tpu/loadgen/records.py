@@ -56,6 +56,11 @@ class RequestRow:
     session: str = ""
     seq_no: Optional[int] = None
     warm: Optional[bool] = None       # session frames: warm-start engaged
+    # Speculative tier cascade (serve/cascade/): the canonical schedule
+    # that served this request ("" = single-tier path) and whether the
+    # divergence trigger promoted it to the certified tier early.
+    cascade: str = ""
+    promoted_early: Optional[bool] = None
     degraded: bool = False
     backend: str = ""                 # X-Backend via the router
     request_id: str = ""

@@ -220,6 +220,8 @@ def replay(events: Sequence[TraceEvent], cfg: ReplayConfig,
                 outcome="ok", latency_ms=latency_ms, status=200,
                 deadline_hit=hit, iters_done=meta.get("iters"),
                 warm=meta.get("warm"),
+                cascade=meta.get("cascade") or "",
+                promoted_early=meta.get("promoted_early"),
                 degraded=bool(meta.get("degraded", False)),
                 backend=meta.get("backend", ""),
                 request_id=meta.get("request_id") or "",

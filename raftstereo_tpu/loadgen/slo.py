@@ -156,6 +156,14 @@ def _group_stats(rows: Sequence[RequestRow]) -> Dict:
         # about warmth HOLDING, so rate is over non-initial frames.
         cold = sum(1 for r in frames if r.outcome == "ok" and not r.warm)
         stats["cold_frame_rate"] = round(cold / len(frames), 4)
+    cascaded = [r for r in ok if r.cascade]
+    if cascaded:
+        # Cascade-served answers (serve/cascade/) and how many the
+        # divergence trigger promoted early — keyed only when the group
+        # saw cascades, preserving the historical stats schema.
+        stats["cascade"] = len(cascaded)
+        stats["promoted_early"] = sum(1 for r in cascaded
+                                      if r.promoted_early)
     return stats
 
 
@@ -168,6 +176,12 @@ _DELTA_FAMILIES = (
     "sched_early_exits_total", "cluster_dispatch_total",
     "loadgen_requests_total", "wire_bytes_total",
     "cluster_wire_stream_bytes_total",
+    # Tier-cascade families (serve/cascade/): completed cascades,
+    # promotions (scheduled + early) and per-phase iteration counts —
+    # the server-side cross-check that cascade rows really drafted
+    # their cheap iterations where the client thinks they did.
+    "cascade_schedules_total", "cascade_promotions_total",
+    "cascade_iterations_total",
 )
 
 

@@ -19,6 +19,16 @@ deadline, controller-owned iterations).  Session frames never carry
 priority/deadline/iters — the server rejects that combination (400,
 docs/serving.md "Scheduling") and the generator respects the contract.
 
+``tier`` also accepts ``cascade:<schedule>`` (e.g.
+``cascade:int8:24+fp32:8``) — the speculative-tier-cascade request form
+(serve/cascade/, docs/serving.md "Tier cascade").  Cascade events never
+carry explicit ``iters`` (the schedule fixes the budget; the server
+rejects the combination) and the schedule grammar is validated at trace
+read/generate time so a typo fails before any traffic is offered.  The
+plain ``certified`` tier stays valid as ever — against a
+cascade-serving deployment it resolves server-side to the cheapest
+certified cascade.
+
 Generators are DETERMINISTIC: same ``TraceSpec`` (seed included) ⇒
 byte-identical JSONL.  That is what makes "replay the same trace twice,
 demand identical request streams" an assertable property
@@ -89,6 +99,22 @@ class TraceEvent:
         if self.priority is not None and self.priority not in _PRIORITIES:
             raise ValueError(f"event {self.index}: bad priority "
                              f"{self.priority!r}")
+        if self.tier is not None and self.tier.startswith("cascade:"):
+            # Cascade requests (serve/cascade/): fail a schedule typo at
+            # trace time, not as N replayed 400s.  The schedule module
+            # is deliberately jax-free, so this stays client-weight.
+            from ..serve.cascade.schedule import parse_schedule
+            try:
+                parse_schedule(self.tier[len("cascade:"):])
+            except (ValueError, AssertionError) as e:
+                raise ValueError(f"event {self.index}: bad cascade "
+                                 f"schedule {self.tier!r}: {e}")
+            if self.iters is not None:
+                # Mirrors the server's 400: the schedule fixes the
+                # iteration budget, an explicit target contradicts it.
+                raise ValueError(
+                    f"event {self.index}: cascade events cannot carry "
+                    f"iters (the schedule fixes the budget)")
         if self.session is not None:
             if self.priority is not None or self.deadline_ms is not None \
                     or self.iters is not None:
@@ -239,6 +265,12 @@ def generate(spec: TraceSpec) -> List[TraceEvent]:
                     rng.random() < spec.iters_fraction:
                 iters = int(spec.iters_choices[
                     int(rng.integers(0, len(spec.iters_choices)))])
+            if tier.startswith("cascade:"):
+                # The schedule fixes the budget; drawing THEN dropping
+                # keeps rng consumption identical across tier choices,
+                # so adding a cascade to the mix never reshuffles the
+                # other events' draws.
+                iters = None
             spatial = None
             if spec.spatial_fraction and \
                     rng.random() < spec.spatial_fraction:

@@ -18,6 +18,13 @@ Layers, bottom-up:
                               aging, deadline-aware anytime early exit,
                               no head-of-line blocking; docs/serving.md
                               "Scheduling").
+* ``cascade``               — speculative tier cascades (``--cascades``):
+                              schedule grammar, divergence-trigger
+                              policy and the cheap-to-certified state
+                              handoff — most GRU iterations on a cheap
+                              precision tier, the last K on the
+                              certified fp32 executables (docs/serving.md
+                              "Tier cascade").
 * ``metrics``               — counters / gauges / latency histograms with
                               Prometheus text exposition.
 * ``server.StereoServer``   — stdlib HTTP front-end: ``/predict``,
@@ -62,6 +69,11 @@ _EXPORTS = {
     "StereoRouter": ".cluster",
     "build_router": ".cluster",
     "BatchEngine": ".engine",
+    "CascadeSchedule": ".cascade",
+    "cheapest": ".cascade",
+    "handoff_state": ".cascade",
+    "parse_schedule": ".cascade",
+    "validate_schedule": ".cascade",
     "ClusterMetrics": ".metrics",
     "Counter": ".metrics",
     "Gauge": ".metrics",

@@ -1,0 +1,60 @@
+"""Speculative tier cascade: cheap-tier iterations, certified answers.
+
+RAFT-Stereo's anytime property (accuracy rises smoothly with GRU
+iteration count) plus the per-(bucket, mode) running batches of the
+iteration scheduler and the certified precision tiers sharing one weight
+set enable a draft/verify-style serving policy: run most GRU iterations
+on a cheap tier (int8/bf16) and hand the carried state to the certified
+fp32 executables for the last K iterations.  Pure policy over existing
+executables — no new kernels.
+
+The subsystem splits the same way ``serve/sched`` does:
+
+* :mod:`.schedule` — the versioned schedule grammar
+  (``"int8:24+fp32:8"``) and its validation against the tier vocabulary
+  and the scheduler's ``iters_per_step`` granularity;
+* :mod:`.policy` — the pure divergence-trigger functions (an EMA of the
+  per-step low-res disparity delta, the same signal family as
+  ``stream/controller.py``) deciding when a cascade slot promotes to the
+  certified tier;
+* :mod:`.handoff` — the cross-tier state handoff expression shared by
+  the serving engine and the certification harness, so what is certified
+  is exactly what serves.
+"""
+
+import importlib
+
+# Lazy (PEP 562) exports, same policy as the parent package: the
+# schedule grammar and promotion policy are pure Python, but ``handoff``
+# pulls jax — a scheduler or config import of the grammar must not drag
+# the numerics stack in.
+_EXPORTS = {
+    "handoff_state": ".handoff",
+    "DIVERGENCE_DECAY": ".policy",
+    "promotion_kind": ".policy",
+    "should_promote": ".policy",
+    "update_ema": ".policy",
+    "MODE_COST": ".schedule",
+    "SCHEDULE_VERSION": ".schedule",
+    "CascadeSchedule": ".schedule",
+    "cheapest": ".schedule",
+    "parse_schedule": ".schedule",
+    "validate_schedule": ".schedule",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        rel = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    value = getattr(importlib.import_module(rel, __name__), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

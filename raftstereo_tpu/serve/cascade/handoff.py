@@ -1,0 +1,55 @@
+"""The cross-tier state-handoff expression, shared engine <-> certifier.
+
+One function owns the cheap-to-certified state translation so the
+serving engine (serve/engine.py ``infer_cascade_handoff``) and the
+certification harness (eval/certify.py ``certify_cascades``) compile the
+SAME math — what is certified is exactly what serves.
+
+The carried state (models/raft_stereo.forward_prologue) splits into:
+
+* **tier-independent** leaves — the GRU hidden states (``nets``), the
+  context features (``zqr``) and the low-res disparity (``disp``, always
+  fp32): semantically mode-free, only their storage dtype follows the
+  tier's compute dtype.  The handoff CASTS them to the certified
+  exemplar's dtypes, so the certified step executable (traced at warmup
+  from a certified prologue) sees exactly the signature it was traced
+  with;
+* **tier-specific** leaves — the correlation state (``corr``): an int8
+  tier's corr state is quantized rows + scales, structurally different
+  from the fp32 pyramid.  It cannot be cast; the cascade prologue stages
+  the certified tier's corr state alongside the cheap one (built from
+  the same images in the same dispatch) and the handoff SWAPS it in.
+
+The staging cost — the documented builder decision (docs/serving.md
+"Tier cascade"): the cascade prologue runs BOTH tiers' prologues, so a
+cascade join pays one extra fp32 encode + correlation build and holds
+the certified corr state in device memory for the cheap leg's duration.
+Rebuilding at handoff instead would halve prologue cost but stall the
+certified batch behind a fresh encode at every promotion — and an
+early-promotion trigger would make that stall data-dependent.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["handoff_state"]
+
+
+def handoff_state(state, stage):
+    """Assemble the certified-tier carried state at the tier handoff.
+
+    ``state`` is the cheap tier's carried state after its drafting leg;
+    ``stage`` is the certified tier's staged prologue state (same batch,
+    same images).  Tier-independent leaves carry over from ``state``
+    (cast leaf-by-leaf to ``stage``'s dtypes — the certified trace's
+    exact signature); the tier-specific corr state comes from ``stage``.
+    ``disp`` is fp32 on every tier (the model contract) and carries over
+    uncast.
+    """
+    def carry(part):
+        return jax.tree.map(lambda c, x: c.astype(x.dtype),
+                            state[part], stage[part])
+
+    return {"nets": carry("nets"), "zqr": carry("zqr"),
+            "corr": stage["corr"], "disp": state["disp"]}

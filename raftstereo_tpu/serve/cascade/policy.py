@@ -1,0 +1,67 @@
+"""Pure cascade-promotion policy: when a slot leaves the cheap tier.
+
+Same design contract as ``serve/sched/policy.py``: every function here
+is pure (no clocks, no engine, no locks) so the promotion behaviour
+unit-tests deterministically without a device, and the scheduler calls
+them with explicit state.
+
+The divergence trigger watches the SAME signal family as the adaptive
+stream controller (``stream/controller.py``): an exponential moving
+average of the per-step mean |Δdisparity| on the low-res grid.  A cheap
+tier that is converging produces a shrinking delta; a spike means the
+cheap tier's updates are thrashing on this pair (quantization noise
+feeding back through the correlation lookup), so the remaining iteration
+budget is better spent on the certified executables — the slot promotes
+EARLY and every remaining iteration runs fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__all__ = ["DIVERGENCE_DECAY", "promotion_kind", "should_promote",
+           "update_ema"]
+
+# EMA decay d: ema' = d * ema + (1 - d) * delta.  Same form as
+# stream/controller.update_ema; slightly faster than the controller's
+# default because a cascade's cheap leg is tens of iterations, not
+# hundreds of frames — the trigger must react within the leg.
+DIVERGENCE_DECAY = 0.6
+
+
+def update_ema(ema: Optional[float], delta: float,
+               decay: float = DIVERGENCE_DECAY) -> float:
+    """One EMA update of the per-step disparity delta; ``None`` seeds
+    the average with the first observation (no cold-start bias toward
+    zero — a zero seed would mask an immediately-divergent pair for
+    several boundaries)."""
+    if ema is None:
+        return float(delta)
+    return decay * float(ema) + (1.0 - decay) * float(delta)
+
+
+def should_promote(done_iters: int, cheap_iters: int,
+                   ema: Optional[float],
+                   threshold: Optional[float]) -> Tuple[bool, bool]:
+    """Whether a cascade slot hands off to the certified tier at this
+    boundary.  Returns ``(promote, early)``:
+
+    * scheduled promotion — the cheap leg's iterations are done
+      (``done_iters >= cheap_iters``; ``>`` only when the certified
+      batch was full at the scheduled boundary and the slot kept cheap-
+      stepping);
+    * early promotion — the divergence trigger fired: an EMA exists
+      (at least one boundary observed) and exceeds ``threshold``.
+      ``threshold`` None or <= 0 disables the trigger entirely.
+    """
+    if done_iters >= cheap_iters:
+        return True, False
+    if threshold is not None and threshold > 0.0 and ema is not None \
+            and ema > threshold:
+        return True, True
+    return False, False
+
+
+def promotion_kind(early: bool) -> str:
+    """The ``cascade_promotions_total{kind=}`` label for a promotion."""
+    return "early" if early else "scheduled"

@@ -1,0 +1,191 @@
+"""The cascade schedule grammar: ``"<cheap>:<iters>+fp32:<iters>"``.
+
+A schedule string is the cascade's identity everywhere — the request's
+``accuracy=cascade:<schedule>`` value, the certification-manifest key
+(eval/certify.py), the ``cascade_schedules_total`` metric label and the
+``/healthz`` listing all use the CANONICAL form produced by
+:meth:`CascadeSchedule.schedule`, so one cascade is one string.
+
+Grammar (version :data:`SCHEDULE_VERSION`):
+
+* legs are ``MODE:ITERS`` joined by ``+``, executed left to right;
+* a leg's mode token is a precision mode (``int8``/``bf16``/``fp32``,
+  ops/quant.MODES) or an accuracy-tier name (``turbo``/``fast``/
+  ``certified``, normalized through ops/quant.TIER_MODES);
+* version 1 allows exactly TWO legs — one cheap drafting leg and one
+  certifying leg — because the engine stages exactly one certified
+  correlation state alongside the cheap one (serve/engine.py
+  ``infer_cascade_prologue``); the parser accepts the general grammar so
+  a longer schedule fails validation with a version message, not a
+  syntax error;
+* the LAST leg must run the certified mode (``fp32``): a cascade's
+  contract is that the answer leaves the certified executables;
+* the first leg must NOT be ``fp32`` — that is not a cascade, it is the
+  monolithic certified path.
+
+Granularity: every leg's iteration count must be a positive multiple of
+the scheduler's ``iters_per_step`` (the handoff happens at a step
+boundary — ``validate_schedule``), and the total must fit ``max_iters``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Tuple
+
+__all__ = ["MODE_COST", "SCHEDULE_VERSION", "CascadeSchedule", "cheapest",
+           "parse_schedule", "validate_schedule"]
+
+SCHEDULE_VERSION = 1
+
+# The certified (final-leg) precision mode — the fp32 reference every
+# tier and cascade is certified against (ops/quant.TIER_MODES
+# ["certified"]; asserted against the vocabulary in parse_schedule, but
+# spelled here so importing the grammar never drags the numerics stack
+# in — config validation and the loadgen trace grammar parse schedules
+# in processes with no jax).
+CERT_MODE = "fp32"
+
+# The tier vocabulary, spelled locally for the same no-jax-import
+# reason as CERT_MODE (tests/test_cascade.py asserts these match
+# ops/quant.MODES / TIER_MODES, so drift fails tier-1).
+_MODES = ("fp32", "bf16", "int8")
+_TIER_MODES = {"certified": "fp32", "fast": "bf16", "turbo": "int8"}
+
+# Relative per-iteration cost weights used ONLY to rank certified
+# cascades when ``accuracy=certified`` resolves to the cheapest one
+# (serve/server.py).  Coarse by design: int8 runs the MXU's native int8
+# correlation pass, bf16 halves the multiply cost — the ranking is
+# stable under any weights that keep fp32 > bf16 > int8 > 0.
+MODE_COST = {"fp32": 1.0, "bf16": 0.5, "int8": 0.25}
+
+
+@dataclasses.dataclass(frozen=True)
+class CascadeSchedule:
+    """A parsed, canonicalized cascade schedule: ``legs`` of
+    ``(precision mode, iterations)`` executed left to right."""
+
+    legs: Tuple[Tuple[str, int], ...]
+
+    @property
+    def cheap_mode(self) -> str:
+        """Precision mode of the drafting (first) leg."""
+        return self.legs[0][0]
+
+    @property
+    def cert_mode(self) -> str:
+        """Precision mode of the certifying (last) leg — always fp32."""
+        return self.legs[-1][0]
+
+    @property
+    def cheap_iters(self) -> int:
+        """Iterations scheduled on the cheap leg before handoff."""
+        return self.legs[0][1]
+
+    @property
+    def cert_iters(self) -> int:
+        """Iterations scheduled on the certified leg (the K of K/total)."""
+        return self.legs[-1][1]
+
+    @property
+    def total_iters(self) -> int:
+        return sum(n for _, n in self.legs)
+
+    @property
+    def fp32_fraction(self) -> float:
+        """SCHEDULED fp32-iteration fraction (the divergence trigger can
+        raise the EXECUTED fraction — ``cascade_iterations_total``)."""
+        return self.cert_iters / self.total_iters
+
+    @property
+    def schedule(self) -> str:
+        """Canonical schedule string (the identity key everywhere)."""
+        return "+".join(f"{m}:{n}" for m, n in self.legs)
+
+    def cost(self) -> float:
+        """Relative cost of one scheduled pass (see :data:`MODE_COST`)."""
+        return sum(MODE_COST.get(m, 1.0) * n for m, n in self.legs)
+
+    def __str__(self) -> str:
+        return self.schedule
+
+
+def parse_schedule(text: str) -> CascadeSchedule:
+    """Parse + canonicalize a schedule string; raises ``ValueError`` with
+    the exact defect (the HTTP 400 / config-assert payload)."""
+    if not isinstance(text, str) or not text.strip():
+        raise ValueError("cascade schedule must be a non-empty string "
+                         "like 'int8:24+fp32:8'")
+    legs = []
+    for part in text.strip().split("+"):
+        bits = part.split(":")
+        if len(bits) != 2:
+            raise ValueError(
+                f"cascade leg {part!r} is not MODE:ITERS "
+                f"(schedule {text!r})")
+        mode, iters_txt = bits[0].strip(), bits[1].strip()
+        # Tier names normalize to their precision mode so
+        # "turbo:24+certified:8" and "int8:24+fp32:8" are ONE schedule.
+        mode = _TIER_MODES.get(mode, mode)
+        if mode not in _MODES:
+            raise ValueError(
+                f"cascade leg {part!r} names unknown mode/tier "
+                f"{bits[0].strip()!r} (modes {list(_MODES)}, tiers "
+                f"{sorted(_TIER_MODES)})")
+        try:
+            iters = int(iters_txt)
+        except ValueError:
+            raise ValueError(
+                f"cascade leg {part!r} has non-integer iterations "
+                f"(schedule {text!r})") from None
+        if iters < 1:
+            raise ValueError(
+                f"cascade leg {part!r} must run >= 1 iteration")
+        legs.append((mode, iters))
+    if len(legs) != 2:
+        raise ValueError(
+            f"cascade schedule {text!r} has {len(legs)} leg(s); grammar "
+            f"version {SCHEDULE_VERSION} takes exactly 2 "
+            "(cheap drafting leg + certifying fp32 leg)")
+    if legs[-1][0] != CERT_MODE:
+        raise ValueError(
+            f"cascade schedule {text!r} must END on the certified mode "
+            f"{CERT_MODE!r} — the answer leaves the certified "
+            "executables")
+    if legs[0][0] == CERT_MODE:
+        raise ValueError(
+            f"cascade schedule {text!r} starts on {CERT_MODE!r}: that is "
+            "the monolithic certified path, not a cascade")
+    return CascadeSchedule(tuple(legs))
+
+
+def validate_schedule(sched: CascadeSchedule, *,
+                      iters_per_step: Optional[int] = None,
+                      max_iters: Optional[int] = None) -> CascadeSchedule:
+    """Check a parsed schedule against the scheduler's granularity: the
+    handoff happens at a step boundary, so every leg must be a multiple
+    of ``iters_per_step``, and the total must fit ``max_iters``.  Returns
+    the schedule for chaining; raises ``ValueError``."""
+    if iters_per_step is not None:
+        for mode, iters in sched.legs:
+            if iters % iters_per_step:
+                raise ValueError(
+                    f"cascade leg {mode}:{iters} of {sched} is not a "
+                    f"multiple of iters_per_step {iters_per_step} — the "
+                    "tier handoff happens at a step boundary")
+    if max_iters is not None and sched.total_iters > max_iters:
+        raise ValueError(
+            f"cascade schedule {sched} totals {sched.total_iters} "
+            f"iterations > max_iters {max_iters}")
+    return sched
+
+
+def cheapest(schedules: Iterable[CascadeSchedule]
+             ) -> Optional[CascadeSchedule]:
+    """The cascade ``accuracy=certified`` resolves to: lowest scheduled
+    cost, canonical-string tie-break so resolution is deterministic
+    across processes.  None when no cascade is certified."""
+    pool = list(schedules)
+    if not pool:
+        return None
+    return min(pool, key=lambda s: (s.cost(), s.schedule))

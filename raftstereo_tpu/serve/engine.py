@@ -379,6 +379,56 @@ class BatchEngine:
             self._fns[key] = jax.jit(join)
         return self._fns[key]
 
+    def _cascade_prologue_fn(self, cheap_mode: str,
+                             cert_mode: str):  # guarded_by: _lock
+        """Compiled cascade phase 1: BOTH tiers' prologues over the same
+        images in one dispatch — ``(cheap carried state, staged certified
+        state)``.  Staging at the prologue (vs rebuilding at handoff) is
+        the builder decision documented in serve/cascade/handoff.py: one
+        extra fp32 encode + corr build per cascade join, certified corr
+        held in device memory for the cheap leg, and in exchange the
+        handoff itself is a cast+swap that never stalls the certified
+        batch behind an encode."""
+        key = ("cascade", "prologue", cheap_mode, cert_mode)
+        if key not in self._fns:
+            m_cheap = self._model_for(cheap_mode)
+            m_cert = self._model_for(cert_mode)
+
+            def fn(v, a, b, f, mc=m_cheap, mx=m_cert):
+                return (mc.forward_prologue(v, a, b, flow_init=f),
+                        mx.forward_prologue(v, a, b, flow_init=f))
+            self._fns[key] = jax.jit(fn)
+        return self._fns[key]
+
+    def _cascade_handoff_fn(self, cheap_mode: str,
+                            cert_mode: str):  # guarded_by: _lock
+        """Compiled tier handoff: the shared cast+swap expression
+        (serve/cascade/handoff.handoff_state — also what the certifier
+        compiles) followed by a lane gather, so promoted slots land at
+        their assigned slots in the certified batch in one dispatch."""
+        key = ("cascade", "handoff", cheap_mode, cert_mode)
+        if key not in self._fns:
+            from .cascade.handoff import handoff_state
+
+            def fn(s, stage, idx):
+                out = handoff_state(s, stage)
+                return jax.tree.map(lambda x: jnp.take(x, idx, axis=0),
+                                    out)
+            self._fns[key] = jax.jit(fn)
+        return self._fns[key]
+
+    def _cascade_delta_fn(self):  # guarded_by: _lock
+        """Compiled divergence signal: per-slot mean |Δdisparity| on the
+        low-res grid between consecutive boundaries — the EMA input of
+        the cascade promotion trigger (serve/cascade/policy.py).  The
+        body is mode-agnostic (disp is fp32 on every tier) but the cache
+        key carries both cascade modes, like the join."""
+        key = ("cascade", "delta")
+        if key not in self._fns:
+            self._fns[key] = jax.jit(
+                lambda a, b: jnp.mean(jnp.abs(a - b), axis=(1, 2, 3)))
+        return self._fns[key]
+
     def warmup(self, buckets=None, iters_list=None,
                modes: Optional[Sequence[str]] = None) -> List[Tuple]:
         """Compile the configured buckets before serving traffic.
@@ -745,19 +795,15 @@ class BatchEngine:
         }
         return out, miss
 
-    def infer_sched_prologue(self, pairs: Sequence[Tuple[np.ndarray,
-                                                         np.ndarray]],
-                             flow_inits: Sequence[Optional[np.ndarray]],
-                             slots: Sequence[int],
-                             mode: Optional[str] = None):
-        """Run the prologue for joining requests, each placed at its
-        assigned batch slot (remaining slots are zero images — dead
-        weight, exactly like batch padding rows).
-
-        ``flow_inits`` follows ``infer_stream_batch``: an optional padded
-        low-res warm-start per pair, None = cold (zeros).  Returns
-        ``(hw, state, included_compile)`` with ``state`` device-resident.
-        """
+    def _sched_assemble(self, pairs, flow_inits, slots):
+        """Shared join-group input assembly for the sched AND cascade
+        prologues: each joining pair placed at its assigned batch slot
+        (remaining slots are zero images — dead weight, exactly like
+        batch padding rows).  Host-side assembly, ONE transfer at
+        dispatch: out-of-jit ``.at[slot].set`` would copy the whole
+        (B, H, W, 3) batch buffer once per joiner (same rationale as
+        _pad_pairs).  Returns ``(hw, i1, i2, fi)`` and stamps the pad
+        timing window."""
         assert len(pairs) == len(flow_inits) == len(slots), (
             len(pairs), len(flow_inits), len(slots))
         assert pairs, "empty join group"
@@ -771,9 +817,6 @@ class BatchEngine:
             "mixed buckets in one join group: "
             f"{sorted({p.bucket_hw for p in padders})}")
         lh, lw = self.low_hw(hw)
-        # Host-side assembly, ONE transfer at dispatch: out-of-jit
-        # ``.at[slot].set`` would copy the whole (B, H, W, 3) batch
-        # buffer once per joiner (same rationale as _pad_pairs).
         i1 = np.zeros((bsz, hw[0], hw[1], self.input_channels), np.float32)
         i2 = np.zeros((bsz, hw[0], hw[1], self.input_channels), np.float32)
         fi = np.zeros((bsz, lh, lw, 1), np.float32)
@@ -791,6 +834,21 @@ class BatchEngine:
                     f"{(lh, lw)} (bucket {hw})")
                 fi[slot, :, :, 0] = init
         self._seg.pad = (t_pad0, time.perf_counter())
+        return hw, i1, i2, fi
+
+    def infer_sched_prologue(self, pairs: Sequence[Tuple[np.ndarray,
+                                                         np.ndarray]],
+                             flow_inits: Sequence[Optional[np.ndarray]],
+                             slots: Sequence[int],
+                             mode: Optional[str] = None):
+        """Run the prologue for joining requests, each placed at its
+        assigned batch slot.
+
+        ``flow_inits`` follows ``infer_stream_batch``: an optional padded
+        low-res warm-start per pair, None = cold (zeros).  Returns
+        ``(hw, state, included_compile)`` with ``state`` device-resident.
+        """
+        hw, i1, i2, fi = self._sched_assemble(pairs, flow_inits, slots)
         m = self._mode(mode)
         key = (hw[0], hw[1], 0, "sched_prologue", self.gru_backend,
                self.input_mode, m)
@@ -872,4 +930,192 @@ class BatchEngine:
                             time.perf_counter() - t0)
                 warmed.extend(self._sched_keys((bh, bw), iters_per_step,
                                                mode))
+        return warmed
+
+    # ------------------------------------------------- speculative cascades
+    #
+    # The cross-tier handoff executables behind serve/cascade/
+    # (docs/serving.md "Tier cascade"): a cascade slot drafts on a cheap
+    # tier's step executable and hands its carried state to the certified
+    # tier's for the last K iterations.  Four cascade-specific phases —
+    # dual prologue (cheap state + staged certified state), stage join,
+    # handoff (cast + corr swap + lane gather) and the divergence delta —
+    # under arity-8 keys (h, w, 0, phase, gru_backend, input_mode,
+    # cheap_mode, cert_mode): every cascade executable is keyed by BOTH
+    # precision modes (ints at 0-2, strings from 3 on, so the mixed-arity
+    # key set stays sortable for /healthz).  The cheap/certified step and
+    # epilogue executables are the UNMODIFIED per-mode sched phases — a
+    # cascade adds no new math to either tier's iteration loop, which is
+    # what keeps the single-tier paths bitwise-unchanged.
+
+    def _cascade_pair(self, cheap_mode: Optional[str],
+                      cert_mode: Optional[str]) -> Tuple[str, str]:
+        cm, xm = self._mode(cheap_mode), self._mode(cert_mode)
+        assert cm != xm, (
+            f"cascade needs two distinct precision modes, got {cm!r} "
+            "for both legs")
+        return cm, xm
+
+    def _cascade_keys(self, hw: Tuple[int, int],
+                      cheap_mode: Optional[str] = None,
+                      cert_mode: Optional[str] = None) -> List[Tuple]:
+        g = self.gru_backend
+        im = self.input_mode
+        cm, xm = self._cascade_pair(cheap_mode, cert_mode)
+        return [(hw[0], hw[1], 0, "cascade_prologue", g, im, cm, xm),
+                (hw[0], hw[1], 0, "cascade_stage_join", g, im, cm, xm),
+                (hw[0], hw[1], 0, "cascade_handoff", g, im, cm, xm),
+                (hw[0], hw[1], 0, "cascade_delta", g, im, cm, xm)]
+
+    def is_cascade_warm(self, hw: Tuple[int, int], iters_per_step: int,
+                        cheap_mode: Optional[str] = None,
+                        cert_mode: Optional[str] = None) -> bool:
+        """Whether a (bucket, cheap_mode -> cert_mode) cascade is fully
+        compiled: the four cascade phases AND both tiers' sched phase
+        executables (the cascade rides them for its steps/epilogue)."""
+        keys = self._cascade_keys(hw, cheap_mode, cert_mode)
+        with self._stats_lock:
+            warm = all(k in self._compiled for k in keys)
+        return (warm
+                and self.is_sched_warm(hw, iters_per_step, cheap_mode)
+                and self.is_sched_warm(hw, iters_per_step, cert_mode))
+
+    def infer_cascade_prologue(self, pairs: Sequence[Tuple[np.ndarray,
+                                                           np.ndarray]],
+                               flow_inits: Sequence[Optional[np.ndarray]],
+                               slots: Sequence[int],
+                               cheap_mode: Optional[str] = None,
+                               cert_mode: Optional[str] = None):
+        """Run BOTH tiers' prologues for joining cascade requests in one
+        dispatch; returns ``(hw, state, stage, included_compile)`` —
+        ``state`` is the cheap tier's carried state (EXACTLY what
+        ``infer_sched_prologue(mode=cheap_mode)`` returns, so the slot
+        joins the cheap tier's running batch indistinguishably) and
+        ``stage`` is the certified tier's staged state, device-resident
+        until the handoff swaps its corr in."""
+        hw, i1, i2, fi = self._sched_assemble(pairs, flow_inits, slots)
+        cm, xm = self._cascade_pair(cheap_mode, cert_mode)
+        key = (hw[0], hw[1], 0, "cascade_prologue", self.gru_backend,
+               self.input_mode, cm, xm)
+        (state, stage), miss = self._dispatch_state(
+            key, lambda: self._cascade_prologue_fn(cm, xm)(
+                self.variables, i1, i2, fi))
+        return hw, state, stage, miss
+
+    def infer_cascade_stage_join(self, hw: Tuple[int, int], running,
+                                 incoming, mask: np.ndarray,
+                                 cheap_mode: Optional[str] = None,
+                                 cert_mode: Optional[str] = None):
+        """Merge newly staged certified state into the running batch's
+        stage where ``mask`` (B,) is True — the side-car twin of
+        ``infer_sched_join`` (same tree-select body, cascade-keyed);
+        returns ``(stage, included_compile)``."""
+        with self._device_ctx():
+            mk = jnp.asarray(mask, bool)
+        assert mk.shape == (self.cfg.max_batch_size,), mk.shape
+        cm, xm = self._cascade_pair(cheap_mode, cert_mode)
+        key = (hw[0], hw[1], 0, "cascade_stage_join", self.gru_backend,
+               self.input_mode, cm, xm)
+        return self._dispatch_state(
+            key, lambda: self._sched_join_fn()(running, incoming, mk))
+
+    def infer_cascade_handoff(self, hw: Tuple[int, int], state, stage,
+                              slot_map: np.ndarray,
+                              cheap_mode: Optional[str] = None,
+                              cert_mode: Optional[str] = None):
+        """The tier handoff: assemble the certified-format carried state
+        (tier-independent leaves cast from the cheap ``state``, corr
+        swapped in from ``stage`` — serve/cascade/handoff.py) and gather
+        lanes so promoted slots land at their certified-batch slots.
+
+        ``slot_map`` is a (max_batch_size,) int array mapping TARGET
+        slot index -> SOURCE slot index (unpromoted target lanes may map
+        anywhere — their rows are dead weight the follow-up
+        ``infer_sched_join`` mask ignores).  Returns
+        ``(state, included_compile)`` with ``state`` device-resident in
+        the certified tier's trace signature."""
+        slot_map = np.asarray(slot_map, np.int32)
+        assert slot_map.shape == (self.cfg.max_batch_size,), slot_map.shape
+        with self._device_ctx():
+            idx = jnp.asarray(slot_map)
+        cm, xm = self._cascade_pair(cheap_mode, cert_mode)
+        key = (hw[0], hw[1], 0, "cascade_handoff", self.gru_backend,
+               self.input_mode, cm, xm)
+        return self._dispatch_state(
+            key, lambda: self._cascade_handoff_fn(cm, xm)(state, stage,
+                                                          idx))
+
+    def infer_cascade_delta(self, hw: Tuple[int, int], prev_disp, disp,
+                            cheap_mode: Optional[str] = None,
+                            cert_mode: Optional[str] = None):
+        """Per-slot mean |Δdisparity| between consecutive boundaries on
+        the low-res grid, fetched to host — the divergence trigger's EMA
+        input (serve/cascade/policy.py).  Returns ``((B,) float32,
+        included_compile)``."""
+        cm, xm = self._cascade_pair(cheap_mode, cert_mode)
+        key = (hw[0], hw[1], 0, "cascade_delta", self.gru_backend,
+               self.input_mode, cm, xm)
+        (deltas,), miss = self._dispatch(
+            key, lambda: [self._cascade_delta_fn()(prev_disp, disp)])
+        return deltas, miss
+
+    def warmup_cascade(self, buckets=None, iters_per_step: int = 1,
+                       schedules: Sequence[object] = ()) -> List[Tuple]:
+        """Compile every cascade executable — including the transition
+        pair — for the configured buckets before serving, so a cascade
+        request never stalls behind an XLA compile: both tiers' sched
+        phases (via ``warmup_sched``), the four cascade phases, AND one
+        certified step + epilogue driven from a handed-off state, so any
+        signature drift between the handoff output and the certified
+        trace retraces HERE, not under traffic (the retrace-budget-0
+        e2e in tests/test_cascade.py holds the engine to that).
+
+        ``schedules`` are CascadeSchedule objects or schedule strings;
+        distinct (cheap, certified) mode pairs are compiled once.
+        Returns the newly warmed keys."""
+        from .cascade.schedule import parse_schedule
+        buckets = list(buckets or self.cfg.buckets)
+        parsed = [s if hasattr(s, "legs") else parse_schedule(s)
+                  for s in schedules]
+        mode_pairs = sorted({(s.cheap_mode, s.cert_mode) for s in parsed})
+        bsz = self.cfg.max_batch_size
+        warmed: List[Tuple] = []
+        for cheap_mode, cert_mode in mode_pairs:
+            # The cascade rides both tiers' step/epilogue executables;
+            # warm them first (no-op for already-warm modes).
+            warmed.extend(self.warmup_sched(buckets=buckets,
+                                            iters_per_step=iters_per_step,
+                                            modes=[cheap_mode, cert_mode]))
+            for h, w in buckets:
+                bh, bw = self.bucket_of((h, w, self.input_channels))
+                if self.is_cascade_warm((bh, bw), iters_per_step,
+                                        cheap_mode, cert_mode):
+                    continue
+                zero = np.zeros((h, w, self.input_channels), np.float32)
+                t0 = time.perf_counter()
+                hw, state, stage, _ = self.infer_cascade_prologue(
+                    [(zero, zero)], [None], [0], cheap_mode=cheap_mode,
+                    cert_mode=cert_mode)
+                stage, _ = self.infer_cascade_stage_join(
+                    hw, stage, stage, np.zeros(bsz, bool),
+                    cheap_mode=cheap_mode, cert_mode=cert_mode)
+                self.infer_cascade_delta(
+                    hw, state["disp"], state["disp"],
+                    cheap_mode=cheap_mode, cert_mode=cert_mode)
+                state, _ = self.infer_cascade_handoff(
+                    hw, state, stage, np.zeros(bsz, np.int32),
+                    cheap_mode=cheap_mode, cert_mode=cert_mode)
+                # The transition pair: certified step + epilogue FROM the
+                # handoff output (cache hits when the handoff reproduces
+                # the certified trace signature — the design contract).
+                state, _ = self.infer_sched_step(hw, state, iters_per_step,
+                                                 mode=cert_mode)
+                self.infer_sched_epilogue(hw, state, mode=cert_mode)
+                logger.info(
+                    "cascade warmup: bucket %dx%d %s->%s "
+                    "iters_per_step=%d compiled in %.1fs", bh, bw,
+                    cheap_mode, cert_mode, iters_per_step,
+                    time.perf_counter() - t0)
+                warmed.extend(self._cascade_keys((bh, bw), cheap_mode,
+                                                 cert_mode))
         return warmed

@@ -335,6 +335,31 @@ class ServeMetrics:
             "sched_step_latency_seconds",
             "engine wall-clock per scheduler step (every occupied slot "
             "advances iters_per_step iterations), compile-free steps only")
+        # Speculative tier cascades (serve/cascade/, docs/serving.md
+        # "Tier cascade").
+        self.cascade_schedules = r.counter(
+            "cascade_schedules_total",
+            "completed cascade requests by canonical schedule string "
+            "(deadline-degraded cheap-phase exits are NOT counted: "
+            "their answer never reached the certified tier)",
+            labels=("schedule",))
+        self.cascade_promotions = r.counter(
+            "cascade_promotions_total",
+            "cheap-to-certified tier handoffs by kind: 'scheduled' at "
+            "the schedule's cheap-leg boundary, 'early' when the "
+            "divergence EMA crossed --cascade_divergence first",
+            labels=("kind",))
+        self.cascade_iterations = r.counter(
+            "cascade_iterations_total",
+            "GRU iterations executed for cascade slots by phase "
+            "(cheap/certified) — certified over the sum is the EXECUTED "
+            "fp32-iteration fraction the cascade is buying down",
+            labels=("phase",))
+        self.cascade_fp32_fraction = r.gauge(
+            "cascade_fp32_fraction",
+            "executed fp32-iteration fraction of the most recently "
+            "completed cascade request (scheduled fraction when no "
+            "early promotion fired)")
         # Spatial sharding (parallel/spatial.py, serve/spatial/,
         # docs/serving.md "Spatial sharding").
         self.spatial_shards = r.gauge(

@@ -17,7 +17,25 @@ Engine contract (``serve/engine.py``; tests substitute stubs):
 * ``infer_sched_prologue(pairs, flow_inits, slots) -> (hw, state, c)``;
 * ``infer_sched_join(hw, running, incoming, mask) -> (state, c)``;
 * ``infer_sched_step(hw, state, iters_per_step) -> (state, c)``;
-* ``infer_sched_epilogue(hw, state) -> (low, up, c)``.
+* ``infer_sched_epilogue(hw, state) -> (low, up, c)``;
+
+and, for speculative tier cascades (serve/cascade/, requests submitted
+with a ``CascadeSchedule``):
+
+* ``infer_cascade_prologue(pairs, flow_inits, slots, cheap_mode=...,
+  cert_mode=...) -> (hw, state, stage, c)``;
+* ``infer_cascade_stage_join(hw, running, incoming, mask, ...) ->
+  (stage, c)``;
+* ``infer_cascade_handoff(hw, state, stage, slot_map, ...) ->
+  (state, c)``;
+* ``infer_cascade_delta(hw, prev_disp, disp, ...) -> ((B,) floats, c)``.
+
+A cascade request drafts in the CHEAP leg's (bucket, mode) running
+batch — sharing slots with that tier's plain requests — and at its
+handoff boundary its slot LEAVES the cheap batch and JOINS the
+certified one, carried state handed across by the handoff executable.
+The handoff is a leave+join riding the existing per-(bucket, mode)
+batches, not a new scheduling concept.
 
 Correctness: per-bucket batch shape is FIXED, so joining/leaving changes
 slot occupancy, not math — a request scheduled iteratively is bitwise-
@@ -45,6 +63,7 @@ import numpy as np
 
 from ...config import SchedConfig, ServeConfig
 from ..batcher import Future, Overloaded, RequestTimedOut, ShuttingDown
+from ..cascade.policy import promotion_kind, should_promote, update_ema
 from .policy import (PRIORITIES, priority_class, queue_sort_key,
                      should_exit)
 
@@ -71,6 +90,13 @@ class SchedResult:
     # Which cluster replica answered (serve/cluster/dispatcher.py);
     # None on the single-engine path.
     replica: Optional[str] = None
+    # Canonical cascade schedule string when the request ran as a
+    # speculative tier cascade (serve/cascade/); None on single-tier
+    # paths.  ``promoted_early`` is True when the divergence trigger
+    # promoted the slot to the certified tier before its scheduled
+    # handoff boundary.
+    cascade: Optional[str] = None
+    promoted_early: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -91,12 +117,21 @@ class _QueueItem:
     # (ops/quant.py; None = the engine's default path).  Joins the
     # running-batch group: tiers never share carried state.
     mode: Optional[str] = None
+    # Cascade schedule (serve/cascade.CascadeSchedule) when the request
+    # runs as a speculative tier cascade; exclusive with ``mode`` (the
+    # schedule's legs carry the modes).
+    cascade: Optional[object] = None
 
     @property
     def group(self) -> Tuple:
         """Running-batch grouping key: one running batch per (bucket,
         precision mode) — slots of different tiers cannot share a state
-        pytree (different dtypes AND different numerics)."""
+        pytree (different dtypes AND different numerics).  A cascade
+        request starts in its CHEAP leg's group, riding the same running
+        batch as that tier's plain requests; the handoff moves its slot
+        to the certified group."""
+        if self.cascade is not None:
+            return (self.bucket, self.cascade.cheap_mode)
         return (self.bucket, self.mode)
 
 
@@ -108,6 +143,20 @@ class _Slot:
         self.padder = padder
         self.done_iters = 0
         self.compile_seen = compile_seen
+        # Cascade bookkeeping (worker-thread state, like everything
+        # else here): phase is "cheap" until the handoff, "certified"
+        # after; ``ema`` is the divergence trigger's per-slot EMA of the
+        # boundary disparity delta (serve/cascade/policy.py);
+        # ``leave_at`` is the iteration count the slot exits at, set at
+        # promotion (>= target_iters when the certified batch was full
+        # at the scheduled boundary and the certifying leg must still
+        # run whole).
+        self.schedule = item.cascade          # CascadeSchedule or None
+        self.phase = "cheap" if item.cascade is not None else None
+        self.ema: Optional[float] = None
+        self.promoted_early = False
+        self.promoted_at: Optional[int] = None  # done_iters at handoff
+        self.leave_at: Optional[int] = None
 
 
 class _RunningBatch:
@@ -122,12 +171,23 @@ class _RunningBatch:
         self.state = None          # device pytree, set at first join
         self.slots: List[Optional[_Slot]] = [None] * n_slots
         self.step_est_s = 0.0      # EMA of boundary latency (deadline est)
+        # Cascade side-car: the staged certified-tier state for this
+        # batch's cascade slots (engine.infer_cascade_prologue), None
+        # until the first cascade join.  Lanes of non-cascade slots are
+        # dead weight the handoff's slot_map never gathers.  Worker-
+        # thread-confined like ``state``.
+        self.cascade_stage = None
 
     def occupied(self) -> List[int]:
         return [i for i, s in enumerate(self.slots) if s is not None]
 
     def free(self) -> List[int]:
         return [i for i, s in enumerate(self.slots) if s is None]
+
+    def cascade_slots(self) -> List[int]:
+        """Occupied slots still drafting on the cheap leg of a cascade."""
+        return [i for i in self.occupied()
+                if self.slots[i].phase == "cheap"]
 
 
 class IterationScheduler:
@@ -225,18 +285,33 @@ class IterationScheduler:
                priority: Optional[str] = None,
                deadline_ms: Optional[float] = None,
                trace_id: Optional[str] = None,
-               mode: Optional[str] = None) -> Future:
+               mode: Optional[str] = None,
+               cascade=None) -> Future:
         """Enqueue one stereo pair; returns a ``Future`` resolving to a
         :class:`SchedResult`.
 
         ``iters`` may be ANY multiple of ``iters_per_step`` up to
         ``max_iters`` (default ``cfg.iters``) — the step executable is
         iteration-count-agnostic, so arbitrary targets cost no compile.
-        Raises ``ValueError`` on a bad target/priority (HTTP 400),
-        ``Overloaded`` beyond ``queue_limit`` (503), ``ShuttingDown``
-        after stop."""
+        ``cascade`` (a ``serve.cascade.CascadeSchedule``) runs the
+        request as a speculative tier cascade; the schedule fixes the
+        iteration budget, so ``iters`` must be None and ``mode`` is
+        carried by the schedule's legs.  Raises ``ValueError`` on a bad
+        target/priority (HTTP 400), ``Overloaded`` beyond
+        ``queue_limit`` (503), ``ShuttingDown`` after stop."""
         sc = self.sched_cfg
-        target = int(iters) if iters is not None else self.cfg.iters
+        if cascade is not None:
+            if iters is not None:
+                raise ValueError(
+                    f"iters is fixed by the cascade schedule {cascade} "
+                    "(omit it)")
+            if mode is not None:
+                raise ValueError(
+                    "accuracy mode is carried by the cascade schedule "
+                    f"{cascade} (omit it)")
+            target = cascade.total_iters
+        else:
+            target = int(iters) if iters is not None else self.cfg.iters
         if not 1 <= target <= sc.max_iters:
             raise ValueError(
                 f"iters {target} outside [1, {sc.max_iters}]")
@@ -265,7 +340,7 @@ class IterationScheduler:
             self._queue.append(_QueueItem(
                 image1, image2, flow_init, target, deadline_s, cls,
                 PRIORITIES[cls], fut, self._now(), self._seq, bucket,
-                trace_id, mode))
+                trace_id, mode, cascade))
             if self.metrics is not None:
                 self.metrics.sched_queue_depth.labels(
                     priority=PRIORITIES[cls]).add(1)
@@ -318,6 +393,7 @@ class IterationScheduler:
                 continue
             did_work = True
             self._step(rb)
+            self._promote(rb)
             self._leave(rb)
             if not rb.occupied():
                 del self._running[group]
@@ -381,7 +457,11 @@ class IterationScheduler:
     def _join(self, group: Tuple,
               items: List[_QueueItem]) -> None:
         """Prologue the joiners at their assigned slots and merge them
-        into the group's running batch."""
+        into the group's running batch.  Plain and cascade joiners run
+        as SEPARATE sub-joins: plain items go through the unmodified
+        single-tier prologue executable (part of the bitwise-unchanged
+        guarantee for non-cascade traffic), cascade items through the
+        dual prologue that also stages the certified tier's state."""
         bucket, mode = group
         rb = self._running.get(group)
         if rb is None:
@@ -389,10 +469,44 @@ class IterationScheduler:
                 bucket, self.cfg.max_batch_size, mode)
         slots = rb.free()[:len(items)]
         assert len(slots) == len(items), (slots, len(items))
+        assigned = list(zip(items, slots))
+        for is_cascade in (False, True):
+            sub = [(it, sl) for it, sl in assigned
+                   if (it.cascade is not None) == is_cascade]
+            if sub:
+                self._join_sub(rb, bucket, mode, sub)
+
+    def _join_sub(self, rb: _RunningBatch, bucket: Tuple[int, int],
+                  mode: Optional[str],
+                  sub: List[Tuple[_QueueItem, int]]) -> None:
+        items = [it for it, _ in sub]
+        slots = [sl for _, sl in sub]
+        cascading = items[0].cascade is not None
         try:
-            hw, incoming, miss = self.engine.infer_sched_prologue(
-                [(it.image1, it.image2) for it in items],
-                [it.flow_init for it in items], slots, mode=mode)
+            if cascading:
+                cert = items[0].cascade.cert_mode
+                # Grammar v1 pins the certifying leg to fp32, so every
+                # cascade in a cheap-tier group shares one cert mode.
+                assert all(it.cascade.cert_mode == cert for it in items)
+                hw, incoming, stage, miss = \
+                    self.engine.infer_cascade_prologue(
+                        [(it.image1, it.image2) for it in items],
+                        [it.flow_init for it in items], slots,
+                        cheap_mode=mode, cert_mode=cert)
+                if rb.cascade_stage is None:
+                    rb.cascade_stage = stage
+                else:
+                    mask = np.zeros(self.cfg.max_batch_size, bool)
+                    mask[slots] = True
+                    rb.cascade_stage, sj_miss = \
+                        self.engine.infer_cascade_stage_join(
+                            bucket, rb.cascade_stage, stage, mask,
+                            cheap_mode=mode, cert_mode=cert)
+                    miss = miss or sj_miss
+            else:
+                hw, incoming, miss = self.engine.infer_sched_prologue(
+                    [(it.image1, it.image2) for it in items],
+                    [it.flow_init for it in items], slots, mode=mode)
             assert hw == bucket, (hw, bucket)
             # Before the join dispatch overwrites it: the prologue's own
             # timing window, for the per-request sched_prologue spans.
@@ -412,7 +526,7 @@ class IterationScheduler:
                 it.future._resolve(exc=e)
             return
         now = self._now()
-        for it, slot in zip(items, slots):
+        for it, slot in sub:
             rb.slots[slot] = _Slot(it, self.engine.padder_of(
                 it.image1.shape), miss)
             if self.tracer is not None and it.trace_id is not None:
@@ -430,6 +544,15 @@ class IterationScheduler:
     def _step(self, rb: _RunningBatch) -> None:
         """Advance every occupied slot by one boundary."""
         ips = self.sched_cfg.iters_per_step
+        # Divergence trigger (serve/cascade/policy.py): hold the low-res
+        # disparity from BEFORE the boundary so the per-slot delta sees
+        # this step's update.  Armed only when the trigger threshold is
+        # set AND a cheap-phase cascade slot is live — plain batches
+        # never touch the state's leaves, keeping the opaque-pytree
+        # contract for non-cascade engines/stubs.
+        threshold = self.cfg.cascade_divergence
+        watch = rb.cascade_slots() if threshold > 0 else []
+        prev_disp = rb.state["disp"] if watch else None
         t0 = self._now()
         try:
             rb.state, miss = self.engine.infer_sched_step(rb.hw, rb.state,
@@ -448,6 +571,18 @@ class IterationScheduler:
         if not miss:
             rb.step_est_s = (dt if rb.step_est_s == 0.0
                              else 0.7 * rb.step_est_s + 0.3 * dt)
+        if watch:
+            try:
+                deltas, d_miss = self.engine.infer_cascade_delta(
+                    rb.hw, prev_disp, rb.state["disp"],
+                    cheap_mode=rb.mode,
+                    cert_mode=rb.slots[watch[0]].schedule.cert_mode)
+                miss = miss or d_miss
+                for i in watch:
+                    s = rb.slots[i]
+                    s.ema = update_ema(s.ema, float(deltas[i]))
+            except Exception:  # trigger idles; scheduled handoff stands
+                logger.exception("cascade divergence delta failed")
         if self.metrics is not None:
             self.metrics.sched_steps.inc()
             if not miss:
@@ -456,11 +591,100 @@ class IterationScheduler:
             s = rb.slots[i]
             s.done_iters += ips
             s.compile_seen = s.compile_seen or miss
+            if self.metrics is not None and s.schedule is not None:
+                self.metrics.cascade_iterations.labels(
+                    phase=s.phase).inc(ips)
             if self.tracer is not None and s.item.trace_id is not None:
                 self.tracer.record(
                     "iteration", t0, t0 + dt, s.item.trace_id,
                     attrs={"i": s.done_iters, "iters_per_step": ips,
                            "compile": miss})
+
+    def _promote(self, rb: _RunningBatch) -> None:
+        """Hand cheap-phase cascade slots whose boundary has come over to
+        the certified tier: scheduled promotions at the schedule's
+        cheap-leg boundary, early ones when the divergence EMA spikes
+        past ``cfg.cascade_divergence``.  A promoted slot leaves this
+        batch and joins the certified (bucket, mode) running batch — its
+        carried state crosses tiers through the engine's handoff
+        executable, then merges like any other joiner.  A full certified
+        batch defers the handoff to the next boundary (``leave_at``
+        still guarantees the full certifying leg runs)."""
+        watch = rb.cascade_slots()
+        if not watch:
+            return
+        threshold = self.cfg.cascade_divergence
+        ready: List[Tuple[int, bool]] = []
+        for i in watch:
+            s = rb.slots[i]
+            promote, early = should_promote(
+                s.done_iters, s.schedule.cheap_iters, s.ema,
+                threshold if threshold > 0 else None)
+            if promote:
+                ready.append((i, early))
+        if not ready:
+            return
+        cert = rb.slots[ready[0][0]].schedule.cert_mode
+        # The certified group uses the same mode normalization as the
+        # server's tier resolution: the engine-default mode runs under
+        # the bare (bucket, None) group, so promoted slots share the
+        # default fp32 running batch with plain certified traffic.
+        default = getattr(self.engine, "default_mode", None)
+        tgt_group = (rb.hw, None if cert == default else cert)
+        tgt = self._running.get(tgt_group)
+        if tgt is None:
+            tgt = self._running[tgt_group] = _RunningBatch(
+                rb.hw, self.cfg.max_batch_size, tgt_group[1])
+        free = tgt.free()
+        if not free:
+            return
+        moves = list(zip([i for i, _ in ready], free))  # (src, dst)
+        slot_map = np.zeros(self.cfg.max_batch_size, np.int32)
+        mask = np.zeros(self.cfg.max_batch_size, bool)
+        for src, dst in moves:
+            slot_map[dst] = src
+            mask[dst] = True
+        t0 = self._now()
+        try:
+            handed, miss = self.engine.infer_cascade_handoff(
+                rb.hw, rb.state, rb.cascade_stage, slot_map,
+                cheap_mode=rb.mode, cert_mode=cert)
+            if tgt.state is None:
+                tgt.state = handed
+            else:
+                tgt.state, j_miss = self.engine.infer_sched_join(
+                    rb.hw, tgt.state, handed, mask, mode=tgt.mode)
+                miss = miss or j_miss
+        except Exception as e:  # fail the promoters, keep both batches
+            if self.metrics is not None:
+                self.metrics.errors.inc(len(moves))
+            for src, _ in moves:
+                rb.slots[src].item.future._resolve(exc=e)
+                rb.slots[src] = None
+            return
+        now = self._now()
+        early_by_src = dict(ready)
+        for src, dst in moves:
+            s = rb.slots[src]
+            rb.slots[src] = None
+            tgt.slots[dst] = s
+            s.phase = "certified"
+            s.promoted_early = early_by_src[src]
+            s.promoted_at = s.done_iters
+            s.compile_seen = s.compile_seen or miss
+            # Early promotion runs ALL remaining iterations certified; a
+            # batch-full-delayed handoff still runs the whole certifying
+            # leg, even past the request's nominal target.
+            s.leave_at = max(s.item.target_iters,
+                             s.done_iters + s.schedule.cert_iters)
+            if self.metrics is not None:
+                self.metrics.cascade_promotions.labels(
+                    kind=promotion_kind(s.promoted_early)).inc()
+            if self.tracer is not None and s.item.trace_id is not None:
+                self.tracer.record(
+                    "cascade_handoff", t0, now, s.item.trace_id,
+                    attrs={"kind": promotion_kind(s.promoted_early),
+                           "iters": s.done_iters, "compile": miss})
 
     def _leave(self, rb: _RunningBatch) -> None:
         """Release every slot whose target is reached or whose deadline
@@ -469,9 +693,18 @@ class IterationScheduler:
         leavers = []
         for i in rb.occupied():
             s = rb.slots[i]
+            # A cascade slot's exit target is ``leave_at`` (set at its
+            # handoff); while still cheap-phase it has NO finish target —
+            # only the deadline takes it out, as a degraded UNcertified
+            # anytime answer (a full certified batch can otherwise delay
+            # the handoff past the nominal target).
+            target = (s.leave_at if s.leave_at is not None
+                      else s.item.target_iters)
             leave, early = should_exit(
-                s.done_iters, s.item.target_iters, s.item.t_enqueue,
+                s.done_iters, target, s.item.t_enqueue,
                 s.item.deadline_s, now, rb.step_est_s)
+            if s.phase == "cheap" and not early:
+                continue
             if leave:
                 leavers.append((i, early))
         if not leavers:
@@ -508,12 +741,25 @@ class IterationScheduler:
                     self.metrics.sched_early_exits.inc()
                 self.metrics.responses.inc()
                 self.metrics.latency.observe(latency)
+                if s.schedule is not None and s.phase == "certified":
+                    # A completed cascade (deadline-degraded cheap-phase
+                    # exits don't count: their answer never certified).
+                    self.metrics.cascade_schedules.labels(
+                        schedule=s.schedule.schedule).inc()
+                    if s.done_iters:
+                        self.metrics.cascade_fp32_fraction.set(round(
+                            (s.done_iters - (s.promoted_at or 0))
+                            / s.done_iters, 4))
             it.future._resolve(value=SchedResult(
                 disparity=disp, disp_low=disp_low, iters=s.done_iters,
                 target_iters=it.target_iters, degraded=early,
                 priority=it.priority, batch_slots=n_occupied,
                 latency_s=latency,
-                included_compile=s.compile_seen or miss))
+                included_compile=s.compile_seen or miss,
+                cascade=(s.schedule.schedule if s.schedule is not None
+                         else None),
+                promoted_early=(s.promoted_early
+                                if s.schedule is not None else None)))
             rb.slots[i] = None
 
     def _update_stats(self) -> None:
@@ -533,6 +779,12 @@ class IterationScheduler:
                 "occupancy": round(n / self.cfg.max_batch_size, 4),
                 "step_est_ms": round(rb.step_est_s * 1e3, 3),
             }
+            # Conditional key keeps the historical schema for
+            # cascade-free servers (/healthz consumers).
+            nc = sum(1 for i in rb.occupied()
+                     if rb.slots[i].schedule is not None)
+            if nc:
+                buckets[name]["cascade_slots"] = nc
         with self._cv:
             self._stats = {"active_slots": total, "buckets": buckets}
         if self.metrics is not None:

@@ -222,6 +222,29 @@ def _response_prefs(obj) -> Dict:
     return prefs
 
 
+def _resolve_cascade(srv: "StereoServer", text: str):
+    """Resolve an explicit ``accuracy=cascade:<schedule>`` request to an
+    advertised ``CascadeSchedule``.  Raises ``ValueError`` (the caller's
+    clean 400) on a grammar defect or an unadvertised/uncertified
+    schedule — the message names the certification manifest so the
+    operator knows exactly which gate refused it."""
+    from .cascade.schedule import parse_schedule
+
+    try:
+        canonical = parse_schedule(text).schedule
+    except ValueError as e:
+        raise ValueError(f"bad cascade schedule: {e}") from None
+    sched = srv.cascades.get(canonical)
+    if sched is None:
+        reason = srv.cascade_reasons.get(
+            canonical, "schedule not offered by this server (--cascades)")
+        manifest = srv.config.cert_manifest or "none configured"
+        raise ValueError(
+            f"cascade {canonical!r} not advertised: {reason} "
+            f"(certification manifest: {manifest})")
+    return sched
+
+
 def _outcome(code: int, obj: Dict) -> str:
     """Label value for ``serve_requests_total{outcome=}``."""
     if code == 200:
@@ -345,6 +368,12 @@ class _Handler(JsonRequestHandler):
                     "advertised": {t: srv.tiers[t]
                                    for t in sorted(srv.tiers)},
                     "refused": dict(srv.tier_reasons),
+                }
+            if srv.cascades or srv.cascade_reasons:
+                health["cascade"] = {
+                    "advertised": sorted(srv.cascades),
+                    "refused": dict(srv.cascade_reasons),
+                    "divergence": srv.config.cascade_divergence,
                 }
             if srv.cluster is not None:
                 health["cluster"] = srv.cluster.stats()
@@ -682,6 +711,7 @@ class _Handler(JsonRequestHandler):
         """Validation + dispatch of one admitted (gate-passed, decoded,
         in-flight-counted) /predict request."""
         mode = None
+        cascade = None
         use_spatial = False
         try:
             # Channel count follows the model's input mode (sl/,
@@ -718,23 +748,43 @@ class _Handler(JsonRequestHandler):
                 # ADVERTISED tiers resolve — a tier the certification
                 # manifest refused (or a server without tiers) answers
                 # with the recorded reason, never a silently-degraded
-                # result or an unwarmed compile.
+                # result or an unwarmed compile.  Cascades resolve
+                # first: explicit "cascade:<schedule>" requests, and
+                # "certified" rides the cheapest certified cascade when
+                # one is offered (its answer still leaves the fp32
+                # executables — that is the cascade contract).
                 accuracy = str(accuracy)
-                if accuracy not in srv.tiers:
-                    reason = srv.tier_reasons.get(
-                        accuracy, "tier not offered by this server "
-                                  "(--tiers)")
+                if accuracy.startswith("cascade:"):
+                    cascade = _resolve_cascade(
+                        srv, accuracy[len("cascade:"):])
+                elif accuracy == "certified" and srv.cascades:
+                    from .cascade.schedule import cheapest
+
+                    cascade = cheapest(srv.cascades.values())
+                if cascade is None:
+                    if accuracy not in srv.tiers:
+                        reason = srv.tier_reasons.get(
+                            accuracy, "tier not offered by this server "
+                                      "(--tiers)")
+                        raise ValueError(
+                            f"accuracy tier {accuracy!r} not advertised: "
+                            f"{reason}")
+                    mode = srv.tiers[accuracy]
+                    if mode == srv.engine.default_mode:
+                        # The tier IS the default path's program (e.g.
+                        # "certified" on an fp32 server): normalize to
+                        # None so the batcher/scheduler group it WITH
+                        # default traffic — same executable, shared
+                        # batches, one running state per bucket.
+                        mode = None
+                elif iters is not None:
                     raise ValueError(
-                        f"accuracy tier {accuracy!r} not advertised: "
-                        f"{reason}")
-                mode = srv.tiers[accuracy]
-                if mode == srv.engine.default_mode:
-                    # The tier IS the default path's program (e.g.
-                    # "certified" on an fp32 server): normalize to None
-                    # so the batcher/scheduler group it WITH default
-                    # traffic — same executable, shared batches, one
-                    # running state per bucket.
-                    mode = None
+                        f"iters is fixed by the cascade schedule "
+                        f"{cascade} (omit it)")
+                elif session_id is not None:
+                    raise ValueError(
+                        "session frames cannot run as cascades (v1): "
+                        "the warm-start state is single-tier")
             if srv.scheduler is None and (deadline_ms is not None
                                           or priority is not None):
                 raise ValueError(
@@ -816,7 +866,16 @@ class _Handler(JsonRequestHandler):
                 # queued request behind it.
                 hw = srv.engine.bucket_of(left.shape)
                 if srv.scheduler is not None:
-                    if not srv.engine.is_sched_warm(
+                    if cascade is not None:
+                        if not srv.engine.is_cascade_warm(
+                                hw, srv.config.sched.iters_per_step,
+                                cheap_mode=cascade.cheap_mode,
+                                cert_mode=cascade.cert_mode):
+                            raise ValueError(
+                                f"shape {tuple(left.shape[:2])} -> "
+                                f"bucket {hw} not cascade-warmed; "
+                                f"configure it in --buckets")
+                    elif not srv.engine.is_sched_warm(
                             hw, srv.config.sched.iters_per_step,
                             mode=mode):
                         raise ValueError(
@@ -914,8 +973,13 @@ class _Handler(JsonRequestHandler):
         # and discards the result.
         hw = srv.engine.bucket_of(left.shape)
         if srv.scheduler is not None:
-            warm = srv.engine.is_sched_warm(
-                hw, srv.config.sched.iters_per_step, mode=mode)
+            ips = srv.config.sched.iters_per_step
+            if cascade is not None:
+                warm = srv.engine.is_cascade_warm(
+                    hw, ips, cheap_mode=cascade.cheap_mode,
+                    cert_mode=cascade.cert_mode)
+            else:
+                warm = srv.engine.is_sched_warm(hw, ips, mode=mode)
         else:
             levels = ([iters] if iters is not None
                       else [srv.config.iters, srv.config.degraded_iters])
@@ -924,9 +988,16 @@ class _Handler(JsonRequestHandler):
         slack = 60.0 if warm else 600.0
         try:
             if srv.scheduler is not None:
-                fut = srv.scheduler.submit(
-                    left, right, iters=iters, priority=priority,
-                    deadline_ms=deadline_ms, trace_id=rid, mode=mode)
+                kwargs = dict(iters=iters, priority=priority,
+                              deadline_ms=deadline_ms, trace_id=rid,
+                              mode=mode)
+                if cascade is not None:
+                    # Keyword only when set: in cluster mode the
+                    # dispatcher fills the scheduler slot and predates
+                    # the cascade contract (cascades are refused there,
+                    # so this branch never fires against it).
+                    kwargs["cascade"] = cascade
+                fut = srv.scheduler.submit(left, right, **kwargs)
             else:
                 fut = srv.batcher.submit(left, right, iters,
                                          trace_id=rid, mode=mode)
@@ -967,6 +1038,9 @@ class _Handler(JsonRequestHandler):
                     "degraded": res.degraded, "priority": res.priority,
                     "batch_slots": res.batch_slots,
                     "latency_ms": round(res.latency_s * 1e3, 3)}
+            if getattr(res, "cascade", None) is not None:
+                meta["cascade"] = res.cascade
+                meta["promoted_early"] = res.promoted_early
         else:
             meta = {"iters": res.iters, "degraded": res.degraded,
                     "batch_size": res.batch_size,
@@ -1049,6 +1123,8 @@ class StereoServer(ThreadingHTTPServer):
                  cluster=None, start_ready: bool = True,
                  tiers: Optional[Dict[str, str]] = None,
                  tier_reasons: Optional[Dict[str, str]] = None,
+                 cascades: Optional[Dict[str, object]] = None,
+                 cascade_reasons: Optional[Dict[str, str]] = None,
                  fault_plan: Optional[FaultPlan] = None):
         assert (batcher is None) != (scheduler is None), (
             "exactly one of batcher (monolithic dispatch) or scheduler "
@@ -1061,6 +1137,12 @@ class StereoServer(ThreadingHTTPServer):
         # clean 400, and no tier executables are ever compiled.
         self.tiers = dict(tiers or {})
         self.tier_reasons = dict(tier_reasons or {})
+        # Advertised speculative tier cascades (canonical schedule string
+        # -> CascadeSchedule) and refusal reasons, the cascade twin of
+        # the tier tables above (eval/certify.resolve_cascades;
+        # docs/serving.md "Tier cascade").
+        self.cascades = dict(cascades or {})
+        self.cascade_reasons = dict(cascade_reasons or {})
         self._engine = engine
         self.batcher = batcher
         self.scheduler = scheduler
@@ -1307,6 +1389,24 @@ def build_server(model, variables, config: ServeConfig,
             base = ("fp32" if model is None
                     else default_mode(model.config))
             warm_modes = [base] + sorted(set(tiers.values()) - {base})
+    # Speculative tier cascades: every schedule must certify — resolved
+    # against the same manifest, refused with a recorded reason
+    # (eval/certify.resolve_cascades, docs/serving.md "Tier cascade").
+    cascades: Dict[str, object] = {}
+    cascade_reasons: Dict[str, str] = {}
+    if config.cascades:
+        if config.cluster is not None:
+            # v1 limitation: the cluster dispatcher's submit contract
+            # predates cascades; a cascade request in cluster mode is a
+            # clean 400 with this reason, never a crash mid-dispatch.
+            cascade_reasons = {s: "cascades are single-engine in v1 "
+                                  "(not offered in cluster mode)"
+                               for s in config.cascades}
+        else:
+            from ..eval.certify import resolve_cascades
+
+            cascades, cascade_reasons = resolve_cascades(
+                config, model.config if model is not None else None)
     cluster = None
     stream = None
     if config.cluster is not None:
@@ -1356,6 +1456,14 @@ def build_server(model, variables, config: ServeConfig,
                     engine.warmup_sched(
                         iters_per_step=config.sched.iters_per_step,
                         modes=warm_modes)
+                    if cascades:
+                        # Both legs' sched phases, the four cascade
+                        # executables AND the handoff transition pair —
+                        # a cascade request never compiles under traffic
+                        # (the retrace-budget-0 e2e holds this).
+                        engine.warmup_cascade(
+                            iters_per_step=config.sched.iters_per_step,
+                            schedules=list(cascades.values()))
             else:
                 if config.warmup:
                     engine.warmup(modes=warm_modes)
@@ -1375,6 +1483,8 @@ def build_server(model, variables, config: ServeConfig,
                           tracer=tracer, scheduler=scheduler,
                           cluster=cluster, start_ready=False,
                           tiers=tiers, tier_reasons=tier_reasons,
+                          cascades=cascades,
+                          cascade_reasons=cascade_reasons,
                           fault_plan=fault_plan)
     if config.stream is not None and config.stream.tier is not None:
         from ..stream.tier import TierClient, TierPublisher

@@ -107,3 +107,25 @@ class Engine:
                 if key in self._compiled:   # shards missing: RSA401
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_cascade_handoff(self, state, stage, cheap_mode, cert_mode):
+        # Dual-mode cascade executable (serve/cascade/): a key carrying
+        # only the cheap mode hits the wrong (cheap, certified) pair's
+        # handoff program and silently casts into the wrong dtype tree.
+        h, w = 64, 96
+        key = (h, w, 0, "cascade_handoff", "xla", cheap_mode)
+        return self._dispatch(key, lambda: (state, cert_mode))  # RSA401
+
+    def warmup_cascade_pairs(self, buckets, cheap_mode, cert_mode):
+        for h, w in buckets:
+            key = (h, w, 0, "cascade_prologue", "xla", cheap_mode)
+            if key in self._compiled:   # cert_mode missing: RSA401
+                continue
+            self._dispatch(key, lambda: None)
+
+    def infer_cascade_resolved(self, pairs, iters, schedule):
+        # Schedule-string selector (serve/cascade/schedule.py): the
+        # canonical schedule never reaches the key.
+        h, w = 64, 96
+        key = (h, w, iters, "xla")
+        return self._dispatch(key, lambda: (pairs, schedule))  # RSA401

@@ -122,3 +122,29 @@ class Engine:
                 if key in self._compiled:
                     continue
                 self._dispatch(key, lambda: None)
+
+    def infer_cascade_handoff(self, state, stage, cheap_mode, cert_mode):
+        # Dual-mode cascade executable (serve/cascade/, serve/engine.py):
+        # BOTH precision modes join the key — each (cheap, certified)
+        # pair compiles a distinct handoff program, and the token match
+        # demands cheap_mode and cert_mode independently.
+        h, w = 64, 96
+        key = (h, w, 0, "cascade_handoff", "xla", cheap_mode, cert_mode)
+        return self._dispatch(key, lambda: (state, stage))
+
+    def warmup_cascade_pairs(self, buckets, cheap_mode, cert_mode):
+        for h, w in buckets:
+            key = (h, w, 0, "cascade_prologue", "xla", cheap_mode,
+                   cert_mode)
+            if key in self._compiled:
+                continue
+            self._dispatch(key, lambda: None)
+
+    def infer_cascade_resolved(self, pairs, iters, schedule):
+        # Schedule-string selector (serve/cascade/schedule.py): a
+        # resolver keyed by the canonical schedule carries it to the key
+        # transitively through the canonicalizing assignment.
+        h, w = 64, 96
+        canonical = schedule
+        key = (h, w, iters, "xla", canonical)
+        return self._dispatch(key, lambda: pairs)

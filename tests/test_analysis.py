@@ -97,7 +97,8 @@ class TestCacheKeys:
             ("RSA401", 30), ("RSA401", 35), ("RSA401", 44),
             ("RSA401", 50), ("RSA401", 57), ("RSA401", 62),
             ("RSA401", 71), ("RSA401", 77), ("RSA401", 86),
-            ("RSA401", 92), ("RSA401", 101), ("RSA401", 107)]
+            ("RSA401", 92), ("RSA401", 101), ("RSA401", 107),
+            ("RSA401", 117), ("RSA401", 122), ("RSA401", 131)]
         assert "precision" in findings[0].message
         assert "mode" in findings[2].message
         # Kernel-backend selectors are key-relevant too: an infer call
@@ -126,6 +127,13 @@ class TestCacheKeys:
         # call and a warmup ladder whose keys drop input_mode.
         assert "input_mode" in findings[11].message
         assert "input_mode" in findings[12].message
+        # Dual-mode cascade executables (serve/cascade/): keys carrying
+        # only cheap_mode must still be flagged for the missing
+        # cert_mode — both modes are demanded independently — and a
+        # schedule-string resolver must carry the schedule.
+        assert "cert_mode" in findings[15].message
+        assert "cert_mode" in findings[16].message
+        assert "schedule" in findings[17].message
 
     def test_good_fixture_is_clean(self):
         # Includes the phase-executable shapes: prologue (no key-relevant

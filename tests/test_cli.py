@@ -41,6 +41,32 @@ class TestHelpRegression:
         assert ei.value.code == 0
         assert "usage" in capsys.readouterr().out.lower()
 
+    def test_certify_cascade_verb_help(self, capsys):
+        # The cascade verb rides in front of certify's historical
+        # flag-only parser (docs/serving.md "Tier cascade"); its --help
+        # must wire up independently of the flag form above.
+        from raftstereo_tpu.cli import certify
+
+        with pytest.raises(SystemExit) as ei:
+            certify.main(["cascade", "--help"])
+        assert ei.value.code == 0
+        out = capsys.readouterr().out
+        assert "--schedules" in out and "--cascade_bound" in out
+        # The budget is the schedule's own — the flag (rendered by
+        # argparse as "--cert_iters CERT_ITERS") is not defined here;
+        # the prose in --schedules' help may still NAME it.
+        assert "--cert_iters CERT_ITERS" not in out
+
+    def test_serve_help_lists_cascade_flags(self, capsys):
+        import importlib
+
+        mod = importlib.import_module("raftstereo_tpu.cli.serve")
+        with pytest.raises(SystemExit) as ei:
+            mod.main(["--help"])
+        assert ei.value.code == 0
+        out = capsys.readouterr().out
+        assert "--cascades" in out and "--cascade_divergence" in out
+
 
 class TestViz:
     def test_jet_endpoints(self):
