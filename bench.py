@@ -1579,7 +1579,120 @@ def measure_torch_baseline(height: int, width: int, batch: int, iters: int,
     return batch * reps / dt
 
 
-def main() -> None:
+_LEDGER_FORMAT = 1
+
+
+def collect_perf_ledger(root: str = REPO) -> dict:
+    """Collate every committed perf artifact into one versioned ledger.
+
+    The repo accumulates one-off bench records per growth round
+    (``BENCH_r*.json``, ``MULTICHIP_r*.json``, ``BENCH_SESSION_r*.json``,
+    ``BENCH_SLO_*``/``BENCH_CASCADE_*`` and the torch-CPU
+    ``BENCH_BASELINE.json``) with per-mode schemas; this flattens them
+    into a single ``entries`` list in the one shape the trajectory table
+    in docs/perf_notes_r08.md (and any later tooling) reads:
+    ``{source, round, mode, metric, value, unit, ...extras}``.
+    Collation only — nothing is measured, re-run, or overwritten; the
+    output is deterministic for a given artifact set (sorted by source
+    filename, then in-file order).
+    """
+    import glob
+    import re
+
+    entries = []
+
+    def _round_of(fname: str):
+        m = re.search(r"_r(\d+)\.json$", fname)
+        return int(m.group(1)) if m else None
+
+    def _entry(source, mode, rec, extras=()):
+        e = {
+            "source": source,
+            "round": _round_of(source),
+            "mode": mode,
+            "metric": rec.get("metric"),
+            "value": rec.get("value"),
+            "unit": rec.get("unit"),
+        }
+        for k in extras:
+            if k in rec:
+                e[k] = rec[k]
+        return e
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        with open(path) as f:
+            doc = json.load(f)
+        source = os.path.basename(path)
+        parsed = doc.get("parsed") or {}
+        if parsed.get("metric") is not None:
+            entries.append(_entry(
+                source, "headline", parsed,
+                extras=("vs_baseline", "mfu_vs_measured_peak",
+                        "model_tflops", "measured_peak_tflops")))
+
+    for path in sorted(glob.glob(os.path.join(root,
+                                              "BENCH_SESSION_r*.json"))):
+        with open(path) as f:
+            doc = json.load(f)
+        source = os.path.basename(path)
+        for cfg in doc.get("configs", ()):
+            if cfg.get("metric") is None:
+                continue
+            entries.append(_entry(
+                source, "session", cfg,
+                extras=("vs_baseline", "config",
+                        "mfu_vs_measured_peak")))
+
+    for name, mode in (("BENCH_SLO_*.json", "slo"),
+                       ("BENCH_CASCADE_*.json", "cascade")):
+        for path in sorted(glob.glob(os.path.join(root, name))):
+            with open(path) as f:
+                doc = json.load(f)
+            source = os.path.basename(path)
+            if doc.get("metric") is None:
+                continue
+            entries.append(_entry(
+                source, mode, doc,
+                extras=("vs_baseline", "replicas", "slo_pass",
+                        "schedule", "total_iters", "epe_gap")))
+
+    for path in sorted(glob.glob(os.path.join(root,
+                                              "MULTICHIP_r*.json"))):
+        with open(path) as f:
+            doc = json.load(f)
+        source = os.path.basename(path)
+        entries.append({
+            "source": source,
+            "round": _round_of(source),
+            "mode": "multichip",
+            "metric": "multichip dryrun devices",
+            "value": doc.get("n_devices"),
+            "unit": "devices",
+            "ok": doc.get("ok"),
+            "skipped": doc.get("skipped"),
+        })
+
+    base_path = os.path.join(root, "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            doc = json.load(f)
+        entries.append({
+            "source": "BENCH_BASELINE.json",
+            "round": None,
+            "mode": "baseline",
+            "metric": "torch-cpu reference, "
+                      + doc.get("config", "flagship config"),
+            "value": doc.get("pairs_per_sec"),
+            "unit": "pairs/sec",
+        })
+
+    return {"ledger_format": _LEDGER_FORMAT,
+            "generated_by": "bench.py --ledger",
+            "n_entries": len(entries),
+            "entries": entries}
+
+
+def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--height", type=int, default=None,
                    help="image height (default 540; 4000 with --tiled)")
@@ -1742,7 +1855,28 @@ def main() -> None:
                    help="with --data: measure the mitigated host pipeline "
                         "(photometric jitter + eraser moved on-device, "
                         "host does decode + spatial aug only)")
-    args = p.parse_args()
+    p.add_argument("--ledger", action="store_true",
+                   help="collate the committed BENCH_*/MULTICHIP_* "
+                        "artifacts into PERF_LEDGER.json and exit "
+                        "(pure collation: measures nothing, needs no "
+                        "accelerator)")
+    p.add_argument("--ledger_out", default=None, metavar="PATH",
+                   help="with --ledger: write the ledger here instead of "
+                        "<repo>/PERF_LEDGER.json")
+    args = p.parse_args(argv)
+
+    if args.ledger:
+        # Offline collation — runs before (and independent of) the
+        # static-analysis gate and any jax import.
+        ledger = collect_perf_ledger()
+        out = args.ledger_out or os.path.join(REPO, "PERF_LEDGER.json")
+        with open(out, "w") as f:
+            json.dump(ledger, f, indent=1)
+            f.write("\n")
+        print(json.dumps({"ledger": out,
+                          "ledger_format": ledger["ledger_format"],
+                          "n_entries": ledger["n_entries"]}))
+        return
 
     # Perf rounds must not land on top of known hazards: the smoke modes
     # refuse to run while the static-analysis baseline has entries

@@ -32,12 +32,14 @@ _SERVE_PATH = "raftstereo_tpu/serve/metrics.py"
 _TRAIN_PATH = "raftstereo_tpu/train/telemetry.py"
 _LOADGEN_PATH = "raftstereo_tpu/loadgen/metrics.py"
 _TIER_PATH = "raftstereo_tpu/stream/tier.py"
+_OBS_PATH = "raftstereo_tpu/obs/fleet.py"
 
 
 def run_metrics_lint() -> List[Finding]:
     """Instantiate + lint + render-validate the repo's metric bundles."""
     from ..loadgen.metrics import LoadgenMetrics
-    from ..obs import lint_registry, validate_prometheus
+    from ..obs import (BurnRateAlerts, FleetFederator, lint_registry,
+                       validate_prometheus)
     from ..serve.metrics import (ClusterMetrics, MetricsRegistry,
                                  ServeMetrics)
     from ..stream.tier import TierMetrics
@@ -58,6 +60,11 @@ def run_metrics_lint() -> List[Finding]:
         # The durable session tier's families (tier_*): its own process
         # normally, but they must stay collision-free with the rest.
         tier = TierMetrics(registry)
+        # The fleet observability plane (fleet_*): the router mounts
+        # the federator's scrape counters and the burn-rate alert
+        # gauges next to the cluster bundle — one registry, one render.
+        federator = FleetFederator(registry)
+        alerts = BurnRateAlerts(registry)
     except ValueError as e:  # duplicate registration across bundles
         return [Finding("RSA503", _TRAIN_PATH, 1,
                         f"bundle collision: {e}", "metrics")]
@@ -67,6 +74,7 @@ def run_metrics_lint() -> List[Finding]:
             else _LOADGEN_PATH \
             if name.startswith(("loadgen", "slo", "chaos")) \
             else _TIER_PATH if name.startswith("tier") \
+            else _OBS_PATH if name.startswith("fleet") \
             else _SERVE_PATH
         findings.append(Finding("RSA501", path, 1, msg, "metrics"))
 
@@ -109,6 +117,10 @@ def run_metrics_lint() -> List[Finding]:
     loadgen.slo_checks.labels(status="pass").inc()
     loadgen.slo_pass.set(1)
     tier.requests.labels(op="put", outcome="ok").inc()
+    federator.scrapes.labels(backend="b0").inc()
+    federator.scrape_failures.labels(backend="b0").inc()
+    alerts.alert_state.labels(**{"class": "tier=*,priority=*"}).set(0)
+    alerts.alert_burn.labels(**{"class": "tier=*,priority=*"}).set(0.0)
     for msg in validate_prometheus(registry.render()):
         findings.append(Finding("RSA502", _SERVE_PATH, 1, msg, "metrics"))
     return findings

@@ -21,4 +21,7 @@
 * ``python -m raftstereo_tpu.cli.sessiontier`` — model-free durable
   session tier: any replica resumes any stream warm (docs/streaming.md
   "Durable sessions")
+* ``python -m raftstereo_tpu.cli.obs``       — fleet observatory client:
+  trace / fleet / alerts verbs against a running router
+  (docs/observability.md "Fleet observatory")
 """

@@ -1,0 +1,136 @@
+"""Fleet observatory client: operator verbs against a running router.
+
+    python -m raftstereo_tpu.cli.obs trace  --router 127.0.0.1:8000 \
+        --trace_id <id> [--out trace.json]
+    python -m raftstereo_tpu.cli.obs fleet  --router 127.0.0.1:8000
+    python -m raftstereo_tpu.cli.obs alerts --router 127.0.0.1:8000 \
+        [--watch 5]
+
+``trace`` fetches the STITCHED cross-hop tree for one trace id
+(``GET /debug/trace?trace_id=`` — router + every backend + session
+tier merged into one Perfetto-loadable document); ``--out`` writes the
+chrome://tracing JSON, otherwise the span tree prints as an indented
+summary.  ``fleet`` dumps the federated ``GET /metrics/fleet``
+exposition verbatim.  ``alerts`` prints the live burn-rate evaluation
+(``GET /debug/alerts``); ``--watch N`` re-evaluates every N seconds
+until interrupted.  Semantics: docs/observability.md "Fleet
+observatory".
+
+Like the router it talks to, this client is model-free and
+stdlib-only: it never imports the engine/model stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from urllib.parse import quote
+
+from .common import setup_logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    def _common(sp):
+        sp.add_argument("--router", default="127.0.0.1:8000",
+                        help="router host:port (default %(default)s)")
+        sp.add_argument("--timeout_s", type=float, default=5.0,
+                        help="per-request HTTP timeout")
+
+    t = sub.add_parser("trace", help="fetch one stitched cross-hop trace")
+    _common(t)
+    t.add_argument("--trace_id", required=True,
+                   help="trace id to stitch (the request's X-Request-Id "
+                        "unless the client sent X-Trace-Context)")
+    t.add_argument("--out", default=None,
+                   help="write the chrome://tracing JSON here instead of "
+                        "printing the span-tree summary")
+
+    f = sub.add_parser("fleet", help="dump the federated /metrics/fleet "
+                                     "exposition")
+    _common(f)
+
+    a = sub.add_parser("alerts", help="print the live burn-rate alert "
+                                      "evaluation")
+    _common(a)
+    a.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                   help="re-evaluate every SECONDS until interrupted")
+    return p
+
+
+def _get(router: str, path: str, timeout_s: float) -> bytes:
+    with urllib.request.urlopen(f"http://{router}{path}",
+                                timeout=timeout_s) as resp:
+        return resp.read()
+
+
+def _print_tree(node, depth=0):
+    span = node["span"]
+    dur_ms = span.get("dur_us", 0) / 1e3
+    attrs = span.get("attrs") or {}
+    extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+    print(f"{'  ' * depth}{span.get('source', '?')}/{span['name']} "
+          f"{dur_ms:.3f}ms{(' ' + extra) if extra else ''}")
+    for child in node.get("children", ()):
+        _print_tree(child, depth + 1)
+
+
+def _alerts_line(doc) -> str:
+    parts = []
+    for cls in doc.get("classes", ()):
+        parts.append(f"{cls['class']}: {cls['state_name']} "
+                     f"burn={cls['burn']} (fast={cls['burn_fast']} "
+                     f"slow={cls['burn_slow']})")
+    return "; ".join(parts) or "no classes"
+
+
+def main(argv=None) -> int:
+    setup_logging()
+    args = build_parser().parse_args(argv)
+    try:
+        if args.verb == "trace":
+            raw = _get(args.router, "/debug/trace?trace_id="
+                       + quote(args.trace_id, safe=""), args.timeout_s)
+            doc = json.loads(raw)
+            if args.out:
+                with open(args.out, "w") as fh:
+                    json.dump(doc, fh)
+                print(json.dumps({"out": args.out,
+                                  "stitch": doc.get("stitch")}))
+            else:
+                stitch = doc.get("stitch", {})
+                print(f"trace {args.trace_id}: "
+                      f"{stitch.get('n_spans', 0)} spans from "
+                      f"{', '.join(stitch.get('sources', ()))}"
+                      + (f" (gaps: {', '.join(stitch['gaps'])})"
+                         if stitch.get("gaps") else ""))
+                for root in doc.get("tree", ()):
+                    _print_tree(root)
+        elif args.verb == "fleet":
+            sys.stdout.write(
+                _get(args.router, "/metrics/fleet",
+                     args.timeout_s).decode("utf-8", "replace"))
+        else:  # alerts
+            while True:
+                doc = json.loads(_get(args.router, "/debug/alerts",
+                                      args.timeout_s))
+                if args.watch is None:
+                    print(json.dumps(doc, indent=2))
+                    break
+                print(_alerts_line(doc), flush=True)
+                time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

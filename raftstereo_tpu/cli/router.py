@@ -56,9 +56,9 @@ def main(argv=None) -> int:
     print(json.dumps({
         "routing": f"http://{cfg.host}:{router.port}",
         "backends": [f"{h}:{p}" for h, p in cfg.backends],
-        "endpoints": ["/predict", "/metrics", "/healthz", "/debug/trace",
-                      "/debug/threads", "/debug/vars", "/debug/drain",
-                      "/debug/restart"],
+        "endpoints": ["/predict", "/metrics", "/metrics/fleet", "/healthz",
+                      "/debug/trace", "/debug/alerts", "/debug/threads",
+                      "/debug/vars", "/debug/drain", "/debug/restart"],
     }), flush=True)
     try:
         router.serve_forever()

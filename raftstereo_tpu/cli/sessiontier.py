@@ -56,7 +56,7 @@ def main(argv=None) -> int:
         "session_limit": cfg.session_limit,
         "budget_mb": cfg.budget_mb,
         "endpoints": ["/healthz", "/metrics", "/debug/sessions",
-                      "/debug/faults"],
+                      "/debug/faults", "/debug/trace", "/debug/vars"],
     }), flush=True)
     try:
         tier.serve_forever()

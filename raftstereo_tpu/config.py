@@ -458,6 +458,24 @@ class RouterConfig:
     # observed.  Never for sessions or streamed binary bodies.
     hedge_floor_ms: float = 0.0
     hedge_min_samples: int = 20
+    # Tail-based trace retention ring (obs/stitch.py): how many
+    # kept-trace records GET /debug/vars surfaces.  Error traces and
+    # traces slower than the live hop p99 are retained; the boring
+    # middle is dropped deterministically.
+    tail_ring: int = 256
+    # Burn-rate alerting (obs/alerts.py): fast evaluation window; the
+    # slow window is 5x it (the standard multi-window pairing).
+    alert_window_s: float = 30.0
+    # Error-rate budget per alert class: observed error rate divided by
+    # this IS the burn rate (1.0 = consuming budget exactly at limit).
+    alert_error_budget: float = 0.05
+    # Shed-rate budget, same semantics.
+    alert_shed_budget: float = 0.25
+    # Both windows burning at >= this rate -> PAGE (state 2) and an
+    # autoscaler scale-up signal.
+    alert_page_burn: float = 2.0
+    # Per-target timeout for GET /metrics/fleet federation scrapes.
+    fleet_timeout_s: float = 2.0
 
     def __post_init__(self):
         if isinstance(self.backends, list):
@@ -479,6 +497,12 @@ class RouterConfig:
         assert self.breaker_reset_s > 0, self.breaker_reset_s
         assert self.hedge_floor_ms >= 0, self.hedge_floor_ms
         assert self.hedge_min_samples >= 1, self.hedge_min_samples
+        assert self.tail_ring >= 1, self.tail_ring
+        assert self.alert_window_s > 0, self.alert_window_s
+        assert 0 < self.alert_error_budget <= 1, self.alert_error_budget
+        assert 0 < self.alert_shed_budget <= 1, self.alert_shed_budget
+        assert self.alert_page_burn >= 1, self.alert_page_burn
+        assert self.fleet_timeout_s > 0, self.fleet_timeout_s
 
 
 @dataclasses.dataclass(frozen=True)
@@ -951,6 +975,29 @@ def add_router_args(parser: argparse.ArgumentParser) -> None:
                    default=d.hedge_min_samples,
                    help="forward-latency samples required before the hedge "
                         "delay tracks live p99 instead of the floor")
+    g.add_argument("--tail_ring", type=int, default=d.tail_ring,
+                   help="tail-based trace retention ring capacity: error "
+                        "and slower-than-live-p99 traces kept, the "
+                        "boring middle dropped (docs/observability.md)")
+    g.add_argument("--alert_window_s", type=float,
+                   default=d.alert_window_s,
+                   help="fast burn-rate evaluation window; the slow "
+                        "window is 5x it")
+    g.add_argument("--alert_error_budget", type=float,
+                   default=d.alert_error_budget,
+                   help="error-rate budget: observed error rate over "
+                        "this is the burn rate")
+    g.add_argument("--alert_shed_budget", type=float,
+                   default=d.alert_shed_budget,
+                   help="shed-rate budget, same burn semantics")
+    g.add_argument("--alert_page_burn", type=float,
+                   default=d.alert_page_burn,
+                   help="both windows burning at >= this pages (alert "
+                        "state 2) and signals the autoscaler")
+    g.add_argument("--fleet_timeout_s", type=float,
+                   default=d.fleet_timeout_s,
+                   help="per-target scrape timeout for GET /metrics/fleet "
+                        "federation")
 
 
 def router_config_from_args(args: argparse.Namespace) -> RouterConfig:
@@ -974,6 +1021,12 @@ def router_config_from_args(args: argparse.Namespace) -> RouterConfig:
         breaker_reset_s=args.breaker_reset_s,
         hedge_floor_ms=args.hedge_floor_ms,
         hedge_min_samples=args.hedge_min_samples,
+        tail_ring=args.tail_ring,
+        alert_window_s=args.alert_window_s,
+        alert_error_budget=args.alert_error_budget,
+        alert_shed_budget=args.alert_shed_budget,
+        alert_page_burn=args.alert_page_burn,
+        fleet_timeout_s=args.fleet_timeout_s,
     )
 
 

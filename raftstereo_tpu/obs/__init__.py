@@ -19,17 +19,25 @@ The instruments themselves (Counter/Gauge/label families/histograms) live
 in ``serve/metrics.py``; this package is everything around them.
 """
 
+from .alerts import AlertClass, BurnRateAlerts  # noqa: F401
 from .exporter import (  # noqa: F401
     TelemetryServer,
     build_info,
     dump_threads,
     trace_response,
 )
+from .fleet import FleetFederator, FleetScrape  # noqa: F401
 from .prom import (  # noqa: F401
     Scrape,
     lint_registry,
     parse_sample,
     parse_text,
     validate_prometheus,
+)
+from .stitch import (  # noqa: F401
+    TailSampler,
+    spans_from_chrome,
+    stitch_sources,
+    stitch_tree,
 )
 from .trace import Span, Tracer, to_chrome_trace  # noqa: F401

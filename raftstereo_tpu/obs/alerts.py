@@ -1,0 +1,234 @@
+"""Live SLO burn-rate alerting over the federated scrape
+(docs/observability.md "Burn-rate alerts").
+
+The SLO harness (loadgen/slo.py) renders VERDICTS after a replay ends;
+this module answers "is the error budget burning RIGHT NOW" while
+traffic is live.  It keeps a short history of federated counter
+snapshots (obs/fleet.py), computes windowed error/shed rates and the
+live hop p99, and turns them into multi-window BURN RATES: a burn of
+1.0 means the class bound is being consumed exactly at its limit; N
+means N times faster.
+
+The class vocabulary deliberately mirrors ``loadgen.slo.SLOClass``
+(same field names, same ``selector()`` string — a parity test pins
+this), but it is re-declared here rather than imported: ``loadgen``
+pulls in the serve client stack and the router is model-free, exactly
+the reason ``ops/autoscale.py`` re-implements its capacity-model
+loading (see that module's doc).
+
+Multi-window rule (the standard fast+slow burn-rate pattern): PAGE
+(state 2) only when BOTH the fast and the slow window burn at
+``page_burn`` or faster — fast-only spikes are noise, slow-only means
+the incident is already old news; WARN (state 1) when either window
+burns at >= 1.0; OK (state 0) otherwise.  States are exported as
+``fleet_alert_state{class=}`` and the page-qualified burn
+(min(fast, slow), the quantity the page rule thresholds) feeds
+``ops/autoscale.Autoscaler`` as a scale-up signal.
+
+Stdlib-only: the router imports this and the router is model-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["AlertClass", "BurnRateAlerts", "ERROR_OUTCOMES"]
+
+#: ``serve_requests_total{outcome=}`` values that consume error budget.
+#: ``shed`` is budgeted separately (``max_shed_rate``) — load shedding
+#: is a policy outcome, not a failure (docs/slo_harness.md).
+ERROR_OUTCOMES = ("error", "timeout", "unavailable")
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertClass:
+    """One alerted traffic class — the live-alerting subset of
+    ``loadgen.slo.SLOClass``'s vocabulary (field names and ``selector``
+    format are identical by test-pinned contract; tests/test_fleet.py).
+
+    Bounds ARE budgets: ``max_error_rate=0.01`` means 1% of requests
+    may fail; an observed 2% error rate is a burn of 2.0.  Unset bounds
+    (the defaults) never contribute burn."""
+
+    tier: str = "*"
+    priority: str = "*"
+    p99_ms: float = math.inf
+    max_shed_rate: float = 1.0
+    max_error_rate: float = 1.0
+
+    def __post_init__(self):
+        assert self.p99_ms > 0, self.p99_ms
+        assert 0 < self.max_shed_rate <= 1.0, self.max_shed_rate
+        assert 0 < self.max_error_rate <= 1.0, self.max_error_rate
+
+    def selector(self) -> str:
+        return f"tier={self.tier},priority={self.priority}"
+
+
+_STATE_NAMES = {0: "ok", 1: "warn", 2: "page"}
+
+
+class BurnRateAlerts:
+    """Rolling burn-rate evaluation over successive federated scrapes.
+
+    ``observe(fleet_scrape, p99_s=...)`` is called on each evaluation
+    (the router's ``GET /debug/alerts`` triggers one); it appends a
+    counter snapshot, evaluates every class over the fast and slow
+    windows, updates the ``fleet_alert_state{class=}`` /
+    ``fleet_alert_burn`` gauges, and returns the full evaluation dict.
+    """
+
+    def __init__(self, registry, classes: Sequence[AlertClass] = (),
+                 fast_window_s: float = 30.0,
+                 slow_window_s: Optional[float] = None,
+                 page_burn: float = 2.0):
+        assert fast_window_s > 0, fast_window_s
+        if slow_window_s is None:
+            slow_window_s = 5.0 * fast_window_s
+        assert slow_window_s >= fast_window_s, (slow_window_s,
+                                                fast_window_s)
+        assert page_burn >= 1.0, page_burn
+        self.classes = tuple(classes) or (AlertClass(),)
+        self.fast_window_s = fast_window_s
+        self.slow_window_s = slow_window_s
+        self.page_burn = page_burn
+        self._lock = threading.Lock()
+        # (t, requests, errors, sheds) snapshots, oldest first
+        self._snaps: deque = deque()  # guarded_by: _lock
+        self._last: Optional[Dict] = None  # guarded_by: _lock
+        self.alert_state = registry.gauge(
+            "fleet_alert_state",
+            "live burn-rate alert state per SLO class "
+            "(0 = ok, 1 = warn, 2 = page; obs/alerts.py)",
+            labels=("class",))
+        self.alert_burn = registry.gauge(
+            "fleet_alert_burn",
+            "page-qualified error-budget burn rate per SLO class — "
+            "min(fast, slow) window burn, 1.0 = budget consumed exactly "
+            "at its limit",
+            labels=("class",))
+
+    # ------------------------------------------------------------ counts
+
+    @staticmethod
+    def _counts(scrape) -> Tuple[float, float, float]:
+        """(requests, errors, sheds) fleet-wide from one parsed scrape.
+        ``serve_requests_total`` is summed across every ``backend=``
+        label the federator added — absent metric reads as 0."""
+        m = scrape.get("serve_requests_total")
+        requests = errors = sheds = 0.0
+        if m is None:
+            return requests, errors, sheds
+        for litems, value in m.series("serve_requests_total"):
+            labels = dict(litems)
+            requests += value
+            outcome = labels.get("outcome")
+            if outcome in ERROR_OUTCOMES:
+                errors += value
+            elif outcome == "shed":
+                sheds += value
+        return requests, errors, sheds
+
+    def _window_delta(self, now: float, window_s: float  # guarded_by: _lock
+                      ) -> Tuple[float, float, float]:
+        """Counter deltas over the trailing window: current snapshot
+        minus the most recent snapshot at least ``window_s`` old (or
+        the oldest held — a young history under-reports the window,
+        which biases burn DOWN, never a false page)."""
+        cur = self._snaps[-1]
+        base = self._snaps[0]
+        for snap in self._snaps:
+            if snap[0] <= now - window_s:
+                base = snap
+            else:
+                break
+        return (cur[1] - base[1], cur[2] - base[2], cur[3] - base[3])
+
+    # ---------------------------------------------------------- evaluate
+
+    def observe(self, fleet_scrape, p99_s: Optional[float] = None,
+                now: Optional[float] = None) -> Dict:
+        """Snapshot + evaluate.  ``fleet_scrape`` is a
+        ``fleet.FleetScrape`` (or anything with ``.scrape``);
+        ``p99_s`` is the live hop p99 the caller reads from its
+        latency histogram (``LatencyHistogram.quantile(0.99)``)."""
+        t = time.time() if now is None else now
+        scrape = getattr(fleet_scrape, "scrape", fleet_scrape)
+        requests, errors, sheds = self._counts(scrape)
+        with self._lock:
+            self._snaps.append((t, requests, errors, sheds))
+            horizon = t - 2.0 * self.slow_window_s
+            while len(self._snaps) > 2 and self._snaps[1][0] < horizon:
+                self._snaps.popleft()
+            windows = {}
+            for name, window_s in (("fast", self.fast_window_s),
+                                   ("slow", self.slow_window_s)):
+                dr, de, ds = self._window_delta(t, window_s)
+                windows[name] = {
+                    "window_s": window_s, "requests": dr,
+                    "error_rate": (de / dr) if dr > 0 else 0.0,
+                    "shed_rate": (ds / dr) if dr > 0 else 0.0,
+                }
+            evaluated: List[Dict] = []
+            for cls in self.classes:
+                burns = {}
+                for name, w in windows.items():
+                    burn = max(w["error_rate"] / cls.max_error_rate,
+                               w["shed_rate"] / cls.max_shed_rate)
+                    if p99_s is not None and math.isfinite(cls.p99_ms):
+                        burn = max(burn, p99_s * 1e3 / cls.p99_ms)
+                    burns[name] = burn
+                paged = min(burns["fast"], burns["slow"])
+                if paged >= self.page_burn:
+                    state = 2
+                elif max(burns["fast"], burns["slow"]) >= 1.0:
+                    state = 1
+                else:
+                    state = 0
+                sel = cls.selector()
+                self.alert_state.labels(**{"class": sel}).set(state)
+                self.alert_burn.labels(**{"class": sel}).set(
+                    round(paged, 6))
+                evaluated.append({
+                    "class": sel, "state": state,
+                    "state_name": _STATE_NAMES[state],
+                    "burn_fast": round(burns["fast"], 6),
+                    "burn_slow": round(burns["slow"], 6),
+                    "burn": round(paged, 6),
+                    "bounds": {"p99_ms": cls.p99_ms,
+                               "max_error_rate": cls.max_error_rate,
+                               "max_shed_rate": cls.max_shed_rate},
+                })
+            self._last = {
+                "now_unix": round(t, 3),
+                "page_burn": self.page_burn,
+                "p99_ms": (round(p99_s * 1e3, 3)
+                           if p99_s is not None else None),
+                "windows": windows,
+                "classes": evaluated,
+                "scrape": {"sources": getattr(fleet_scrape, "sources",
+                                              None),
+                           "gaps": getattr(fleet_scrape, "gaps", None)},
+            }
+            return self._last
+
+    def last(self) -> Optional[Dict]:
+        """Most recent evaluation (None before the first observe)."""
+        with self._lock:
+            return self._last
+
+    def max_burn(self) -> float:
+        """Max page-qualified burn across classes from the LAST
+        evaluation — the autoscaler's scale-up signal; 0.0 before any
+        evaluation (never triggers a fresh fleet scrape: the gauge
+        refresh path must stay cheap)."""
+        with self._lock:
+            if self._last is None:
+                return 0.0
+            return max((c["burn"] for c in self._last["classes"]),
+                       default=0.0)

@@ -1,0 +1,190 @@
+"""Federated fleet metrics: one scrape for the whole cluster
+(docs/observability.md "Federated metrics").
+
+The router, every backend, and the session tier each serve their own
+``/metrics``; capacity planning and the live burn-rate alerts
+(obs/alerts.py) need the UNION.  ``FleetFederator`` scrapes each
+registered target, re-labels every foreign series with ``backend=``,
+merges them with the router's own registry render, and returns one
+Prometheus 0.0.4 exposition — served by the router at
+``GET /metrics/fleet``.
+
+Validator-clean by construction: every source text is round-tripped
+through ``obs/prom.parse_text`` (which itself runs the validator), label
+values and HELP text are re-emitted in their already-escaped wire form,
+and the merged text is parsed ONCE MORE before it leaves — a federated
+scrape that fails its own validator is a bug here, not in a source.
+
+Scrape failures are surfaced, not swallowed: an unreachable target
+increments ``fleet_scrape_failures_total{backend=}`` and its series are
+simply absent from that render — the fleet view degrades per-hop, the
+endpoint never 500s because one backend is down (that is precisely when
+the fleet view is needed).
+
+Stdlib-only: the router imports this and the router is model-free.
+"""
+
+from __future__ import annotations
+
+import http.client
+import math
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .prom import parse_text
+
+__all__ = ["FleetFederator", "FleetScrape", "fetch_metrics_text"]
+
+Target = Tuple[str, str, int]  # (label, host, port)
+
+
+def fetch_metrics_text(host: str, port: int, timeout_s: float = 2.0,
+                       path: str = "/metrics") -> str:
+    """GET one target's text exposition (raises on any failure)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(f"{host}:{port}{path} -> {resp.status}")
+        return body.decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if v != v:  # NaN
+        return "NaN"
+    if float(v).is_integer():
+        return str(int(v))
+    return format(v, ".9g")
+
+
+class FleetScrape:
+    """One federated render: the merged text plus its parsed form and
+    the per-source outcome (``sources`` scraped, ``gaps`` not)."""
+
+    def __init__(self, text: str, sources: List[str], gaps: List[str]):
+        self.text = text
+        self.sources = sources
+        self.gaps = gaps
+        self.scrape = parse_text(text)  # self-validating by construction
+
+
+class FleetFederator:
+    """Scrape-and-merge across the fleet.
+
+    ``targets_fn`` returns the live ``(label, host, port)`` list at call
+    time (the router's backend set changes under drain/rejoin, so it is
+    a callable, not a snapshot).  ``fetch_fn`` is injectable for tests.
+    """
+
+    def __init__(self, registry, targets_fn: Optional[
+                     Callable[[], Sequence[Target]]] = None,
+                 timeout_s: float = 2.0,
+                 fetch_fn: Optional[Callable[[str, int, float],
+                                             str]] = None):
+        self.registry = registry
+        self._targets_fn = targets_fn or (lambda: ())
+        self.timeout_s = timeout_s
+        self._fetch = fetch_fn or (
+            lambda host, port, t: fetch_metrics_text(host, port, t))
+        self.scrapes = registry.counter(
+            "fleet_scrapes_total",
+            "federation scrape attempts per target, successful or not "
+            "(obs/fleet.py; GET /metrics/fleet)",
+            labels=("backend",))
+        self.scrape_failures = registry.counter(
+            "fleet_scrape_failures_total",
+            "federation scrapes that failed (target unreachable, "
+            "non-200, or invalid exposition) — the target's series are "
+            "absent from that /metrics/fleet render, never silently "
+            "stale",
+            labels=("backend",))
+
+    # ------------------------------------------------------------- merge
+
+    def federate(self, local_text_fn: Optional[
+            Callable[[], str]] = None) -> FleetScrape:
+        """One federated render.  ``local_text_fn`` produces the
+        router's own freshly refreshed render (defaults to
+        ``registry.render`` — callers that must refresh gauges first
+        pass their own).  It is a CALLABLE invoked AFTER the foreign
+        scrapes so this very render's ``fleet_scrape_failures_total``
+        increments are already in it — a failed scrape is visible in
+        the same exposition that carries its gap, never one render
+        late.
+
+        Merge rules: the router's series pass through unlabeled; every
+        foreign series gains ``backend=<label>`` (histogram
+        ``_bucket``/``_sum``/``_count`` included, so per-backend bucket
+        ladders stay independently cumulative — the validator checks
+        coherence per label set).  First-seen HELP/TYPE wins for a
+        family name; duplicate series keep the first occurrence."""
+        # families: name -> (kind, help, rows); rows keep source order.
+        families: "Dict[str, List]" = {}
+        order: List[str] = []
+        seen_series = set()
+
+        def add(name, kind, help_, sample_name, labels, value):
+            fam = families.get(name)
+            if fam is None:
+                fam = families[name] = [kind, help_, []]
+                order.append(name)
+            elif fam[0] != kind:
+                return  # TYPE conflict across sources: first wins
+            key = (sample_name, labels)
+            if key in seen_series:
+                return
+            seen_series.add(key)
+            fam[2].append((sample_name, labels, value))
+
+        def merge(scrape, backend: Optional[str]):
+            for name, metric in scrape.metrics.items():
+                for (sname, litems), value in metric.samples.items():
+                    labels = litems
+                    if backend is not None:
+                        labels = (("backend", backend),) + tuple(
+                            kv for kv in litems if kv[0] != "backend")
+                    add(name, metric.kind, metric.help, sname,
+                        tuple(labels), value)
+                if not metric.samples:  # declared-but-empty family
+                    add(name, metric.kind, metric.help, None, None, None)
+
+        sources, gaps = [], []
+        foreign: List[Tuple[str, object]] = []
+        for label, host, port in self._targets_fn():
+            self.scrapes.labels(backend=label).inc()
+            try:
+                text = self._fetch(host, port, self.timeout_s)
+                foreign.append((label, parse_text(text)))
+            except Exception:
+                self.scrape_failures.labels(backend=label).inc()
+                gaps.append(label)
+                continue
+            sources.append(label)
+        merge(parse_text(local_text_fn() if local_text_fn is not None
+                         else self.registry.render()), None)
+        for label, scrape in foreign:
+            merge(scrape, label)
+        lines: List[str] = []
+        for name in order:
+            kind, help_, rows = families[name]
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            for sname, labels, value in rows:
+                if sname is None:
+                    continue  # family with no series yet
+                if labels:
+                    labelset = ",".join(f'{k}="{v}"' for k, v in labels)
+                    lines.append(f"{sname}{{{labelset}}} "
+                                 f"{_fmt_value(value)}")
+                else:
+                    lines.append(f"{sname} {_fmt_value(value)}")
+        text = "\n".join(lines) + "\n"
+        return FleetScrape(text, sources, gaps)

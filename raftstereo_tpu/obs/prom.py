@@ -173,7 +173,12 @@ def validate_prometheus(text: str) -> List[str]:
             errors.append(f"{where}: duplicate series {line.split(' ')[0]}")
         series_seen.add(key)
         if types[base] == "histogram":
-            h = hist.setdefault(base, {"buckets": [], "count": None})
+            # Coherence is per label SET (minus ``le``): a federated
+            # exposition (obs/fleet.py) carries one bucket ladder per
+            # ``backend=`` label, each independently cumulative.
+            group = tuple(sorted((k, v) for k, v in labels if k != "le"))
+            h = hist.setdefault((base, group),
+                                {"buckets": [], "count": None})
             if name == base + "_bucket":
                 le = dict(labels).get("le")
                 if le is None:
@@ -187,24 +192,27 @@ def validate_prometheus(text: str) -> List[str]:
                 h["buckets"].append((bound, value))
             elif name == base + "_count":
                 h["count"] = value
-    for base, h in hist.items():
+    for (base, group), h in hist.items():
+        label = base if not group else \
+            base + "{" + ",".join(f'{k}="{v}"' for k, v in group) + "}"
         buckets = h["buckets"]
         if not buckets:
-            errors.append(f"histogram {base} has no _bucket series")
+            errors.append(f"histogram {label} has no _bucket series")
             continue
         bounds = [b for b, _ in buckets]
         cums = [c for _, c in buckets]
         if bounds != sorted(bounds):
-            errors.append(f"histogram {base} buckets out of order")
+            errors.append(f"histogram {label} buckets out of order")
         if any(a > b for a, b in zip(cums, cums[1:])):
-            errors.append(f"histogram {base} cumulative counts not monotone")
+            errors.append(
+                f"histogram {label} cumulative counts not monotone")
         if bounds[-1] != math.inf:
-            errors.append(f"histogram {base} missing le=\"+Inf\" bucket")
+            errors.append(f"histogram {label} missing le=\"+Inf\" bucket")
         elif h["count"] is None:
-            errors.append(f"histogram {base} missing _count")
+            errors.append(f"histogram {label} missing _count")
         elif cums[-1] != h["count"]:
             errors.append(
-                f"histogram {base} +Inf bucket {cums[-1]} != _count "
+                f"histogram {label} +Inf bucket {cums[-1]} != _count "
                 f"{h['count']}")
     return errors
 

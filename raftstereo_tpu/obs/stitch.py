@@ -1,0 +1,243 @@
+"""Cross-hop trace stitching + tail-based retention (docs/observability.md).
+
+One request now crosses up to three processes — router, backend, session
+tier — and each hop records spans into its OWN bounded ring
+(``obs/trace.py``).  The trace-context header (``serve/httpbase.py``)
+makes every hop agree on the trace id; this module puts the pieces back
+together: the router's ``GET /debug/trace?trace_id=`` fans out to each
+hop's trace endpoint, parses the Chrome trace-event exports back into
+spans, and returns ONE stitched span tree in which the router's hop span
+is an ancestor of the backend's admission → queue_wait → dispatch →
+host_fetch spans.
+
+Two stitching rules, applied in order:
+
+1. **Explicit parentage** — a span whose ``parent_id`` resolves to
+   another collected span attaches there.  The router emits its hop
+   span's id in the outbound header, so the backend's root "request"
+   span links across the process boundary by id.
+2. **Wall-time containment** — spans recorded without a parent (the
+   batcher's queue_wait/dispatch/host_fetch are measured after the fact
+   by the dispatch worker, which has no span stack) attach to the
+   SMALLEST span whose wall-time interval encloses theirs, within a
+   small jitter allowance: every process computes wall time from one
+   import-time ``time.time() - time.perf_counter()`` offset, so
+   same-host hops agree to well under the allowance.
+
+Everything else is a root.  The stitched document stays a valid Chrome
+trace (top-level ``traceEvents``) so Perfetto opens it unchanged, with
+the tree + per-source gap report riding alongside.
+
+``TailSampler`` is the retention policy that makes the ring buffers
+useful at fleet rates: sampling decided at request END (tail-based),
+when the outcome is known — error traces are ALWAYS kept, traces slower
+than the caller's live p99 threshold are kept, and the boring middle is
+dropped deterministically (the decision is a pure function of
+(status, duration, threshold), so replaying the same traffic retains
+the same traces).
+
+Stdlib-only: the router imports this and the router is model-free.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["spans_from_chrome", "stitch_tree", "stitch_sources",
+           "TailSampler"]
+
+#: wall-clock jitter allowance (µs) for cross-process containment —
+#: generous against import-time offset skew, far below span durations.
+CLOCK_SLACK_US = 5000.0
+
+
+def spans_from_chrome(doc: Optional[Dict], source: str) -> List[Dict]:
+    """Parse a Chrome trace-event export (``to_chrome_trace`` form) back
+    into plain span dicts, each tagged with the ``source`` hop it came
+    from.  Tolerant: events without span/trace ids (foreign exports,
+    metadata events) are skipped, never raised on."""
+    out: List[Dict] = []
+    for ev in (doc or {}).get("traceEvents", []):
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        args = ev.get("args")
+        args = dict(args) if isinstance(args, dict) else {}
+        span_id = args.pop("span_id", None)
+        trace_id = args.pop("trace_id", None)
+        parent_id = args.pop("parent_id", None)
+        if not span_id or not trace_id:
+            continue
+        try:
+            t0_us = float(ev.get("ts", 0.0))
+            dur_us = max(float(ev.get("dur", 0.0)), 0.0)
+        except (TypeError, ValueError):
+            continue
+        out.append({"name": str(ev.get("name", "?")), "source": source,
+                    "trace_id": str(trace_id), "span_id": str(span_id),
+                    "parent_id": (str(parent_id) if parent_id else None),
+                    "t0_us": t0_us, "dur_us": dur_us, "attrs": args})
+    return out
+
+
+def _order_key(span: Dict) -> Tuple[float, str]:
+    """Strict ordering that makes containment attachment acyclic: a span
+    may only attach under a span with a GREATER key, so the child→parent
+    walk strictly increases and can never loop even when clock slack
+    makes two near-identical intervals mutually 'enclosing'."""
+    return (span["dur_us"], span["span_id"])
+
+
+def stitch_tree(spans: Sequence[Dict]) -> List[Dict]:
+    """Build the stitched tree: ``[{"span": ..., "children": [...]}]``
+    roots, children sorted by start time.  See the module doc for the
+    two attachment rules."""
+    by_id = {s["span_id"]: s for s in spans}
+    parent_of: Dict[str, Optional[str]] = {}
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid and pid in by_id and pid != s["span_id"]:
+            parent_of[s["span_id"]] = pid
+            continue
+        # Orphan: smallest enclosing wall-time interval, slack-tolerant.
+        s0, s1 = s["t0_us"], s["t0_us"] + s["dur_us"]
+        best = None
+        for cand in spans:
+            if cand["span_id"] == s["span_id"]:
+                continue
+            if _order_key(cand) <= _order_key(s):
+                continue  # acyclicity: parents are strictly "bigger"
+            c0 = cand["t0_us"] - CLOCK_SLACK_US
+            c1 = cand["t0_us"] + cand["dur_us"] + CLOCK_SLACK_US
+            if c0 <= s0 and s1 <= c1:
+                if best is None or _order_key(cand) < _order_key(best):
+                    best = cand
+        parent_of[s["span_id"]] = best["span_id"] if best else None
+    nodes = {s["span_id"]: {"span": s, "children": []} for s in spans}
+    roots: List[Dict] = []
+    for s in spans:
+        pid = parent_of.get(s["span_id"])
+        if pid is not None:
+            nodes[pid]["children"].append(nodes[s["span_id"]])
+        else:
+            roots.append(nodes[s["span_id"]])
+    for node in nodes.values():
+        node["children"].sort(key=lambda n: n["span"]["t0_us"])
+    roots.sort(key=lambda n: n["span"]["t0_us"])
+    return roots
+
+
+def stitch_sources(trace_id: str,
+                   sources: Sequence[Tuple[str, Optional[Dict]]]) -> Dict:
+    """Stitch one trace from per-hop Chrome exports.
+
+    ``sources`` is ``[(name, chrome_doc_or_None), ...]`` — None marks a
+    hop that could not be scraped; it becomes an entry in
+    ``stitch.gaps`` and the tree is returned PARTIAL rather than the
+    whole request 500ing (the observable part of a degraded fleet is
+    exactly what an operator needs while it is degraded).
+
+    The result is simultaneously a valid Chrome trace (``traceEvents``
+    rebuilt with one synthetic pid per source hop + process_name
+    metadata, so Perfetto shows router/backend/tier as separate process
+    tracks) and the structured form (``tree``, ``stitch``)."""
+    spans: List[Dict] = []
+    used: List[str] = []
+    gaps: List[str] = []
+    for name, doc in sources:
+        if doc is None:
+            gaps.append(name)
+            continue
+        used.append(name)
+        spans.extend(s for s in spans_from_chrome(doc, name)
+                     if s["trace_id"] == trace_id)
+    events: List[Dict] = []
+    pids = {name: i + 1 for i, name in enumerate(used)}
+    for s in spans:
+        events.append({
+            "ph": "X", "name": s["name"], "cat": "obs",
+            "ts": s["t0_us"], "dur": s["dur_us"],
+            "pid": pids[s["source"]], "tid": 1,
+            "args": {"trace_id": s["trace_id"], "span_id": s["span_id"],
+                     "parent_id": s["parent_id"], "source": s["source"],
+                     **s["attrs"]},
+        })
+    for name, pid in pids.items():
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 1, "args": {"name": name}})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "trace_id": trace_id,
+        "tree": stitch_tree(spans),
+        "stitch": {"sources": used, "gaps": gaps, "n_spans": len(spans)},
+    }
+
+
+class TailSampler:
+    """Bounded tail-based trace retention ring.
+
+    ``offer`` is called once per finished request with the outcome in
+    hand; it KEEPS the trace id when the request errored (status >= 500)
+    or ran slower than the live threshold the caller passes (the
+    router's hop p99), and counts a deterministic drop otherwise.  The
+    ring is bounded (LRU on insertion order) so retention can never be
+    the thing that OOMs the router.
+    """
+
+    def __init__(self, capacity: int = 256):
+        assert capacity >= 1, capacity
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._kept: "OrderedDict[str, Dict]" = OrderedDict()  # guarded_by: _lock
+        self._dropped = 0  # guarded_by: _lock
+        self._kept_error = 0  # guarded_by: _lock
+        self._kept_slow = 0  # guarded_by: _lock
+        self._evicted = 0  # guarded_by: _lock
+
+    def offer(self, trace_id: Optional[str], duration_s: float,
+              status: int, threshold_s: Optional[float] = None) -> bool:
+        """Decide retention for one finished request; returns True when
+        the trace was kept.  Pure function of the arguments — replaying
+        identical traffic retains identical traces."""
+        if not trace_id:
+            return False  # unsampled: there are no spans to retain
+        error = status >= 500
+        slow = threshold_s is not None and duration_s > threshold_s
+        if not (error or slow):
+            with self._lock:
+                self._dropped += 1
+            return False
+        record = {"trace_id": trace_id,
+                  "duration_ms": round(duration_s * 1e3, 3),
+                  "status": int(status),
+                  "why": "error" if error else "slow"}
+        with self._lock:
+            if error:
+                self._kept_error += 1
+            else:
+                self._kept_slow += 1
+            self._kept[trace_id] = record
+            self._kept.move_to_end(trace_id)
+            while len(self._kept) > self.capacity:
+                self._kept.popitem(last=False)
+                self._evicted += 1
+        return True
+
+    def __contains__(self, trace_id: str) -> bool:
+        with self._lock:
+            return trace_id in self._kept
+
+    def retained(self) -> List[Dict]:
+        """Kept-trace records, oldest first (snapshot)."""
+        with self._lock:
+            return list(self._kept.values())
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"capacity": self.capacity, "kept": len(self._kept),
+                    "dropped": self._dropped,
+                    "kept_error": self._kept_error,
+                    "kept_slow": self._kept_slow,
+                    "evicted": self._evicted}

@@ -103,8 +103,15 @@ class Tracer:
         return uuid.uuid4().hex
 
     @staticmethod
-    def _new_span_id() -> str:
+    def new_span_id() -> str:
+        """Public span-id mint: callers that must know a span's id BEFORE
+        the span completes (the router emits the hop span's id in the
+        outbound ``X-Trace-Context`` header, then records the span after
+        the forward returns) mint here and pass it to ``record``."""
         return uuid.uuid4().hex[:16]
+
+    # internal alias kept for the pre-PR 20 private callers
+    _new_span_id = new_span_id
 
     def current(self) -> Optional[Tuple[str, str]]:
         """(trace_id, span_id) of this thread's innermost open span."""
@@ -113,13 +120,25 @@ class Tracer:
 
     # ------------------------------------------------------------ recording
 
-    def record(self, name: str, t0: float, t1: float, trace_id: str,
+    def record(self, name: str, t0: float, t1: float,
+               trace_id: Optional[str],
                parent_id: Optional[str] = None,
-               attrs: Optional[Dict] = None) -> str:
+               attrs: Optional[Dict] = None,
+               span_id: Optional[str] = None) -> str:
         """Record a span measured elsewhere (``t0``/``t1`` are
         ``time.perf_counter`` values).  Returns the span id so callers can
-        parent further spans under it."""
-        sid = self._new_span_id()
+        parent further spans under it.
+
+        A falsy ``trace_id`` records NOTHING and returns "" — this is the
+        central ``sampled=0`` guard: hops that continue an unsampled
+        trace context pass ``trace_id=None`` downstream (batcher,
+        scheduler, stream) and every span silently vanishes without
+        per-component flag plumbing.  ``span_id`` lets the caller use a
+        pre-minted id (``new_span_id``) that already left the process in
+        a trace-context header."""
+        if not trace_id:
+            return ""
+        sid = span_id or self.new_span_id()
         span = Span(trace_id=trace_id, span_id=sid, parent_id=parent_id,
                     name=name, t0=t0, t1=t1,
                     thread=threading.current_thread().name,

@@ -63,6 +63,12 @@ class AutoscalePolicy:
     # the fleet is about to pay budget evictions — each one turns a live
     # stream's next frame cold, so scale out BEFORE the budget trips.
     high_memory_pressure: float = 0.9
+    # Page-qualified SLO burn rate (obs/alerts.py: min(fast, slow)
+    # window burn across alert classes) at or above which the error
+    # budget is burning fast enough to PAGE — the fleet is failing its
+    # SLO right now, so scale out even while utilization looks sane
+    # (e.g. errors from a degraded backend, not from saturation).
+    high_alert_burn: float = 2.0
     # Never recommend scaling below this many replicas.
     min_replicas: int = 1
     # Largest single-step recommendation in either direction.
@@ -74,7 +80,8 @@ class AutoscalePolicy:
 def recommend(policy: AutoscalePolicy, *, ready: int, utilization: float,
               occupancy: Optional[float] = None,
               shed_delta: float = 0.0,
-              memory_pressure: float = 0.0) -> Tuple[int, str]:
+              memory_pressure: float = 0.0,
+              alert_burn: float = 0.0) -> Tuple[int, str]:
     """Classify ONE observation into ``(direction, reason)`` with
     direction in {-1, 0, +1}.  Pure — the stateful hysteresis/shed-rate
     tracking lives in :class:`Autoscaler`."""
@@ -83,6 +90,10 @@ def recommend(policy: AutoscalePolicy, *, ready: int, utilization: float,
     if shed_delta > 0:
         return 1, (f"shed {shed_delta:g} request(s) since last "
                    "observation — capacity was refused")
+    if alert_burn >= policy.high_alert_burn:
+        return 1, (f"SLO burn rate {alert_burn:.2f} >= "
+                   f"{policy.high_alert_burn:.2f} — error budget "
+                   "burning at page rate")
     if utilization >= policy.high_utilization:
         return 1, (f"utilization {utilization:.2f} >= "
                    f"{policy.high_utilization:.2f}")
@@ -149,9 +160,13 @@ class Autoscaler:
     def observe(self, *, ready: int, utilization: float,
                 occupancy: Optional[float] = None,
                 shed_total: float = 0.0,
-                memory_pressure: float = 0.0) -> Dict[str, object]:
+                memory_pressure: float = 0.0,
+                alert_burn: float = 0.0) -> Dict[str, object]:
         """Fold one observation in; returns the advice dict surfaced in
-        ``/debug/vars`` (``delta`` is what the gauge exports)."""
+        ``/debug/vars`` (``delta`` is what the gauge exports).
+        ``alert_burn`` is the live page-qualified SLO burn
+        (``obs.alerts.BurnRateAlerts.max_burn``) — 0.0 when alerting is
+        not wired or has not evaluated yet."""
         policy = self.policy
         with self._lock:
             shed_delta = max(0.0, shed_total - self._last_shed)
@@ -159,7 +174,7 @@ class Autoscaler:
             direction, reason = recommend(
                 policy, ready=ready, utilization=utilization,
                 occupancy=occupancy, shed_delta=shed_delta,
-                memory_pressure=memory_pressure)
+                memory_pressure=memory_pressure, alert_burn=alert_burn)
             if direction == self._streak_dir:
                 self._streak += 1
             else:
@@ -183,6 +198,7 @@ class Autoscaler:
                               if occupancy is not None else None),
                 "shed_delta": shed_delta,
                 "memory_pressure": round(memory_pressure, 4),
+                "alert_burn": round(alert_burn, 4),
             },
         }
         cap = self.capacity_advice(ready)

@@ -64,12 +64,27 @@ from urllib.parse import parse_qs, quote, urlparse
 
 from ... import wire
 from ...config import RouterConfig
-from ...obs import Tracer, build_info, dump_threads, trace_response
+from ...obs import (
+    AlertClass,
+    BurnRateAlerts,
+    FleetFederator,
+    TailSampler,
+    Tracer,
+    build_info,
+    dump_threads,
+    stitch_sources,
+    trace_response,
+)
 from ...ops.autoscale import Autoscaler, load_capacity_model
 from ...utils.backoff import backoff_delay
 from ...utils.faults import FaultPlan
 from ...utils.profiling import LatencyHistogram
-from ..httpbase import WIRE_CHUNK, JsonRequestHandler
+from ..httpbase import (
+    TRACE_HEADER,
+    WIRE_CHUNK,
+    JsonRequestHandler,
+    format_trace_context,
+)
 from ..metrics import ClusterMetrics, MetricsRegistry
 from .pins import PinTable
 
@@ -456,13 +471,34 @@ class _RouterHandler(JsonRequestHandler):
             rt.refresh_gauges()
             self._send(200, rt.cluster_metrics.render().encode(),
                        "text/plain; version=0.0.4")
+        elif url.path == "/metrics/fleet":
+            # Federated fleet exposition (obs/fleet.py): the router's
+            # own families plus every backend's and the session tier's,
+            # re-labeled with backend= — one scrape for the cluster.
+            fs = rt.federate()
+            self._send(200, fs.text.encode(),
+                       "text/plain; version=0.0.4")
         elif url.path == "/debug/trace":
+            qs = parse_qs(url.query)
+            tid = (qs.get("trace_id") or [None])[0]
+            if tid:
+                # Cross-hop stitching (obs/stitch.py): fan out to every
+                # fleet member's /debug/trace and return ONE span tree —
+                # the router hop span parenting each backend's
+                # admission -> queue_wait -> dispatch -> host_fetch.
+                self._json(200, rt.stitched_trace(tid))
+                return
             try:
                 body, extra = trace_response(rt.tracer, url.query)
             except ValueError as e:
                 self._json(400, {"error": f"bad query: {e}"})
                 return
             self._send(200, body, "application/json", extra)
+        elif url.path == "/debug/alerts":
+            # One live burn-rate evaluation over a fresh federated
+            # scrape (obs/alerts.py) — also refreshes the
+            # fleet_alert_state{class=} gauges.
+            self._json(200, rt.evaluate_alerts())
         elif url.path == "/debug/threads":
             self._send(200, dump_threads().encode(), "text/plain")
         elif url.path == "/debug/vars":
@@ -479,6 +515,8 @@ class _RouterHandler(JsonRequestHandler):
                     "hop_p50_ms": round(hop.quantile(0.5) * 1e3, 3),
                     "hop_p99_ms": round(hop.quantile(0.99) * 1e3, 3),
                 } if hop.count else None),
+                "tail": rt.tail.stats(),
+                "alerts": rt.alert_summary(),
                 "build": build_info(),
             })
         else:
@@ -621,7 +659,8 @@ class _RouterHandler(JsonRequestHandler):
             return
         status, body, ctype, headers = rt.route_predict(
             raw, session_id, rid, accept=self.headers.get("Accept"),
-            deadline_ms=self._header_deadline())
+            deadline_ms=self._header_deadline(),
+            trace=self.trace_of(rid))
         self._send(status, body, ctype, headers)
 
     def _predict_stream(self, rt: "StereoRouter") -> None:
@@ -682,7 +721,8 @@ class _RouterHandler(JsonRequestHandler):
                                 length - wire.HEADER_SIZE - meta_len,
                                 session_id, rid,
                                 accept=self.headers.get("Accept"),
-                                deadline_ms=self._header_deadline())
+                                deadline_ms=self._header_deadline(),
+                                trace=self.trace_of(rid))
 
 
 class StereoRouter(ThreadingHTTPServer):
@@ -743,6 +783,21 @@ class StereoRouter(ThreadingHTTPServer):
         self._autoscaler = Autoscaler(capacity=capacity,
                                       target_rps=config.target_rps)
         self._advice: Dict[str, object] = {}
+        # Fleet observability plane (docs/observability.md): tail-based
+        # trace retention, the /metrics/fleet federator, and the live
+        # burn-rate alerts whose page-qualified burn feeds the
+        # autoscaler (refresh_gauges).
+        self.tail = TailSampler(capacity=config.tail_ring)
+        self._federator = FleetFederator(
+            self.registry, targets_fn=self._fleet_targets,
+            timeout_s=config.fleet_timeout_s)
+        self.alerts = BurnRateAlerts(
+            self.registry,
+            classes=(AlertClass(
+                max_error_rate=config.alert_error_budget,
+                max_shed_rate=config.alert_shed_budget),),
+            fast_window_s=config.alert_window_s,
+            page_burn=config.alert_page_burn)
         self._prober = _Prober(self)
         super().__init__((config.host, config.port), _RouterHandler)
 
@@ -978,7 +1033,8 @@ class StereoRouter(ThreadingHTTPServer):
                            if budget > 0 else 0.0)
         advice = self._autoscaler.observe(
             ready=len(ready), utilization=cm.utilization.value,
-            shed_total=shed, memory_pressure=memory_pressure)
+            shed_total=shed, memory_pressure=memory_pressure,
+            alert_burn=self.alerts.max_burn())
         cm.autoscale_recommendation.set(advice["delta"])
         cap = advice.get("capacity")
         # 0.0 without a model (same convention as the dispatcher).
@@ -989,9 +1045,81 @@ class StereoRouter(ThreadingHTTPServer):
     def autoscale_advice(self) -> Dict[str, object]:
         return self._advice
 
+    # ------------------------------------------------ fleet observability
+
+    def _fleet_targets(self) -> List[Tuple[str, str, int]]:
+        """Live (label, host, port) scrape/stitch targets: every
+        registered backend plus the session tier when configured.
+        Called per federation so drain/rejoin is always reflected."""
+        targets = [(b.name, b.host, b.port) for b in self.backends]
+        if self.config.session_tier is not None:
+            host, port = self.config.session_tier
+            targets.append(("session_tier", host, port))
+        return targets
+
+    def federate(self):
+        """One federated /metrics/fleet render.  The local text is
+        produced AFTER the foreign scrapes (obs/fleet.py federate doc)
+        and with gauges freshly refreshed, so the render carries both
+        its own scrape-failure increments and live advice."""
+        def local_text() -> str:
+            self.refresh_gauges()
+            return self.registry.render()
+        return self._federator.federate(local_text)
+
+    def evaluate_alerts(self) -> Dict:
+        """Fresh federated scrape -> one burn-rate evaluation.  The
+        p99 fed to the latency bound is the FULL forward p99 (connect
+        -> last byte) — what a client of this router experiences."""
+        fs = self.federate()
+        p99 = (self._fwd_latency.quantile(0.99)
+               if self._fwd_latency.count else None)
+        return self.alerts.observe(fs, p99_s=p99)
+
+    def alert_summary(self) -> Optional[Dict]:
+        """Compact /debug/vars view of the last alert evaluation
+        (None until GET /debug/alerts has evaluated once)."""
+        last = self.alerts.last()
+        if last is None:
+            return None
+        return {"classes": [{"class": c["class"],
+                             "state": c["state_name"],
+                             "burn": c["burn"]}
+                            for c in last["classes"]],
+                "page_burn": last["page_burn"]}
+
+    def stitched_trace(self, trace_id: str) -> Dict:
+        """Cross-hop stitch (obs/stitch.py): the router's own spans
+        plus every fleet member's /debug/trace export for this trace,
+        merged into one span tree.  An unreachable member becomes a
+        ``gaps`` entry — the tree is partial, never a 500."""
+        sources: List[Tuple[str, Optional[Dict]]] = [
+            ("router", self.tracer.to_chrome(trace_id=trace_id))]
+        for label, host, port in self._fleet_targets():
+            try:
+                status, doc = _http_json(
+                    host, port, "GET",
+                    "/debug/trace?trace_id=" + quote(trace_id, safe=""),
+                    timeout=self.config.fleet_timeout_s)
+                sources.append((label, doc if status == 200 else None))
+            except (OSError, ValueError):
+                sources.append((label, None))
+        return stitch_sources(trace_id, sources)
+
+    def _tail_offer(self, trace_id: Optional[str], t0: float,
+                    status: int) -> None:
+        """Feed the tail sampler one finished route: the slow threshold
+        is the live full-forward p99 once enough samples exist (early
+        traffic has no meaningful tail to compare against)."""
+        thr = (self._fwd_latency.quantile(0.99)
+               if self._fwd_latency.count >= 20 else None)
+        self.tail.offer(trace_id, time.perf_counter() - t0, status,
+                        threshold_s=thr)
+
     def _forward(self, backend: Backend, raw: bytes, rid: str,
                  accept: Optional[str] = None,
-                 deadline_left_ms: Optional[float] = None
+                 deadline_left_ms: Optional[float] = None,
+                 trace_header: Optional[str] = None
                  ) -> Tuple[str, int, bytes, str, Dict[str, str]]:
         """One proxy attempt.  Returns (phase, status, body, ctype,
         headers): phase ``"ok"`` carries a backend reply; ``"connect"``
@@ -1000,12 +1128,17 @@ class StereoRouter(ThreadingHTTPServer):
         retry); ``"timeout"`` means the backend may still be computing.
         The client's ``Accept`` forwards verbatim so the BACKEND decides
         the response dialect — the router relays bytes, it never
-        negotiates."""
+        negotiates.  ``trace_header`` is the pre-formatted
+        ``X-Trace-Context`` value continuing this hop's trace (the
+        parent is the hop span whose id was minted before the forward);
+        None keeps the wire header-compatible with pre-PR 20 callers."""
         conn = http.client.HTTPConnection(
             backend.host, backend.port,
             timeout=self.config.request_timeout_s)
         headers_out = {"Content-Type": "application/json",
                        "X-Request-Id": rid}
+        if trace_header:
+            headers_out[TRACE_HEADER] = trace_header
         if accept:
             headers_out["Accept"] = accept
         if deadline_left_ms is not None:
@@ -1039,7 +1172,8 @@ class StereoRouter(ThreadingHTTPServer):
 
     def _forward_timed(self, backend: Backend, raw: bytes, rid: str,
                        accept: Optional[str] = None,
-                       deadline_left_ms: Optional[float] = None
+                       deadline_left_ms: Optional[float] = None,
+                       trace_header: Optional[str] = None
                        ) -> Tuple[str, int, bytes, str, Dict[str, str]]:
         """``_forward`` plus the bookkeeping every attempt owes:
         inflight begin/end, the breaker verdict (any HTTP reply =
@@ -1049,7 +1183,7 @@ class StereoRouter(ThreadingHTTPServer):
         t = time.perf_counter()
         try:
             result = self._forward(backend, raw, rid, accept,
-                                   deadline_left_ms)
+                                   deadline_left_ms, trace_header)
         finally:
             backend.end()
         if result[0] == "ok":
@@ -1099,7 +1233,8 @@ class StereoRouter(ThreadingHTTPServer):
     def _forward_hedged(self, primary: Backend, raw: bytes, rid: str,
                         accept: Optional[str], tried: List[int],
                         is_session: bool,
-                        deadline_left_ms: Optional[float] = None
+                        deadline_left_ms: Optional[float] = None,
+                        trace_header: Optional[str] = None
                         ) -> Tuple[Backend, str, int, bytes, str,
                                    Dict[str, str]]:
         """Forward with an optional hedged second request (cold JSON
@@ -1115,12 +1250,16 @@ class StereoRouter(ThreadingHTTPServer):
         delay = None if is_session else self._hedge_delay_s()
         if delay is None:
             return (primary,) + self._forward_timed(
-                primary, raw, rid, accept, deadline_left_ms)
+                primary, raw, rid, accept, deadline_left_ms,
+                trace_header)
         results: "queue.Queue" = queue.Queue()
 
         def attempt(b: Backend) -> None:
+            # Both contenders carry the SAME trace header: each backend
+            # request span parents under the one hop span that covers
+            # this hedged attempt.
             results.put((b,) + self._forward_timed(
-                b, raw, rid, accept, deadline_left_ms))
+                b, raw, rid, accept, deadline_left_ms, trace_header))
 
         threading.Thread(target=attempt, args=(primary,),
                          name=f"hedge-p-{rid[:8]}", daemon=True).start()
@@ -1167,14 +1306,27 @@ class StereoRouter(ThreadingHTTPServer):
 
     def route_predict(self, raw: bytes, session_id: Optional[str],
                       rid: str, accept: Optional[str] = None,
-                      deadline_ms: Optional[float] = None
+                      deadline_ms: Optional[float] = None,
+                      trace: Optional[Tuple[Optional[str],
+                                            Optional[str]]] = None
                       ) -> Tuple[int, bytes, str, Dict[str, str]]:
         """Pick a backend and proxy; bounded failover for cold requests.
         Never blocks without a timeout and never retries work that may
         have executed unless it is idempotent (cold inference).
-        Returns (status, body, content_type, headers)."""
+        Returns (status, body, content_type, headers).
+
+        ``trace`` is the continued ``(trace_id, parent_span_id)`` from
+        the client's X-Trace-Context (httpbase.trace_of): trace_id None
+        means the client sent sampled=0 — every span this route records
+        silently no-ops (obs/trace.py) and the header forwarded to the
+        backend says sampled=0 too.  Default (direct callers, tests)
+        keeps the pre-PR 20 behavior: rid doubles as the trace id."""
         cfg = self.config
         t0 = time.perf_counter()
+        tid, t_parent = trace if trace is not None else (rid, None)
+        # The route span's id is minted up front so every hop span can
+        # parent under it even though the route span is recorded last.
+        route_sid = self.tracer.new_span_id()
         is_session = session_id is not None
         attempts = cfg.retries + 1
         tried: List[int] = []
@@ -1190,9 +1342,11 @@ class StereoRouter(ThreadingHTTPServer):
                     # here is cheaper than letting a backend compute a
                     # disparity nobody reads.
                     self.tracer.record(
-                        "route", t0, time.perf_counter(), rid,
+                        "route", t0, time.perf_counter(), tid,
+                        parent_id=t_parent, span_id=route_sid,
                         attrs={"attempts": len(tried), "status": 504,
                                "detail": "deadline exhausted"})
+                    self._tail_offer(tid, t0, 504)
                     return 504, json.dumps(
                         {"error": "timeout",
                          "detail": "deadline exhausted at the router "
@@ -1217,11 +1371,19 @@ class StereoRouter(ThreadingHTTPServer):
                                          attempt - 1))
             spilled_shed = False
             t_fwd = time.perf_counter()
+            # Hop span id is minted BEFORE the forward: it leaves in the
+            # X-Trace-Context header as the backend's parent, and the
+            # span itself is recorded once the forward returns.
+            hop_sid = self.tracer.new_span_id()
+            hdr = format_trace_context(tid or rid,
+                                       hop_sid if tid else None,
+                                       sampled=tid is not None)
             backend, phase, status, body, ctype, headers = \
                 self._forward_hedged(backend, raw, rid, accept, tried,
-                                     is_session, left_ms)
+                                     is_session, left_ms, hdr)
             self.tracer.record(
-                "router_hop", t_fwd, time.perf_counter(), rid,
+                "router_hop", t_fwd, time.perf_counter(), tid,
+                parent_id=route_sid, span_id=hop_sid,
                 attrs={"backend": backend.name, "attempt": attempt,
                        "phase": phase, "status": status,
                        "session": is_session})
@@ -1251,15 +1413,18 @@ class StereoRouter(ThreadingHTTPServer):
                 # forward began (route pick, failed attempts, backoffs)
                 # — the backend's own compute is excluded.
                 self.cluster_metrics.router_latency.observe(t_fwd - t0)
-                self.tracer.record("route", t0, time.perf_counter(), rid,
+                self.tracer.record("route", t0, time.perf_counter(), tid,
+                                   parent_id=t_parent, span_id=route_sid,
                                    attrs={"backend": backend.name,
                                           "attempts": attempt + 1,
                                           "status": status})
+                self._tail_offer(tid, t0, status)
                 return status, body, ctype, headers
             if phase == "timeout":
                 # The backend may still be computing: a blind retry would
                 # run inference twice AND double the client's wait.
                 self._record(backend, "timeout")
+                self._tail_offer(tid, t0, 504)
                 return 504, json.dumps(
                     {"error": "timeout",
                      "detail": f"backend {backend.name} exceeded "
@@ -1269,6 +1434,7 @@ class StereoRouter(ThreadingHTTPServer):
                 # The frame may have executed; a duplicate would advance
                 # the session state.  Fail clean, client decides.
                 self._record(backend, "error")
+                self._tail_offer(tid, t0, 503)
                 return 503, json.dumps(
                     {"error": "unavailable",
                      "detail": f"backend {backend.name} failed "
@@ -1281,9 +1447,11 @@ class StereoRouter(ThreadingHTTPServer):
                          else "failover")
             detail = f"backend {backend.name} {phase} failure"
         self.refresh_gauges()
-        self.tracer.record("route", t0, time.perf_counter(), rid,
+        self.tracer.record("route", t0, time.perf_counter(), tid,
+                           parent_id=t_parent, span_id=route_sid,
                            attrs={"attempts": len(tried), "status": 503,
                                   "detail": detail})
+        self._tail_offer(tid, t0, 503)
         return 503, json.dumps(
             {"error": "unavailable", "detail": detail,
              "attempts": len(tried)}).encode(), "application/json", \
@@ -1295,7 +1463,9 @@ class StereoRouter(ThreadingHTTPServer):
                              remaining: int, session_id: Optional[str],
                              rid: str,
                              accept: Optional[str] = None,
-                             deadline_ms: Optional[float] = None
+                             deadline_ms: Optional[float] = None,
+                             trace: Optional[Tuple[Optional[str],
+                                                   Optional[str]]] = None
                              ) -> None:
         """Forward a binary /predict without ever holding the full body.
 
@@ -1316,6 +1486,9 @@ class StereoRouter(ThreadingHTTPServer):
         """
         cfg = self.config
         t0 = time.perf_counter()
+        tid, t_parent = trace if trace is not None else (rid, None)
+        route_sid = self.tracer.new_span_id()
+        hop_sid = ""
         is_session = session_id is not None
         attempts = cfg.retries + 1
         tried: List[int] = []
@@ -1331,6 +1504,7 @@ class StereoRouter(ThreadingHTTPServer):
                     # drain it first so the reply lands on a keep-alive
                     # connection in a defined state.
                     self._drain_client(handler, remaining)
+                    self._tail_offer(tid, t0, 504)
                     self._json_reply(
                         handler, 504,
                         {"error": "timeout",
@@ -1352,12 +1526,19 @@ class StereoRouter(ThreadingHTTPServer):
             conn = http.client.HTTPConnection(
                 backend.host, backend.port,
                 timeout=cfg.request_timeout_s)
+            # Same pre-minted hop-span-id discipline as the JSON path:
+            # the id leaves in the header now, the span records after
+            # the relay completes.
+            hop_sid = self.tracer.new_span_id()
             try:
                 conn.putrequest("POST", "/predict")
                 conn.putheader("Content-Type", wire.WIRE_CONTENT_TYPE)
                 conn.putheader("Content-Length",
                                str(len(prefix) + remaining))
                 conn.putheader("X-Request-Id", rid)
+                conn.putheader(TRACE_HEADER, format_trace_context(
+                    tid or rid, hop_sid if tid else None,
+                    sampled=tid is not None))
                 if accept:
                     conn.putheader("Accept", accept)
                 if left_ms is not None:
@@ -1376,6 +1557,7 @@ class StereoRouter(ThreadingHTTPServer):
             break
         if conn is None or backend is None:
             self.refresh_gauges()
+            self._tail_offer(tid, t0, 503)
             self._json_reply(handler, 503,
                              {"error": "unavailable", "detail": detail,
                               "attempts": len(tried)},
@@ -1418,6 +1600,7 @@ class StereoRouter(ThreadingHTTPServer):
                 backend.breaker.record_failure()
                 self._record(backend, "error")
                 self._drain_client(handler, left)
+                self._tail_offer(tid, t0, 503)
                 self._json_reply(
                     handler, 503,
                     {"error": "unavailable",
@@ -1430,6 +1613,7 @@ class StereoRouter(ThreadingHTTPServer):
             except socket.timeout:
                 backend.breaker.record_failure()
                 self._record(backend, "timeout")
+                self._tail_offer(tid, t0, 504)
                 self._json_reply(
                     handler, 504,
                     {"error": "timeout",
@@ -1441,6 +1625,7 @@ class StereoRouter(ThreadingHTTPServer):
                 backend.mark_unreachable()
                 backend.breaker.record_failure()
                 self._record(backend, "error")
+                self._tail_offer(tid, t0, 503)
                 self._json_reply(
                     handler, 503,
                     {"error": "unavailable",
@@ -1465,10 +1650,17 @@ class StereoRouter(ThreadingHTTPServer):
             m.wire_stream_bytes.labels(direction="out").inc(received)
             m.wire_stream_peak_chunk.set(peak_seen)
             self.tracer.record(
-                "route", t0, time.perf_counter(), rid,
+                "router_hop", t_fwd, time.perf_counter(), tid,
+                parent_id=route_sid, span_id=hop_sid,
+                attrs={"backend": backend.name, "phase": "ok",
+                       "status": resp.status, "stream": True})
+            self.tracer.record(
+                "route", t0, time.perf_counter(), tid,
+                parent_id=t_parent, span_id=route_sid,
                 attrs={"backend": backend.name, "attempts": len(tried),
                        "status": resp.status, "stream": True,
                        "bytes_in": sent, "bytes_out": received})
+            self._tail_offer(tid, t0, resp.status)
         finally:
             backend.end()
             conn.close()

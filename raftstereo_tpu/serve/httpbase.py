@@ -31,16 +31,78 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import uuid
 from http.server import BaseHTTPRequestHandler
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
-__all__ = ["JsonRequestHandler", "WIRE_CHUNK"]
+__all__ = ["JsonRequestHandler", "WIRE_CHUNK", "TRACE_HEADER",
+           "TraceContext", "parse_trace_context", "format_trace_context"]
 
 #: chunk size for the streaming body reader — also the upper bound on
 #: what a streaming consumer (router forward, frame decoder) ever
 #: buffers of the raw body at once.
 WIRE_CHUNK = 64 * 1024
+
+#: cross-hop trace context header (docs/observability.md).  Key-value
+#: (not positional) because client-chosen ``X-Request-Id`` values — which
+#: double as trace ids on the first hop — may themselves contain dashes
+#: or dots, so no separator charset is safe for splitting.
+TRACE_HEADER = "X-Trace-Context"
+
+# trace id: whatever the X-Request-Id charset allows (it IS the trace id
+# on un-headered requests); span id: the tracer's 16-hex form, but accept
+# any short token — a foreign parent id is harmless, it just won't join.
+_TRACE_TOKEN = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+_SPAN_TOKEN = re.compile(r"^[A-Za-z0-9._-]{1,32}$")
+
+
+class TraceContext(NamedTuple):
+    """Parsed ``X-Trace-Context``: the identity a request carries across
+    hops.  ``sampled=False`` means "count me, don't span me" — every hop
+    suppresses span recording but still serves the request normally."""
+
+    trace_id: str
+    parent_id: Optional[str]
+    sampled: bool
+
+
+def parse_trace_context(value: Optional[str]) -> Optional[TraceContext]:
+    """Parse a ``trace=<id>;parent=<spanid>;sampled=<0|1>`` header.
+
+    Returns None for absent, malformed, or foreign-format values — the
+    receiving hop then mints a fresh trace.  NEVER raises: a bad trace
+    header must not be able to 500 a request (tests/test_obs.py)."""
+    if not value or len(value) > 256:
+        return None
+    fields: Dict[str, str] = {}
+    for part in value.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, val = part.partition("=")
+        if not sep:
+            return None
+        fields[key.strip().lower()] = val.strip()
+    trace_id = fields.get("trace", "")
+    if not _TRACE_TOKEN.match(trace_id):
+        return None
+    parent = fields.get("parent") or None
+    if parent is not None and not _SPAN_TOKEN.match(parent):
+        return None
+    sampled = fields.get("sampled", "1")
+    if sampled not in ("0", "1"):
+        return None
+    return TraceContext(trace_id, parent, sampled == "1")
+
+
+def format_trace_context(trace_id: str, parent_id: Optional[str] = None,
+                         sampled: bool = True) -> str:
+    """Render the ``X-Trace-Context`` value for an outbound hop."""
+    out = f"trace={trace_id}"
+    if parent_id:
+        out += f";parent={parent_id}"
+    return out + f";sampled={'1' if sampled else '0'}"
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -65,6 +127,29 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         state would leak one request's id into the next."""
         return (self.headers.get("X-Request-Id") or "")[:64] \
             or uuid.uuid4().hex
+
+    def trace_context(self) -> Optional[TraceContext]:
+        """Parsed inbound ``X-Trace-Context``, or None (fresh trace).
+
+        Computed per call, never cached on ``self`` — same keep-alive
+        reuse hazard as ``request_id``."""
+        return parse_trace_context(self.headers.get(TRACE_HEADER))
+
+    def trace_of(self, rid: str) -> Tuple[Optional[str], Optional[str]]:
+        """(trace_id, parent_span_id) this request's spans should carry.
+
+        A valid inbound context is CONTINUED (its trace id + parent span
+        id); ``sampled=0`` yields trace_id None, which ``Tracer.record``
+        treats as "don't record" — the one central guard that makes the
+        sampled flag hold end-to-end without per-callsite plumbing.  No
+        (or malformed) context: the request id doubles as the trace id,
+        exactly the pre-stitching behaviour."""
+        ctx = self.trace_context()
+        if ctx is None:
+            return rid, None
+        if not ctx.sampled:
+            return None, None
+        return ctx.trace_id, ctx.parent_id
 
     def _maybe_blackhole(self) -> float:
         """``blackhole_backend@t_ms`` chaos seam (utils/faults.py):

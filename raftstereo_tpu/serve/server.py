@@ -65,7 +65,7 @@ import logging
 import threading
 import time
 from http.server import ThreadingHTTPServer
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Optional, Tuple, Union
 from urllib.parse import unquote, urlparse
 
 import numpy as np
@@ -274,6 +274,13 @@ class _Handler(JsonRequestHandler):
     # the top of every /predict before any dispatch can read it.
     _wire_ctx: Optional[Dict] = None
 
+    # (trace_id, parent_span_id) continued from the CURRENT /predict
+    # request's X-Trace-Context (httpbase.trace_of) — trace_id None
+    # means the upstream said sampled=0 and every span this request
+    # records silently no-ops (obs/trace.py).  Reset per request for
+    # the same keep-alive reuse reason as _wire_ctx.
+    _trace: Optional[Tuple[Optional[str], Optional[str]]] = None
+
     # ------------------------------------------------------------- plumbing
     # (_send/_json/_reject_body come from JsonRequestHandler, shared
     # byte-for-byte with the cluster router's handler.)
@@ -295,7 +302,9 @@ class _Handler(JsonRequestHandler):
         # therefore excludes the response write itself.
         outcome = _outcome(code, obj)
         srv.metrics.requests.labels(endpoint=endpoint, outcome=outcome).inc()
-        srv.tracer.record("request", t0, time.perf_counter(), rid,
+        tid, parent = self._trace if self._trace is not None else (rid, None)
+        srv.tracer.record("request", t0, time.perf_counter(), tid,
+                          parent_id=parent,
                           attrs={"endpoint": endpoint, "status": code,
                                  "outcome": outcome})
         body = json.dumps(obj).encode()
@@ -320,7 +329,9 @@ class _Handler(JsonRequestHandler):
         srv.metrics.wire_bytes.labels(
             direction="out", format="binary").inc(len(frame))
         srv.metrics.requests.labels(endpoint=endpoint, outcome="ok").inc()
-        srv.tracer.record("request", t0, time.perf_counter(), rid,
+        tid, parent = self._trace if self._trace is not None else (rid, None)
+        srv.tracer.record("request", t0, time.perf_counter(), tid,
+                          parent_id=parent,
                           attrs={"endpoint": endpoint, "status": 200,
                                  "outcome": "ok"})
         self._send(200, frame, wire.WIRE_CONTENT_TYPE,
@@ -559,6 +570,11 @@ class _Handler(JsonRequestHandler):
         # and the backend's spans share one trace (docs/observability.md).
         rid = (self.headers.get("X-Request-Id") or "")[:64] \
             or srv.tracer.new_trace_id()
+        # Cross-hop trace continuation: a valid X-Trace-Context pins
+        # this request's spans to the upstream trace (the parent is the
+        # router's hop span); absent/malformed falls back to rid-as-
+        # trace-id, sampled=0 suppresses every span.
+        self._trace = self.trace_of(rid)
         t_req0 = time.perf_counter()
         endpoint = "predict"
         # Reset per request — the handler instance is reused across
@@ -710,6 +726,10 @@ class _Handler(JsonRequestHandler):
                           spatial=None) -> None:
         """Validation + dispatch of one admitted (gate-passed, decoded,
         in-flight-counted) /predict request."""
+        # Downstream span recording (admission, batcher/scheduler
+        # phases, stream warp) keys on the CONTINUED trace id — None
+        # (sampled=0) makes every one a no-op without flag plumbing.
+        tid = (self._trace or (rid, None))[0]
         mode = None
         cascade = None
         use_spatial = False
@@ -895,7 +915,7 @@ class _Handler(JsonRequestHandler):
             return
         # Decode + validation done: the admission span closes where the
         # request either enters the batcher queue or the session path.
-        srv.tracer.record("admission", t_req0, time.perf_counter(), rid,
+        srv.tracer.record("admission", t_req0, time.perf_counter(), tid,
                           attrs={"endpoint": endpoint,
                                  "shape": list(left.shape)})
         if use_spatial:
@@ -924,7 +944,7 @@ class _Handler(JsonRequestHandler):
                 srv.stream_inflight += 1
             try:
                 res = srv.stream.step(session_id, seq_no, left, right,
-                                      trace_id=rid, mode=mode)
+                                      trace_id=tid, mode=mode)
             except Overloaded as e:
                 # Sched mode: the frame is a scheduler job and admission
                 # can shed it there too — same backpressure contract as
@@ -989,7 +1009,7 @@ class _Handler(JsonRequestHandler):
         try:
             if srv.scheduler is not None:
                 kwargs = dict(iters=iters, priority=priority,
-                              deadline_ms=deadline_ms, trace_id=rid,
+                              deadline_ms=deadline_ms, trace_id=tid,
                               mode=mode)
                 if cascade is not None:
                     # Keyword only when set: in cluster mode the
@@ -1000,7 +1020,7 @@ class _Handler(JsonRequestHandler):
                 fut = srv.scheduler.submit(left, right, **kwargs)
             else:
                 fut = srv.batcher.submit(left, right, iters,
-                                         trace_id=rid, mode=mode)
+                                         trace_id=tid, mode=mode)
         except ValueError as e:  # bad priority/deadline/target (sched)
             self._finish(400, {"error": f"bad request: {e}"},
                          endpoint, rid, t_req0)
@@ -1065,6 +1085,7 @@ class _Handler(JsonRequestHandler):
         by queue_limit, the same backpressure contract as the session
         path (decoded 4K pairs held in unboundedly many blocked threads
         would grow host RSS exactly like an unbounded queue)."""
+        tid = (self._trace or (rid, None))[0]
         with srv.spatial_inflight_lock:
             if srv.spatial_inflight >= srv.config.queue_limit:
                 srv.metrics.shed.inc()
@@ -1089,7 +1110,7 @@ class _Handler(JsonRequestHandler):
             with srv.spatial_inflight_lock:
                 srv.spatial_inflight -= 1
         t1 = time.perf_counter()
-        srv.tracer.record("spatial_dispatch", t0, t1, rid,
+        srv.tracer.record("spatial_dispatch", t0, t1, tid,
                           attrs={"shards": srv.engine.spatial_shards,
                                  "iters": iters, "compile": compiled})
         srv.metrics.spatial_requests.labels(outcome="ok").inc()

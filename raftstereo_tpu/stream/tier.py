@@ -50,6 +50,7 @@ from http.server import ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..config import TierConfig
+from ..obs import Tracer, build_info, trace_response
 from ..serve.httpbase import JsonRequestHandler
 from ..serve.metrics import MetricsRegistry
 from ..utils.backoff import backoff_delay
@@ -189,7 +190,14 @@ class _TierHandler(JsonRequestHandler):
     def do_GET(self):
         srv: "SessionTier" = self.server
         self._chaos_gate()
-        path = self.path.split("?", 1)[0]
+        # Observability parity with the router/backends (PR 20): every
+        # reply carries X-Request-Id, tier ops continue the caller's
+        # X-Trace-Context, and /debug/trace + /debug/vars exist so the
+        # tier is a first-class stitch/federation target.
+        rid = self.request_id()
+        hdrs = {"X-Request-Id": rid}
+        t0 = time.perf_counter()
+        path, _, query = self.path.partition("?")
         if path == "/healthz":
             store = srv.store
             self._json(200, {
@@ -200,33 +208,62 @@ class _TierHandler(JsonRequestHandler):
                 "session_bytes": store.total_bytes(),
                 "session_limit": store.limit,
                 "budget_mb": srv.config.budget_mb,
-            })
+            }, hdrs)
         elif path == "/metrics":
             self._send(200, srv.metrics.render().encode(),
-                       "text/plain; version=0.0.4")
+                       "text/plain; version=0.0.4", hdrs)
+        elif path == "/debug/trace":
+            try:
+                body, extra = trace_response(srv.tracer, query)
+            except ValueError as e:
+                self._json(400, {"error": f"bad query: {e}"}, hdrs)
+                return
+            extra = dict(extra, **hdrs)
+            self._send(200, body, "application/json", extra)
+        elif path == "/debug/vars":
+            store = srv.store
+            self._json(200, {
+                "sessions": len(store),
+                "session_bytes": store.total_bytes(),
+                "session_limit": store.limit,
+                "budget_mb": srv.config.budget_mb,
+                "build": build_info(),
+            }, hdrs)
         elif path.startswith("/debug/sessions/"):
             from urllib.parse import unquote
 
             sid = unquote(path[len("/debug/sessions/"):])
             body = srv.store.get(sid)
+            tid, parent = self.trace_of(rid)
             if body is None:
                 srv.metrics.requests.labels(op="get", outcome="miss").inc()
+                srv.tracer.record("tier_get", t0, time.perf_counter(),
+                                  tid, parent_id=parent,
+                                  attrs={"outcome": "miss"})
                 self._json(404, {"error": f"no snapshot for session "
-                                          f"{sid!r}"})
+                                          f"{sid!r}"}, hdrs)
             else:
                 srv.metrics.requests.labels(op="get", outcome="ok").inc()
-                self._send(200, body, "application/json")
+                srv.tracer.record("tier_get", t0, time.perf_counter(),
+                                  tid, parent_id=parent,
+                                  attrs={"outcome": "ok",
+                                         "bytes": len(body)})
+                self._send(200, body, "application/json", hdrs)
         else:
-            self._json(404, {"error": f"unknown path {path!r}"})
+            self._json(404, {"error": f"unknown path {path!r}"}, hdrs)
 
     def do_POST(self):
         srv: "SessionTier" = self.server
         self._chaos_gate()
+        rid = self.request_id()
+        hdrs = {"X-Request-Id": rid}
+        t0 = time.perf_counter()
         path = self.path.split("?", 1)[0]
         if path == "/debug/sessions":
             raw = self._read_body(srv.config.max_body_mb)
             if raw is None:
                 return
+            tid, parent = self.trace_of(rid)
             try:
                 obj = json.loads(raw)
                 sid = str(obj["session_id"])
@@ -234,14 +271,21 @@ class _TierHandler(JsonRequestHandler):
             except Exception:
                 srv.metrics.requests.labels(
                     op="put", outcome="bad_request").inc()
+                srv.tracer.record("tier_put", t0, time.perf_counter(),
+                                  tid, parent_id=parent,
+                                  attrs={"outcome": "bad_request"})
                 self._json(400, {"error": "bad snapshot: session_id and "
-                                          "next_seq required"})
+                                          "next_seq required"}, hdrs)
                 return
             outcome = srv.store.put(sid, raw, next_seq)
             srv.metrics.requests.labels(
                 op="put",
                 outcome="ok" if outcome == "stored" else outcome).inc()
-            self._json(200, {"session_id": sid, "outcome": outcome})
+            srv.tracer.record("tier_put", t0, time.perf_counter(),
+                              tid, parent_id=parent,
+                              attrs={"outcome": outcome,
+                                     "bytes": len(raw)})
+            self._json(200, {"session_id": sid, "outcome": outcome}, hdrs)
         elif path == "/debug/faults":
             raw = self._read_body(srv.config.max_body_mb)
             if raw is None:
@@ -250,11 +294,11 @@ class _TierHandler(JsonRequestHandler):
                 spec = json.loads(raw or b"{}").get("faults", "")
                 armed = srv.fault_plan.extend(str(spec or ""))
             except ValueError as e:
-                self._json(400, {"error": f"bad fault spec: {e}"})
+                self._json(400, {"error": f"bad fault spec: {e}"}, hdrs)
                 return
-            self._json(200, {"armed": [f.spec() for f in armed]})
+            self._json(200, {"armed": [f.spec() for f in armed]}, hdrs)
         else:
-            self._json(404, {"error": f"unknown path {path!r}"})
+            self._json(404, {"error": f"unknown path {path!r}"}, hdrs)
 
 
 class SessionTier(ThreadingHTTPServer):
@@ -273,6 +317,9 @@ class SessionTier(ThreadingHTTPServer):
                            else FaultPlan.from_env()).arm()
         self.store = _TierStore(config.session_limit, config.budget_mb,
                                 self.metrics)
+        # Small ring: tier ops are tiny spans, and the tier is one
+        # stitch source among many (GET /debug/trace serves it).
+        self.tracer = Tracer(capacity=512)
         super().__init__((config.host, config.port), _TierHandler)
 
     @property

@@ -29,7 +29,7 @@ class TestHelpRegression:
 
     SUBCOMMANDS = ["train", "evaluate", "demo", "serve", "convert",
                    "sl", "sl_smoke", "stream", "router", "certify",
-                   "loadgen"]
+                   "loadgen", "sessiontier", "obs"]
 
     @pytest.mark.parametrize("name", SUBCOMMANDS)
     def test_help_exits_zero(self, name, capsys):
@@ -66,6 +66,35 @@ class TestHelpRegression:
         assert ei.value.code == 0
         out = capsys.readouterr().out
         assert "--cascades" in out and "--cascade_divergence" in out
+
+    def test_router_help_lists_observability_flags(self, capsys):
+        # The fleet-observatory knobs (docs/observability.md "Fleet
+        # observatory") must stay wired through add_router_args.
+        from raftstereo_tpu.cli import router
+
+        with pytest.raises(SystemExit) as ei:
+            router.main(["--help"])
+        assert ei.value.code == 0
+        out = capsys.readouterr().out
+        for flag in ("--tail_ring", "--alert_window_s",
+                     "--alert_error_budget", "--alert_shed_budget",
+                     "--alert_page_burn", "--fleet_timeout_s"):
+            assert flag in out, flag
+
+    @pytest.mark.parametrize("verb,flags", [
+        ("trace", ("--trace_id", "--out")),
+        ("fleet", ("--router",)),
+        ("alerts", ("--watch",)),
+    ])
+    def test_obs_verb_help(self, verb, flags, capsys):
+        from raftstereo_tpu.cli import obs
+
+        with pytest.raises(SystemExit) as ei:
+            obs.main([verb, "--help"])
+        assert ei.value.code == 0
+        out = capsys.readouterr().out
+        for flag in flags:
+            assert flag in out, flag
 
 
 class TestViz:

@@ -1450,6 +1450,284 @@ class TestRouter:
                     pass
                 th.join(5)
 
+    def test_fleet_observatory_e2e(self, cluster_model, retrace_guard):
+        """THE acceptance gate (ISSUE 20): the fleet observatory over a
+        REAL router + 2-backend + session-tier cluster under a
+        zero-compile retrace budget, with a chaos-grammar fault window
+        (utils/faults.py) declared mid-replay.
+
+        (a) one ``GET /debug/trace?trace_id=`` returns ONE stitched
+        tree in which the router's hop span is an ancestor of the
+        backend's admission -> queue_wait -> dispatch -> host_fetch
+        spans;
+        (b) the tail sampler provably retains the fault window's
+        slow/error traces while dropping the fast-path bulk;
+        (c) ONE ``GET /metrics/fleet`` scrape passes the exposition
+        validator and its per-backend-labeled counter sums equal the
+        individual backends' own scrapes;
+        (d) the burn-rate alert fires during the declared fault window,
+        clears in recovery, and the autoscaler's advice reflects it.
+        """
+        from raftstereo_tpu.obs import validate_prometheus
+        from raftstereo_tpu.obs.prom import parse_text
+        from raftstereo_tpu.serve.httpbase import (TRACE_HEADER,
+                                                   format_trace_context)
+        from raftstereo_tpu.serve.server import encode_array
+        from raftstereo_tpu.stream.tier import build_session_tier
+
+        model, variables = cluster_model
+        tier = build_session_tier(TierConfig(port=0))
+        tt = threading.Thread(target=tier.serve_forever, daemon=True)
+        tt.start()
+        tier_addr = ("127.0.0.1", tier.port)
+        stream_cfg = StreamConfig(ladder=(2, 1), tier=tier_addr)
+        # b0 is the fault-window victim: a tiny queue so an overload
+        # storm sheds (outcome="shed" burns the shed budget fleet-wide).
+        cfg0 = _cfg(warmup=True, iters=2, degraded_iters=2,
+                    stream=stream_cfg, stream_warmup=True, cluster=None,
+                    max_batch_size=1, queue_limit=2)
+        b0 = build_server(model, variables, cfg0)
+        t0 = threading.Thread(target=b0.serve_forever, daemon=True)
+        t0.start()
+        b1, t1 = self._backend(cluster_model, stream=stream_cfg)
+        servers = {"b0": (b0, t0), "b1": (b1, t1)}
+        # Tight alert windows (fast 1s / slow 5s) so fire-and-clear
+        # fits a test: page at burn >= 2 on a 25% shed budget.
+        router = build_router(RouterConfig(
+            port=0, backends=(("127.0.0.1", b0.port),
+                              ("127.0.0.1", b1.port)),
+            probe_interval_s=0.15, fail_after=1, retries=2,
+            retry_backoff_ms=20.0, request_timeout_s=60.0,
+            session_tier=tier_addr, alert_window_s=1.0,
+            alert_shed_budget=0.25, alert_page_burn=2.0,
+            fleet_timeout_s=10.0))
+        rt = threading.Thread(target=router.serve_forever, daemon=True)
+        rt.start()
+        client = ServeClient("127.0.0.1", router.port, timeout=120,
+                             retries=2)
+        frames = [_img(60, 90, 300 + i) for i in range(4)]
+        body = json.dumps({"left": encode_array(frames[0]),
+                           "right": encode_array(frames[0])}).encode()
+
+        def alerts_eval():
+            status, raw, _ = client._request("GET", "/debug/alerts")
+            assert status == 200, raw
+            return json.loads(raw)["classes"][0]
+
+        try:
+            deadline = time.perf_counter() + 60
+            while time.perf_counter() < deadline:
+                h = client.healthz()
+                if all(h["backends"][n]["state"] == "ready"
+                       for n in ("b0", "b1")):
+                    break
+                time.sleep(0.1)
+            assert h["backends"]["b0"]["state"] == "ready"
+            assert h["backends"]["b1"]["state"] == "ready"
+            for name, (srv, _th) in servers.items():
+                direct = ServeClient("127.0.0.1", srv.port, timeout=120)
+                direct.predict(frames[0], frames[0])
+                direct.close()
+
+            with retrace_guard(0, what="observatory reads run beside "
+                                       "steady-state traffic; the fault "
+                                       "window sheds and sleeps, it "
+                                       "never compiles",
+                               min_duration_s=0.5):
+                # Steady state: 100 fast JSON requests through the
+                # router — they seed the live forward p99 the tail
+                # sampler thresholds against.
+                load = run_load(
+                    "127.0.0.1", router.port,
+                    lambda i: (frames[i % 4], frames[i % 4]),
+                    requests=100, concurrency=4, timeout=120,
+                    retries=2, wire_format="json")
+                assert load["ok"] == 100, load
+                base = alerts_eval()
+                assert base["state_name"] == "ok"
+
+                # ---- (a) the traced request: a client-minted trace
+                # context continued router -> backend over HTTP.
+                status, raw, _ = client._request(
+                    "POST", "/predict", body,
+                    headers={"Content-Type": "application/json",
+                             "X-Request-Id": "rid-e2e",
+                             TRACE_HEADER: format_trace_context(
+                                 "tr-e2e", "client-span")})
+                assert status == 200, raw
+                status, raw, _ = client._request(
+                    "GET", "/debug/trace?trace_id=tr-e2e")
+                assert status == 200
+                doc = json.loads(raw)
+                assert doc["stitch"]["gaps"] == []
+                assert set(doc["stitch"]["sources"]) >= \
+                    {"router", "b0", "b1", "session_tier"}
+                root = doc["tree"][0]["span"]
+                assert (root["source"], root["name"]) == ("router",
+                                                          "route")
+                assert root["parent_id"] == "client-span"
+                hop = doc["tree"][0]["children"][0]
+                assert hop["span"]["name"] == "router_hop"
+
+                def descend(node, out):
+                    for ch in node["children"]:
+                        out.append((ch["span"]["source"],
+                                    ch["span"]["name"]))
+                        descend(ch, out)
+                below_hop = []
+                descend(hop, below_hop)
+                backend_src = below_hop[0][0]
+                assert backend_src in ("b0", "b1")
+                names = {n for s, n in below_hop if s == backend_src}
+                assert {"request", "admission", "queue_wait",
+                        "dispatch", "host_fetch"} <= names, below_hop
+
+                # ---- (b)+(d) the declared fault window:
+                # slow_replica makes b0's next dispatch sleep, and an
+                # overload storm against its 2-deep queue sheds.
+                vc = ServeClient("127.0.0.1", b0.port, timeout=30)
+                status, raw, _ = vc._request(
+                    "POST", "/debug/faults",
+                    json.dumps({"faults":
+                                "slow_replica@request=1:1.5"}).encode())
+                assert status == 200, raw
+                vc.close()
+                # Barrier-released storm: all 12 requests hit b0 while
+                # the 1.5s fault holds its single-dispatch engine, so
+                # the 2-deep queue sheds >= 7 even on a loaded host —
+                # enough that shed_rate >= 0.5 over the alert window
+                # (>= 2x the 25% budget, the page threshold below).
+                outcomes = {"ok": [], "shed": []}
+                gate = threading.Barrier(12)
+
+                def storm():
+                    c = ServeClient("127.0.0.1", b0.port, timeout=30)
+                    try:
+                        gate.wait(30)
+                        c.predict(frames[0], frames[0])
+                        outcomes["ok"].append(1)
+                    except ServeError as e:
+                        assert e.status == 503, e
+                        assert e.payload["error"] == "overloaded"
+                        outcomes["shed"].append(1)
+                    finally:
+                        c.close()
+
+                threads = [threading.Thread(target=storm)
+                           for _ in range(12)]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join(60)
+                assert len(outcomes["shed"]) >= 7, outcomes
+                # A slow trace through the router inside the window:
+                # both backends armed so the cold pick lands slow
+                # either way.
+                for name, (srv, _th) in servers.items():
+                    c = ServeClient("127.0.0.1", srv.port, timeout=30)
+                    c._request("POST", "/debug/faults", json.dumps(
+                        {"faults": "slow_replica@request=1:8.0"}
+                    ).encode())
+                    c.close()
+                status, _, _, _ = router.route_predict(
+                    body, None, "rid-slow", trace=("tr-slow", None))
+                assert status == 200
+                # An error trace: the client budget dies at the router
+                # hop — 504 without touching a backend.
+                status, _, _, _ = router.route_predict(
+                    body, None, "rid-dead", deadline_ms=1e-6,
+                    trace=("tr-dead", None))
+                assert status == 504
+
+                # The alert FIRES inside the window: the storm's sheds
+                # burn the 25% shed budget at >= page rate in both
+                # windows, and the autoscaler sees it.
+                fired = alerts_eval()
+                assert fired["state_name"] == "page", fired
+                assert fired["burn"] >= 2.0
+                router.refresh_gauges()
+                adv = router.autoscale_advice
+                assert adv["signals"]["alert_burn"] >= 2.0, adv
+                assert "burn" in adv["reason"], adv
+
+                # Tail retention: the fault window's error + slow
+                # traces are kept, the 100-request fast bulk dropped.
+                assert "tr-dead" in router.tail
+                assert "tr-slow" in router.tail
+                kept = {r["trace_id"]: r["why"]
+                        for r in router.tail.retained()}
+                assert kept["tr-dead"] == "error"
+                assert kept["tr-slow"] == "slow"
+                stats = router.tail.stats()
+                assert stats["dropped"] >= 50, stats
+                # The fast-path bulk is provably NOT retained: at most
+                # the fault-window traces plus a borderline keep sit in
+                # the ring while 100+ steady-state routes were offered.
+                assert stats["kept"] <= 4, router.tail.retained()
+
+                # Spend the leftover armed fault outside any timing
+                # assertion (count-valued faults persist until fired):
+                # tr-slow fired on one backend only, so hit BOTH
+                # directly — the recovery loop below must never absorb
+                # a surprise 8s dispatch.
+                for name, (srv, _th) in servers.items():
+                    direct = ServeClient("127.0.0.1", srv.port,
+                                         timeout=60)
+                    direct.predict(frames[1], frames[1])
+                    direct.close()
+
+                # ---- (c) ONE federated scrape: validator-clean, and
+                # per-backend sums equal the backends' own scrapes
+                # (no traffic between the two reads).
+                status, raw, _ = client._request("GET", "/metrics/fleet")
+                assert status == 200
+                fleet_text = raw.decode()
+                assert validate_prometheus(fleet_text) == []
+                assert 'fleet_scrape_failures_total{backend=' \
+                    not in fleet_text
+                fleet = parse_text(fleet_text)
+                m = fleet.get("serve_requests_total")
+                sums = {}
+                for litems, value in m.series("serve_requests_total"):
+                    b = dict(litems)["backend"]
+                    sums[b] = sums.get(b, 0.0) + value
+                for name, (srv, _th) in servers.items():
+                    own = parse_text(srv.metrics.render())
+                    own_total = own.total("serve_requests_total")
+                    assert sums[name] == own_total, (name, sums)
+                # the tier is federated too, under its own label
+                assert 'fleet_scrapes_total{backend="session_tier"}' \
+                    in fleet_text
+
+                # ---- (d) recovery: sheds age out of the 5s slow
+                # window while ok traffic keeps flowing; the alert
+                # clears and the advice drops the burn signal.
+                deadline = time.perf_counter() + 60
+                cleared = None
+                while time.perf_counter() < deadline:
+                    client.predict(frames[2], frames[2])
+                    cleared = alerts_eval()
+                    if cleared["state_name"] == "ok":
+                        break
+                    time.sleep(0.5)
+                assert cleared["state_name"] == "ok", cleared
+                router.refresh_gauges()
+                adv = router.autoscale_advice
+                assert adv["signals"]["alert_burn"] < 2.0, adv
+                assert "burn" not in adv["reason"], adv
+        finally:
+            client.close()
+            router.close()
+            rt.join(10)
+            tier.close()
+            tt.join(10)
+            for srv, th in servers.values():
+                try:
+                    srv.close()
+                except Exception:
+                    pass
+                th.join(5)
+
     def test_drained_backend_restart_rejoins_rotation(self):
         """Scale-in undo: a backend drained through the router and then
         RESTARTED at the same host:port reports draining=false on its

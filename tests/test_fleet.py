@@ -1,0 +1,604 @@
+"""Fleet observatory (docs/observability.md "Fleet observatory"):
+cross-hop trace context + stitching, federated metrics, and live SLO
+burn-rate alerts.
+
+Unit layers run against the stdlib-only ``obs`` modules directly
+(parse/format, tail retention, stitch rules, federation merge, burn
+windows); the integration layer runs a REAL router over the model-free
+stub backends from test_cluster (header propagation, partial stitch,
+same-render scrape-failure visibility).  The full-cluster acceptance
+gate — real model, chaos replay, fire-and-clear — lives in
+test_cluster.py ``test_fleet_observatory_e2e``.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from raftstereo_tpu.config import RouterConfig
+from raftstereo_tpu.obs import (AlertClass, BurnRateAlerts, FleetFederator,
+                                TailSampler, Tracer, validate_prometheus)
+from raftstereo_tpu.obs.prom import parse_text
+from raftstereo_tpu.obs.stitch import (spans_from_chrome, stitch_sources,
+                                       stitch_tree)
+from raftstereo_tpu.ops.autoscale import AutoscalePolicy, recommend
+from raftstereo_tpu.serve import build_router
+from raftstereo_tpu.serve.httpbase import (TRACE_HEADER,
+                                           format_trace_context,
+                                           parse_trace_context)
+from raftstereo_tpu.serve.metrics import MetricsRegistry
+
+from test_cluster import _stop_stub, _stub_backend
+
+
+# ------------------------------------------------------- trace context
+
+class TestTraceContext:
+    def test_format_parse_roundtrip(self):
+        hdr = format_trace_context("tr-1.a", "cafe0123cafe0123")
+        ctx = parse_trace_context(hdr)
+        assert ctx.trace_id == "tr-1.a"
+        assert ctx.parent_id == "cafe0123cafe0123"
+        assert ctx.sampled is True
+
+    def test_sampled_zero_roundtrip(self):
+        ctx = parse_trace_context(
+            format_trace_context("t", sampled=False))
+        assert ctx == ("t", None, False)
+
+    def test_dashed_request_id_survives_as_trace_id(self):
+        # Client X-Request-Id values double as trace ids and may carry
+        # dashes/dots — the key-value format must not split on them.
+        rid = "req-2026-08-07.retry-1"
+        ctx = parse_trace_context(format_trace_context(rid))
+        assert ctx.trace_id == rid
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        "",
+        "garbage",                        # no key=value at all
+        "trace=",                         # empty id
+        "trace=ok;sampled=maybe",         # non-binary flag
+        "trace=has space;sampled=1",      # charset violation
+        "trace=ok;parent=no/slash",       # span charset violation
+        "parent=cafe;sampled=1",          # missing trace
+        "trace=" + "x" * 65,              # oversized token
+        "trace=ok;" + "y" * 300,          # oversized header
+        "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",
+    ])
+    def test_malformed_or_foreign_yields_fresh_trace(self, bad):
+        # W3C traceparent (last case) and every malformed form parse to
+        # None — the hop mints a fresh trace, it never 500s.
+        assert parse_trace_context(bad) is None
+
+    def test_parent_is_optional(self):
+        ctx = parse_trace_context("trace=abc;sampled=1")
+        assert ctx == ("abc", None, True)
+
+
+# -------------------------------------------------------- tail sampler
+
+class TestTailSampler:
+    def test_keeps_errors_always(self):
+        ts = TailSampler(capacity=4)
+        assert ts.offer("t-err", 0.001, 503) is True
+        assert "t-err" in ts
+        assert ts.stats()["kept_error"] == 1
+
+    def test_keeps_slow_over_threshold(self):
+        ts = TailSampler(capacity=4)
+        assert ts.offer("t-slow", 0.5, 200, threshold_s=0.1) is True
+        assert ts.retained()[0]["why"] == "slow"
+
+    def test_drops_fast_ok_deterministically(self):
+        ts = TailSampler(capacity=4)
+        assert ts.offer("t-fast", 0.01, 200, threshold_s=0.1) is False
+        assert ts.offer("t-fast", 0.01, 200, threshold_s=0.1) is False
+        assert "t-fast" not in ts
+        assert ts.stats()["dropped"] == 2
+
+    def test_no_threshold_keeps_only_errors(self):
+        # Early traffic: the caller has no p99 yet — nothing is "slow".
+        ts = TailSampler(capacity=4)
+        assert ts.offer("t", 10.0, 200, threshold_s=None) is False
+        assert ts.offer("t2", 10.0, 500, threshold_s=None) is True
+
+    def test_unsampled_trace_is_a_noop(self):
+        ts = TailSampler(capacity=4)
+        assert ts.offer(None, 1.0, 500) is False
+        assert ts.offer("", 1.0, 500) is False
+        assert ts.stats() == {"capacity": 4, "kept": 0, "dropped": 0,
+                              "kept_error": 0, "kept_slow": 0,
+                              "evicted": 0}
+
+    def test_ring_bound_evicts_oldest(self):
+        ts = TailSampler(capacity=2)
+        for i in range(4):
+            ts.offer(f"t{i}", 0.0, 500)
+        s = ts.stats()
+        assert s["kept"] == 2 and s["evicted"] == 2
+        assert [r["trace_id"] for r in ts.retained()] == ["t2", "t3"]
+
+
+# ------------------------------------------------------------ stitching
+
+def _chrome_doc(spans):
+    """Minimal to_chrome_trace-shaped doc from (name, span, parent, t0_us,
+    dur_us) tuples for one trace."""
+    return {"traceEvents": [
+        {"ph": "X", "name": n, "ts": t0, "dur": d,
+         "args": {"trace_id": "tr", "span_id": s, "parent_id": p}}
+        for n, s, p, t0, d in spans]}
+
+
+class TestStitch:
+    def test_explicit_cross_process_parentage(self):
+        # The router's hop span id crossed the wire in X-Trace-Context
+        # and became the backend root span's parent_id.
+        router = _chrome_doc([("route", "r1", "cli", 0, 1000),
+                              ("router_hop", "h1", "r1", 100, 800)])
+        backend = _chrome_doc([("request", "b1", "h1", 150, 700),
+                               ("admission", "a1", "b1", 160, 10)])
+        doc = stitch_sources("tr", [("router", router), ("b0", backend)])
+        assert doc["stitch"] == {"sources": ["router", "b0"], "gaps": [],
+                                 "n_spans": 4}
+        root = doc["tree"][0]["span"]
+        assert (root["name"], root["source"]) == ("route", "router")
+        hop = doc["tree"][0]["children"][0]
+        assert hop["span"]["name"] == "router_hop"
+        req = hop["children"][0]
+        assert (req["span"]["source"], req["span"]["name"]) == \
+            ("b0", "request")
+        assert req["children"][0]["span"]["name"] == "admission"
+
+    def test_orphans_attach_by_containment(self):
+        # The batcher's after-the-fact spans carry no parent_id: they
+        # attach under the SMALLEST enclosing interval.
+        doc = _chrome_doc([("request", "b1", None, 0, 10000),
+                           ("dispatch", "d1", None, 2000, 3000),
+                           ("queue_wait", "q1", None, 2100, 500)])
+        tree = stitch_tree(spans_from_chrome(doc, "b0"))
+        assert tree[0]["span"]["name"] == "request"
+        disp = tree[0]["children"][0]
+        assert disp["span"]["name"] == "dispatch"
+        assert disp["children"][0]["span"]["name"] == "queue_wait"
+
+    def test_unreachable_source_is_a_gap_not_a_500(self):
+        router = _chrome_doc([("route", "r1", None, 0, 1000)])
+        doc = stitch_sources("tr", [("router", router), ("b1", None)])
+        assert doc["stitch"]["gaps"] == ["b1"]
+        assert doc["stitch"]["sources"] == ["router"]
+        assert len(doc["tree"]) == 1  # partial tree, not an error
+
+    def test_foreign_and_metadata_events_are_skipped(self):
+        doc = {"traceEvents": [
+            {"ph": "M", "name": "process_name", "args": {"name": "x"}},
+            {"ph": "X", "name": "no-ids", "ts": 0, "dur": 1, "args": {}},
+            {"ph": "X", "name": "ok", "ts": 0, "dur": 1,
+             "args": {"trace_id": "tr", "span_id": "s1"}},
+            "not-a-dict",
+        ]}
+        spans = spans_from_chrome(doc, "src")
+        assert [s["name"] for s in spans] == ["ok"]
+        assert spans_from_chrome(None, "src") == []
+
+    def test_stitched_doc_is_a_valid_chrome_trace(self):
+        # Perfetto-loadable: traceEvents with one synthetic pid per
+        # source + process_name metadata.
+        router = _chrome_doc([("route", "r1", None, 0, 1000)])
+        backend = _chrome_doc([("request", "b1", "r1", 100, 800)])
+        doc = stitch_sources("tr", [("router", router), ("b0", backend)])
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        ms = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {e["pid"] for e in xs} == {1, 2}
+        assert {e["args"]["name"] for e in ms} == {"router", "b0"}
+        # filtering: spans of OTHER traces in a source never leak in
+        noisy = _chrome_doc([("request", "b9", None, 0, 1)])
+        noisy["traceEvents"][0]["args"]["trace_id"] = "other"
+        doc2 = stitch_sources("tr", [("b0", noisy)])
+        assert doc2["stitch"]["n_spans"] == 0
+
+    def test_real_tracer_exports_stitch(self):
+        # End-to-end through the actual Tracer export format.
+        rt, bt = Tracer(), Tracer()
+        t = time.perf_counter()
+        route_sid = rt.new_span_id()
+        hop_sid = rt.new_span_id()
+        rt.record("route", t, t + 0.10, "tr", span_id=route_sid)
+        rt.record("router_hop", t + 0.01, t + 0.09, "tr",
+                  parent_id=route_sid, span_id=hop_sid)
+        bt.record("request", t + 0.02, t + 0.08, "tr",
+                  parent_id=hop_sid)
+        doc = stitch_sources("tr", [
+            ("router", rt.to_chrome(trace_id="tr")),
+            ("b0", bt.to_chrome(trace_id="tr"))])
+        hop = doc["tree"][0]["children"][0]
+        assert hop["span"]["name"] == "router_hop"
+        assert hop["children"][0]["span"]["source"] == "b0"
+
+
+# ----------------------------------------------------------- federation
+
+_B0_TEXT = """\
+# HELP serve_requests_total total requests
+# TYPE serve_requests_total counter
+serve_requests_total{endpoint="predict",outcome="ok"} 5
+serve_requests_total{endpoint="predict",outcome="error"} 1
+"""
+
+_B1_TEXT = """\
+# HELP serve_requests_total total requests
+# TYPE serve_requests_total counter
+serve_requests_total{endpoint="predict",outcome="ok"} 7
+# HELP serve_latency_seconds request latency
+# TYPE serve_latency_seconds histogram
+serve_latency_seconds_bucket{le="0.1"} 7
+serve_latency_seconds_bucket{le="+Inf"} 7
+serve_latency_seconds_sum 0.2
+serve_latency_seconds_count 7
+"""
+
+
+class TestFleetFederator:
+    def _federator(self, texts):
+        registry = MetricsRegistry()
+        fetched = dict(texts)
+
+        def fetch(host, port, timeout_s):
+            text = fetched[host]
+            if text is None:
+                raise OSError("unreachable")
+            return text
+
+        fed = FleetFederator(
+            registry,
+            targets_fn=lambda: [(label, label, 1) for label in fetched],
+            fetch_fn=fetch)
+        return registry, fed
+
+    def test_union_is_validator_clean_and_backend_labeled(self):
+        registry, fed = self._federator({"b0": _B0_TEXT, "b1": _B1_TEXT})
+        fs = fed.federate()
+        assert fs.sources == ["b0", "b1"] and fs.gaps == []
+        assert validate_prometheus(fs.text) == []
+        # per-backend sums equal the individual scrapes
+        m = fs.scrape.get("serve_requests_total")
+        by_backend = {}
+        for litems, value in m.series("serve_requests_total"):
+            labels = dict(litems)
+            by_backend.setdefault(labels["backend"], 0.0)
+            by_backend[labels["backend"]] += value
+        assert by_backend == {"b0": 6.0, "b1": 7.0}
+        # histogram series keep per-backend bucket ladders
+        assert 'serve_latency_seconds_bucket{backend="b1",le="+Inf"} 7' \
+            in fs.text
+
+    def test_scrape_failure_visible_in_same_render(self):
+        registry, fed = self._federator({"b0": _B0_TEXT, "b1": None})
+        fs = fed.federate(local_text_fn=registry.render)
+        assert fs.sources == ["b0"] and fs.gaps == ["b1"]
+        # THIS render already carries the failure increment (the local
+        # text is produced after the foreign scrapes) — never one late.
+        assert 'fleet_scrape_failures_total{backend="b1"} 1' in fs.text
+        assert validate_prometheus(fs.text) == []
+
+    def test_invalid_foreign_exposition_is_a_counted_gap(self):
+        registry, fed = self._federator({"b0": "{json: not-metrics}"})
+        fs = fed.federate()
+        assert fs.gaps == ["b0"]
+        assert 'fleet_scrapes_total{backend="b0"} 1' in fs.text
+
+    def test_router_series_pass_through_unlabeled(self):
+        registry, fed = self._federator({"b0": _B0_TEXT})
+        own = registry.counter("router_demo_total", "demo counter")
+        own.inc(3)
+        fs = fed.federate()
+        assert "router_demo_total 3" in fs.text
+        assert 'router_demo_total{backend=' not in fs.text
+
+
+# ------------------------------------------------------ burn-rate alerts
+
+def _scrape(requests_ok, errors, sheds=0):
+    lines = ["# HELP serve_requests_total t",
+             "# TYPE serve_requests_total counter",
+             f'serve_requests_total{{backend="b0",outcome="ok"}} '
+             f"{requests_ok}"]
+    if errors:
+        lines.append(f'serve_requests_total{{backend="b0",'
+                     f'outcome="error"}} {errors}')
+    if sheds:
+        lines.append(f'serve_requests_total{{backend="b0",'
+                     f'outcome="shed"}} {sheds}')
+    return parse_text("\n".join(lines) + "\n")
+
+
+class TestBurnRateAlerts:
+    def _alerts(self, **kw):
+        registry = MetricsRegistry()
+        kw.setdefault("classes",
+                      (AlertClass(max_error_rate=0.05),))
+        kw.setdefault("fast_window_s", 30.0)
+        kw.setdefault("page_burn", 2.0)
+        return registry, BurnRateAlerts(registry, **kw)
+
+    def test_fires_during_fault_window_and_clears(self):
+        registry, al = self._alerts()
+        assert al.max_burn() == 0.0  # before any evaluation
+        al.observe(_scrape(0, 0), now=0.0)
+        doc = al.observe(_scrape(100, 0), now=10.0)
+        assert doc["classes"][0]["state_name"] == "ok"
+        # fault window: 30 new errors over 100 new requests = 30%
+        # error rate against a 5% budget -> burn 6 in BOTH windows.
+        doc = al.observe(_scrape(170, 30), now=20.0)
+        cls = doc["classes"][0]
+        assert cls["state_name"] == "page"
+        assert cls["burn_fast"] >= 2.0 and cls["burn_slow"] >= 2.0
+        assert al.max_burn() == cls["burn"]
+        # recovery: error counter flat while requests keep flowing —
+        # old errors age out of both windows.
+        al.observe(_scrape(1000, 30), now=100.0)
+        al.observe(_scrape(5000, 30), now=290.0)
+        doc = al.observe(_scrape(6000, 30), now=300.0)
+        assert doc["classes"][0]["state_name"] == "ok"
+        assert al.max_burn() == 0.0
+        # the exported gauge followed the transitions
+        state = {lv: g.value for lv, g in al.alert_state.series()}
+        assert state[("tier=*,priority=*",)] == 0
+
+    def test_fast_only_spike_warns_but_does_not_page(self):
+        registry, al = self._alerts()
+        al.observe(_scrape(0, 0), now=0.0)
+        for t in range(10, 150, 10):  # long clean history, 10 req/s
+            al.observe(_scrape(10 * t, 0), now=float(t))
+        # 20 errors in the last 10s: ~6.7% error rate over the 30s fast
+        # window (burn ~1.3) but ~1.3% over the 150s slow window (burn
+        # ~0.27) — the spike WARNs, only sustained burn pages.
+        doc = al.observe(_scrape(1480, 20), now=150.0)
+        cls = doc["classes"][0]
+        assert cls["burn_fast"] >= 1.0
+        assert cls["burn_slow"] < 1.0
+        assert cls["state_name"] == "warn"  # no page on fast alone
+
+    def test_shed_budget_is_separate(self):
+        registry, al = self._alerts(
+            classes=(AlertClass(max_shed_rate=0.25),))
+        al.observe(_scrape(0, 0), now=0.0)
+        doc = al.observe(_scrape(50, 0, sheds=50), now=10.0)
+        cls = doc["classes"][0]
+        assert cls["state_name"] == "page"  # 50% shed vs 25% budget
+
+    def test_p99_bound_contributes_burn(self):
+        registry, al = self._alerts(
+            classes=(AlertClass(p99_ms=100.0),))
+        al.observe(_scrape(0, 0), now=0.0)
+        doc = al.observe(_scrape(100, 0), p99_s=0.25, now=10.0)
+        cls = doc["classes"][0]
+        assert cls["state_name"] == "page"  # 250ms vs 100ms bound
+        doc = al.observe(_scrape(200, 0), p99_s=0.05, now=20.0)
+        # burn history: the p99 applies per evaluation, not cumulative
+        assert doc["classes"][0]["burn_fast"] == 0.5
+
+    def test_unset_bounds_never_burn(self):
+        registry, al = self._alerts(classes=(AlertClass(),))
+        al.observe(_scrape(0, 0), now=0.0)
+        doc = al.observe(_scrape(100, 99), now=10.0)
+        # max_error_rate defaults to 1.0: 99% errors is burn 0.99 < 1
+        assert doc["classes"][0]["state_name"] == "ok"
+
+    def test_class_vocabulary_mirrors_slo_class(self):
+        """AlertClass re-declares (never imports — the router is
+        model-free) the loadgen.slo.SLOClass vocabulary: shared field
+        names, defaults, and the selector string must stay identical."""
+        import dataclasses
+
+        from raftstereo_tpu.loadgen.slo import SLOClass
+
+        slo_fields = {f.name: f.default
+                      for f in dataclasses.fields(SLOClass)}
+        for f in dataclasses.fields(AlertClass):
+            assert f.name in slo_fields, \
+                f"AlertClass.{f.name} not in SLOClass"
+            assert f.default == slo_fields[f.name], f.name
+        a, s = AlertClass(tier="rt", priority="high"), \
+            SLOClass(tier="rt", priority="high")
+        assert a.selector() == s.selector()
+
+
+class TestAutoscaleAlertSignal:
+    def test_page_rate_burn_scales_up(self):
+        policy = AutoscalePolicy()
+        d, reason = recommend(policy, ready=2, utilization=0.5,
+                              alert_burn=2.5)
+        assert d == 1 and "burn" in reason
+
+    def test_sub_page_burn_is_not_a_signal(self):
+        d, _ = recommend(AutoscalePolicy(), ready=2, utilization=0.5,
+                         alert_burn=1.5)
+        assert d == 0
+
+    def test_shed_still_outranks_burn(self):
+        d, reason = recommend(AutoscalePolicy(), ready=2,
+                              utilization=0.5, shed_delta=1.0,
+                              alert_burn=9.0)
+        assert d == 1 and "shed" in reason
+
+
+# ------------------------------------------------- router integration
+
+class TestRouterFleetIntegration:
+    """REAL router over model-free stub backends: header propagation,
+    sampled-flag suppression, partial stitch, same-render federation
+    failure visibility.  In-process route_predict where possible; HTTP
+    where the handler layer itself is under test."""
+
+    def _router(self, stubs, **kw):
+        cfg = dict(port=0,
+                   backends=tuple(("127.0.0.1", s.server_address[1])
+                                  for s in stubs),
+                   probe_interval_s=30.0, retries=2,
+                   retry_backoff_ms=5.0, request_timeout_s=5.0,
+                   fleet_timeout_s=1.0)
+        cfg.update(kw)
+        router = build_router(RouterConfig(**cfg))
+        rt = threading.Thread(target=router.serve_forever, daemon=True)
+        rt.start()
+        return router, rt
+
+    def test_trace_context_continues_to_backend(self):
+        capture = []
+        s0, t0 = _stub_backend(capture=capture)
+        router, rt = self._router([s0])
+        try:
+            status, _, _, _ = router.route_predict(
+                b"{}", None, "rid-1", trace=("tr-ctx", "client-span"))
+            assert status == 200
+            ctx = parse_trace_context(capture[0][TRACE_HEADER])
+            assert ctx.trace_id == "tr-ctx" and ctx.sampled is True
+            # the outbound parent is the router's pre-minted hop span
+            spans = {s.name: s for s in
+                     router.tracer.spans(trace_id="tr-ctx")}
+            assert ctx.parent_id == spans["router_hop"].span_id
+            # route span continues the CLIENT's parent; the hop span
+            # parents under the route span.
+            assert spans["route"].parent_id == "client-span"
+            assert spans["router_hop"].parent_id == \
+                spans["route"].span_id
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+
+    def test_unsampled_request_suppresses_spans_everywhere(self):
+        capture = []
+        s0, t0 = _stub_backend(capture=capture)
+        router, rt = self._router([s0])
+        try:
+            status, _, _, _ = router.route_predict(
+                b"{}", None, "rid-uns", trace=(None, None))
+            assert status == 200  # served normally, just not spanned
+            ctx = parse_trace_context(capture[0][TRACE_HEADER])
+            assert ctx.sampled is False  # suppression propagates
+            assert router.tracer.spans(trace_id="rid-uns") == []
+            assert router.tail.stats()["kept"] == 0
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+
+    def test_malformed_header_gets_fresh_trace_over_http(self):
+        # The handler layer: a garbage X-Trace-Context must neither 500
+        # nor leak into the trace — the request id becomes the trace id.
+        s0, t0 = _stub_backend()
+        router, rt = self._router([s0])
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                              timeout=10)
+            conn.request("POST", "/predict", b"{}",
+                         {"Content-Type": "application/json",
+                          "X-Request-Id": "rid-mal",
+                          TRACE_HEADER: "garbage;;;==;"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            conn.close()
+            names = {s.name for s in
+                     router.tracer.spans(trace_id="rid-mal")}
+            assert names == {"route", "router_hop"}
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+
+    def test_stitched_trace_partial_on_dead_backend(self):
+        s0, t0 = _stub_backend()
+        s1, t1 = _stub_backend()
+        router, rt = self._router([s0, s1])
+        try:
+            status, _, _, _ = router.route_predict(
+                b"{}", None, "rid-st", trace=("tr-st", None))
+            assert status == 200
+            _stop_stub(s1, t1)
+            doc = router.stitched_trace("tr-st")
+            assert "router" in doc["stitch"]["sources"]
+            assert "b1" in doc["stitch"]["gaps"]  # partial, not a 500
+            root = doc["tree"][0]
+            assert root["span"]["name"] == "route"
+            assert root["children"][0]["span"]["name"] == "router_hop"
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+
+    def test_fleet_scrape_counts_non_exposition_backends(self):
+        # The stubs answer /metrics with healthz JSON — an INVALID
+        # exposition.  The federated render must stay validator-clean,
+        # count the failures, and carry them in the SAME render.
+        s0, t0 = _stub_backend()
+        router, rt = self._router([s0])
+        try:
+            fs = router.federate()
+            assert fs.gaps == ["b0"]
+            assert validate_prometheus(fs.text) == []
+            assert 'fleet_scrape_failures_total{backend="b0"} 1' \
+                in fs.text
+            # the families also ride the router's own /metrics render
+            assert "fleet_scrape_failures_total" in \
+                router.registry.render()
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
+
+    def test_error_routes_feed_the_tail_sampler(self):
+        s0, t0 = _stub_backend()
+        router, rt = self._router([s0], retries=0)
+        try:
+            _stop_stub(s0, t0)
+            status, _, _, _ = router.route_predict(
+                b"{}", None, "rid-err", trace=("tr-err", None))
+            assert status >= 500
+            assert "tr-err" in router.tail
+            assert router.tail.stats()["kept_error"] == 1
+        finally:
+            router.close()
+            rt.join(5)
+
+    def test_debug_endpoints_over_http(self):
+        s0, t0 = _stub_backend()
+        router, rt = self._router([s0])
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                              timeout=10)
+
+            def get(path):
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                body = resp.read()
+                return resp.status, body
+
+            status, body = get("/metrics/fleet")
+            assert status == 200
+            assert validate_prometheus(body.decode()) == []
+            status, body = get("/debug/alerts")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["classes"][0]["state_name"] == "ok"
+            status, body = get("/debug/trace?trace_id=none-such")
+            assert status == 200
+            assert json.loads(body)["stitch"]["n_spans"] == 0
+            # ?last=N stays the flat pre-stitching export
+            status, body = get("/debug/trace?last=5")
+            assert status == 200 and "tree" not in json.loads(body)
+            status, body = get("/debug/vars")
+            dvars = json.loads(body)
+            assert dvars["tail"]["capacity"] == 256
+            assert "alerts" in dvars
+            conn.close()
+        finally:
+            router.close()
+            rt.join(5)
+            _stop_stub(s0, t0)
